@@ -1,0 +1,27 @@
+"""deppy_tpu — a TPU-native constraint-resolution framework.
+
+A ground-up rebuild of the capabilities of the reference dependency
+resolver (entities → constraint generators → variables → preference-ordered,
+cardinality-minimized SAT resolution with human-readable unsat cores),
+re-architected for TPU hardware: constraints lower to dense padded clause
+tensors plus native cardinality rows, and resolution runs as a lockstep
+batched DPLL inside ``jax.lax.while_loop`` — vmapped over thousands of
+independent problems and sharded across a device mesh.
+
+Layers (bottom-up, mirroring SURVEY.md §1):
+  * :mod:`deppy_tpu.sat`     — constraint vocabulary, tensor lowering, host
+    reference engine, solver facade (reference pkg/sat).
+  * :mod:`deppy_tpu.engine`  — the batched TPU tensor engine (replaces gini).
+  * :mod:`deppy_tpu.ops`     — device kernels (BCP round; Pallas variants).
+  * :mod:`deppy_tpu.entity`  — entity/data layer (reference pkg/entitysource).
+  * :mod:`deppy_tpu.resolution` — constraint-generation API + resolution
+    facade (reference pkg/constraints + pkg/solver).
+  * :mod:`deppy_tpu.parallel` — mesh/sharding utilities.
+  * :mod:`deppy_tpu.models`  — benchmark problem families (BASELINE.json).
+"""
+
+__version__ = "0.1.0"
+
+from . import sat
+
+__all__ = ["sat", "__version__"]

@@ -1,0 +1,24 @@
+"""Benchmark problem families.
+
+Generators for the workload configs recorded in BASELINE.json plus the
+reference's random-instance benchmark generator
+(/root/reference/pkg/sat/bench_test.go:10-64).  These are the "model zoo"
+of a constraint-resolution framework: realistic catalog shapes used for
+conformance fuzzing, differential testing, and performance measurement.
+"""
+
+from .random_instance import random_instance
+from .catalog import (
+    fleet_states,
+    gvk_conflict_catalog,
+    operatorhub_catalog,
+    version_pinned_chains,
+)
+
+__all__ = [
+    "fleet_states",
+    "gvk_conflict_catalog",
+    "operatorhub_catalog",
+    "random_instance",
+    "version_pinned_chains",
+]

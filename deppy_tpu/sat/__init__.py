@@ -1,0 +1,67 @@
+"""Boolean-constraint satisfiability layer.
+
+The rebuild of the reference's ``pkg/sat`` (general-purpose solver for
+boolean constraint satisfiability, /root/reference/pkg/sat/doc.go:1-3):
+constraint vocabulary, dense tensor lowering, the host reference engine,
+and the solver facade.  The TPU tensor engine lives in
+:mod:`deppy_tpu.engine` and is selected via ``Solver(backend=...)``.
+"""
+
+from .constraints import (
+    AppliedConstraint,
+    AtMost,
+    Conflict,
+    Constraint,
+    Dependency,
+    Identifier,
+    Mandatory,
+    Prohibited,
+    Variable,
+    at_most,
+    conflict,
+    dependency,
+    mandatory,
+    prohibited,
+    variable,
+)
+from .encode import Problem, encode
+from .errors import (
+    DuplicateIdentifier,
+    Incomplete,
+    InternalSolverError,
+    NotSatisfiable,
+)
+from .host import HostEngine
+from .solver import Solver
+from .tracer import DefaultTracer, LoggingTracer, SearchPosition, StatsTracer, Tracer
+
+__all__ = [
+    "AppliedConstraint",
+    "AtMost",
+    "Conflict",
+    "Constraint",
+    "Dependency",
+    "DefaultTracer",
+    "DuplicateIdentifier",
+    "HostEngine",
+    "Identifier",
+    "Incomplete",
+    "InternalSolverError",
+    "LoggingTracer",
+    "Mandatory",
+    "NotSatisfiable",
+    "Problem",
+    "Prohibited",
+    "SearchPosition",
+    "Solver",
+    "StatsTracer",
+    "Tracer",
+    "Variable",
+    "at_most",
+    "conflict",
+    "dependency",
+    "encode",
+    "mandatory",
+    "prohibited",
+    "variable",
+]

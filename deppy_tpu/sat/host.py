@@ -1,0 +1,447 @@
+"""Host (CPU/NumPy) reference engine.
+
+This is the executable semantic specification of the solve algorithm — the
+rebuild's stand-in for gini + the reference's search driver.  The TPU tensor
+engine (:mod:`deppy_tpu.engine`) implements the *same* algorithm with dense
+fixed-shape state inside ``lax.while_loop``; differential tests assert the
+two agree bit-for-bit on outcomes, installed sets, and unsat cores.
+
+Algorithm (mirroring /root/reference/pkg/sat/solve.go:53-119 and
+search.go:34-203):
+
+1. assume every constraint's activation + every anchor (solve.go:67-75) and
+   run a baseline propagation "Test" (solve.go:79);
+2. if undetermined, run the preference-ordered guess search: a deque of
+   choices (anchor singletons, then Dependency candidate lists pushed when
+   their subject is guessed), depth-first with chronological backtracking
+   that retries the next candidate of a failed choice (search.go:34-98);
+3. on SAT, cardinality-minimize only the "extras" — model-true variables
+   that were never guessed — holding guesses true and model-false variables
+   false (solve.go:86-113);
+4. on UNSAT, report a minimal core of applied constraints
+   (solve.go:114-115) computed by deletion-based minimization over
+   activation assumptions (the engine-agnostic analog of gini's ``Why``).
+
+Propagation ("Test", gini inter.S.Test) is a dense boolean-constraint
+propagation to fixpoint over the clause matrix plus native cardinality rows;
+full "Solve" (gini CDCL, search.go:168) is DPLL with false-first polarity on
+the lowest-index unassigned variable, which doubles as a
+minimal-model-biased completion.
+"""
+
+from __future__ import annotations
+
+from collections import deque as _deque
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from .constraints import AppliedConstraint, Variable
+from .encode import Problem
+from .errors import Incomplete, InternalSolverError, NotSatisfiable
+from .tracer import SearchPosition, Tracer
+
+SAT = 1
+UNSAT = -1
+UNKNOWN = 0
+
+_UNASSIGNED = 0
+_TRUE = 1
+_FALSE = -1
+
+
+@dataclass
+class _Guess:
+    """One entry of the guess stack (reference search.go:16-21)."""
+
+    choice: int                 # choice-table row
+    index: int                  # candidate index guessed (or where search stopped)
+    var: int                    # guessed var, or -1 if the choice was null/satisfied
+    children: int               # choices spawned by this guess
+
+
+class _Position(SearchPosition):
+    def __init__(self, variables: List[Variable], conflicts: List[AppliedConstraint]):
+        self._variables = variables
+        self._conflicts = conflicts
+
+    def variables(self) -> List[Variable]:
+        return self._variables
+
+    def conflicts(self) -> List[AppliedConstraint]:
+        return self._conflicts
+
+
+class HostEngine:
+    """Reference engine over a lowered :class:`Problem`."""
+
+    def __init__(
+        self,
+        problem: Problem,
+        tracer: Optional[Tracer] = None,
+        max_steps: Optional[int] = None,
+    ):
+        self.p = problem
+        self.tracer = tracer
+        self.max_steps = max_steps
+        self._steps = 0
+
+        p = problem
+        self.n = p.n_vars
+        self.v = p.n_total
+        # Precompute clause index/sign planes for vectorized propagation.
+        cls = p.clauses
+        self._cls_mask = cls != 0
+        self._cls_var = np.where(self._cls_mask, np.abs(cls) - 1, 0)
+        self._cls_sign = np.sign(cls).astype(np.int8)
+        card = p.card_ids
+        self._card_mask = card >= 0
+        self._card_var = np.where(self._card_mask, card, 0)
+        # Base assignment: all activation vars true (AssumeConstraints,
+        # lit_mapping.go:136-140).
+        self._base = np.zeros(self.v, dtype=np.int8)
+        if p.n_cons:
+            self._base[self.n :] = _TRUE
+        self.last_conflicts: List[AppliedConstraint] = []
+
+    # ------------------------------------------------------------------ BCP
+
+    def _bcp(
+        self,
+        assign: np.ndarray,
+        min_mask: Optional[np.ndarray] = None,
+        min_w: int = 0,
+    ) -> Tuple[bool, np.ndarray]:
+        """Propagate to fixpoint.  Returns (conflict, assignment).
+
+        One round evaluates every clause and cardinality row simultaneously —
+        the dense analog of watched-literal BCP, and the op the TPU engine
+        turns into a vmapped kernel.  ``min_mask``/``min_w`` is the dynamic
+        "at most w of the extras" side-constraint used by the minimization
+        loop (the native replacement for CardinalityConstrainer + Leq(w),
+        solve.go:100-110).
+        """
+        p = self.p
+        self.last_conflicts = []
+        while True:
+            changed = False
+            conflict = False
+            want = np.zeros(self.v, dtype=np.int8)  # pending implications
+
+            if p.clauses.shape[0]:
+                vals = assign[self._cls_var] * self._cls_sign
+                vals = np.where(self._cls_mask, vals, _FALSE)
+                sat_c = (vals == _TRUE).any(axis=1)
+                unass = (vals == _UNASSIGNED).sum(axis=1)
+                dead = ~sat_c & (unass == 0)
+                if dead.any():
+                    self.last_conflicts = [
+                        p.applied[j] for j in p.clause_con[np.nonzero(dead)[0]]
+                    ]
+                    return True, assign
+                units = ~sat_c & (unass == 1)
+                if units.any():
+                    rows = np.nonzero(units)[0]
+                    cols = np.argmax(vals[rows] == _UNASSIGNED, axis=1)
+                    uvars = self._cls_var[rows, cols]
+                    usigns = self._cls_sign[rows, cols]
+                    for uv, us in zip(uvars, usigns):
+                        if want[uv] != 0 and want[uv] != us:
+                            self.last_conflicts = [
+                                p.applied[j] for j in p.clause_con[rows]
+                            ]
+                            return True, assign
+                        want[uv] = us
+
+            if p.card_ids.shape[0]:
+                mvals = assign[self._card_var]
+                trues = ((mvals == _TRUE) & self._card_mask).sum(axis=1)
+                unk = ((mvals == _UNASSIGNED) & self._card_mask).sum(axis=1)
+                active = assign[p.card_act] == _TRUE
+                over = active & (trues > p.card_n)
+                if over.any():
+                    self.last_conflicts = [
+                        p.applied[j] for j in p.card_con[np.nonzero(over)[0]]
+                    ]
+                    return True, assign
+                full = active & (trues == p.card_n) & (unk > 0)
+                for r in np.nonzero(full)[0]:
+                    for m in p.card_ids[r]:
+                        if m >= 0 and assign[m] == _UNASSIGNED:
+                            if want[m] == _TRUE:
+                                self.last_conflicts = [p.applied[p.card_con[r]]]
+                                return True, assign
+                            want[m] = _FALSE
+
+            if min_mask is not None:
+                mvals = assign[: self.n]
+                trues = int(((mvals == _TRUE) & min_mask).sum())
+                unk_sel = (mvals == _UNASSIGNED) & min_mask
+                if trues > min_w:
+                    return True, assign
+                if trues == min_w and unk_sel.any():
+                    for m in np.nonzero(unk_sel)[0]:
+                        if want[m] == _TRUE:
+                            return True, assign
+                        want[m] = _FALSE
+
+            pending = want != 0
+            new = pending & (assign == _UNASSIGNED)
+            clash = pending & (assign != _UNASSIGNED) & (assign != want)
+            if clash.any():
+                return True, assign
+            if not new.any():
+                return False, assign
+            assign = assign.copy()
+            assign[new] = want[new]
+
+    # ----------------------------------------------------------------- Test
+
+    def _test(
+        self,
+        guessed: Sequence[int],
+        extra_true: Sequence[int] = (),
+        extra_false: Sequence[int] = (),
+        anchors_assumed: bool = True,
+        act_enabled: Optional[np.ndarray] = None,
+    ) -> Tuple[int, np.ndarray]:
+        """Propagation-only check of the current assumption set — the analog
+        of gini's ``Test`` (inter.S; used at solve.go:79, search.go:76).
+        Returns SAT only when propagation alone yields a total assignment."""
+        self._count_step()
+        assign = self._base.copy()
+        if act_enabled is not None:
+            assign[self.n :] = np.where(act_enabled, _TRUE, _UNASSIGNED)
+        if anchors_assumed:
+            assign[self.p.anchors] = _TRUE
+        for m in guessed:
+            assign[m] = _TRUE
+        for m in extra_true:
+            assign[m] = _TRUE
+        for m in extra_false:
+            assign[m] = _FALSE
+        conflict, assign = self._bcp(assign)
+        if conflict:
+            return UNSAT, assign
+        if (assign[: self.n] != _UNASSIGNED).all():
+            return SAT, assign
+        return UNKNOWN, assign
+
+    # ----------------------------------------------------------------- DPLL
+
+    def _dpll(
+        self,
+        fixed_true: Sequence[int] = (),
+        fixed_false: Sequence[int] = (),
+        anchors_assumed: bool = True,
+        act_enabled: Optional[np.ndarray] = None,
+        min_mask: Optional[np.ndarray] = None,
+        min_w: int = 0,
+    ) -> Tuple[bool, Optional[np.ndarray]]:
+        """Complete search under assumptions — the analog of gini ``Solve()``
+        (search.go:168, solve.go:107).  Chronological DPLL, deciding the
+        lowest-index unassigned problem variable false first, so discovered
+        models are biased toward minimal installs before the explicit
+        cardinality-minimization pass."""
+        assign = self._base.copy()
+        if act_enabled is not None:
+            assign[self.n :] = np.where(act_enabled, _TRUE, _UNASSIGNED)
+        if anchors_assumed:
+            assign[self.p.anchors] = _TRUE
+        for m in fixed_true:
+            assign[m] = _TRUE
+        for m in fixed_false:
+            assign[m] = _FALSE
+
+        conflict, assign = self._bcp(assign, min_mask, min_w)
+        if conflict:
+            return False, None
+        # stack of (var, phase_tried_second, snapshot)
+        stack: List[Tuple[int, bool, np.ndarray]] = []
+        while True:
+            self._count_step()
+            unassigned = np.nonzero(assign[: self.n] == _UNASSIGNED)[0]
+            if unassigned.size == 0:
+                return True, assign
+            var = int(unassigned[0])
+            stack.append((var, False, assign))
+            trial = assign.copy()
+            trial[var] = _FALSE
+            conflict, trial = self._bcp(trial, min_mask, min_w)
+            while conflict:
+                # Backtrack chronologically: flip the deepest unflipped
+                # decision to true; pop flipped ones.
+                while stack and stack[-1][1]:
+                    stack.pop()
+                if not stack:
+                    return False, None
+                var, _, snap = stack.pop()
+                stack.append((var, True, snap))
+                trial = snap.copy()
+                trial[var] = _TRUE
+                conflict, trial = self._bcp(trial, min_mask, min_w)
+            assign = trial
+
+    # --------------------------------------------------------------- search
+
+    def solve(self) -> Tuple[List[Variable], List[int]]:
+        """Run the full algorithm.  Returns (installed variables in input
+        order, installed indices).  Raises NotSatisfiable / Incomplete /
+        InternalSolverError like the reference's error contract
+        (solve.go:53-119)."""
+        p = self.p
+        if p.errors:
+            raise InternalSolverError(p.errors)
+
+        outcome, assign = self._test(guessed=())
+        model: Optional[np.ndarray] = assign if outcome == SAT else None
+        guessed_order: List[int] = []
+        guessed: Set[int] = set()
+
+        if outcome == UNKNOWN:
+            outcome, guessed_order, model = self._search()
+            guessed = set(guessed_order)
+        elif outcome == SAT:
+            # Search skipped: the baseline anchors play the role of the
+            # guess set for minimization purposes (solve.go:77-83 keeps the
+            # anchor assumptions when search doesn't run).
+            guessed = set(int(x) for x in p.anchors)
+
+        if outcome == SAT:
+            assert model is not None
+            return self._minimize(model, guessed)
+        if outcome == UNSAT:
+            raise NotSatisfiable(self._unsat_core())
+        raise Incomplete()
+
+    def _search(self) -> Tuple[int, List[int], Optional[np.ndarray]]:
+        """Preference-ordered guess search (reference search.go:158-203)."""
+        p = self.p
+        dq: _deque = _deque()
+        for r in range(len(p.anchors)):
+            dq.append((r, 0))  # anchor choice rows come first in the table
+        guesses: List[_Guess] = []
+        result = UNKNOWN
+        model: Optional[np.ndarray] = None
+
+        def assumed_vars() -> List[int]:
+            return [g.var for g in guesses if g.var >= 0]
+
+        while True:
+            if not dq and result == UNKNOWN:
+                ok, m = self._dpll(fixed_true=assumed_vars())
+                result = SAT if ok else UNSAT
+                if ok:
+                    model = m
+
+            if result == UNSAT:
+                if self.tracer is not None:
+                    self.tracer.trace(
+                        _Position(
+                            [p.variables[g.var] for g in guesses if g.var >= 0],
+                            list(self.last_conflicts),
+                        )
+                    )
+                if not guesses:
+                    break
+                # PopGuess (search.go:79-98): drop children from the back,
+                # requeue the choice at the front advancing its candidate.
+                g = guesses.pop()
+                for _ in range(g.children):
+                    dq.pop()
+                dq.appendleft((g.choice, g.index + (1 if g.var >= 0 else 0)))
+                if g.var >= 0:
+                    result, assign = self._test(guessed=assumed_vars())
+                    if result == SAT:
+                        model = assign
+                continue
+
+            if not dq:
+                break  # satisfiable and no decisions left (search.go:182-184)
+
+            # PushGuess (search.go:34-77).
+            cid, idx = dq.popleft()
+            cands = [int(c) for c in p.choice_cand[cid] if c >= 0]
+            var = cands[idx] if idx < len(cands) else -1
+            assumed = set(assumed_vars())
+            if any(c in assumed for c in cands):
+                var = -1  # choice already satisfied by an assumption
+            g = _Guess(choice=cid, index=idx, var=var, children=0)
+            guesses.append(g)
+            if var < 0:
+                continue
+            for ch in p.var_choices[var] if var < len(p.var_choices) else []:
+                if ch >= 0:
+                    g.children += 1
+                    dq.append((int(ch), 0))
+            result, assign = self._test(guessed=assumed_vars())
+            if result == SAT:
+                model = assign
+
+        return result, assumed_vars(), model
+
+    # ----------------------------------------------------------- minimize
+
+    def _minimize(
+        self, model: np.ndarray, guessed: Set[int]
+    ) -> Tuple[List[Variable], List[int]]:
+        """Extras-only cardinality minimization (solve.go:86-113): variables
+        chosen by the search stay installed, model-false variables stay out,
+        and the count of incidental extras is driven to the minimum
+        satisfiable w."""
+        p = self.p
+        extras = [
+            i
+            for i in range(self.n)
+            if model[i] == _TRUE and i not in guessed
+        ]
+        excluded = [
+            i
+            for i in range(self.n)
+            if model[i] != _TRUE and i not in guessed
+        ]
+        min_mask = np.zeros(self.n, dtype=bool)
+        min_mask[extras] = True
+        for w in range(len(extras) + 1):
+            ok, m2 = self._dpll(
+                fixed_true=sorted(guessed),
+                fixed_false=excluded,
+                min_mask=min_mask,
+                min_w=w,
+            )
+            if ok:
+                assert m2 is not None
+                installed_idx = [i for i in range(self.n) if m2[i] == _TRUE]
+                return [p.variables[i] for i in installed_idx], installed_idx
+        raise InternalSolverError(["unexpected internal error: minimization failed"])
+
+    # ---------------------------------------------------------- unsat core
+
+    def _unsat_core(self) -> List[AppliedConstraint]:
+        """Minimal unsat core over applied constraints via deletion-based
+        minimization: start from all constraints active and drop any whose
+        removal keeps the remainder unsatisfiable.  Engine-agnostic analog
+        of gini's failed-assumption ``Why`` (lit_mapping.go:198-207); yields
+        the same (unique-minimal) cores the reference tests pin
+        (solve_test.go:111-123,178-197,209-229)."""
+        p = self.p
+        if p.n_cons == 0:
+            return []
+        active = np.ones(p.n_cons, dtype=bool)
+        for j in range(p.n_cons):
+            if not active[j]:
+                continue
+            trial = active.copy()
+            trial[j] = False
+            ok, _ = self._dpll(anchors_assumed=False, act_enabled=trial)
+            if not ok:
+                active = trial
+        return [p.applied[j] for j in range(p.n_cons) if active[j]]
+
+    # ------------------------------------------------------------- budget
+
+    def _count_step(self) -> None:
+        self._steps += 1
+        if self.max_steps is not None and self._steps > self.max_steps:
+            raise Incomplete()

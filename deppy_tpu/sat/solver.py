@@ -1,0 +1,81 @@
+"""Solver facade for single problems.
+
+The analog of the reference's ``sat.NewSolver``/``Solver.Solve``
+(/root/reference/pkg/sat/solve.go:32-34,121-163).  The functional-options
+pattern of the reference maps to plain keyword arguments; backends are
+selected per solve:
+
+  * ``"host"``  — the NumPy reference engine (semantic specification);
+  * ``"tpu"``   — the batched tensor engine on the default JAX backend
+    (one problem = batch of one);
+  * ``"auto"``  — tpu when a JAX accelerator is usable, else host.
+
+Usage::
+
+    from deppy_tpu import sat
+    s = sat.Solver([sat.variable("a", sat.mandatory())])
+    installed = s.solve()          # -> [Variable("a", ...)]
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .constraints import Variable
+from .encode import Problem, encode
+from .errors import InternalSolverError
+from .host import HostEngine
+from .tracer import Tracer
+
+
+class Solver:
+    """Preference-ordered, cardinality-minimized boolean-constraint solver.
+
+    Construction validates input (raising ``DuplicateIdentifier`` like
+    reference lit_mapping.go:49-57); ``solve`` returns the installed
+    variables in input order, raises ``NotSatisfiable`` with a minimal core
+    of applied constraints when no solution exists, or ``Incomplete`` when
+    the step budget is exhausted.
+    """
+
+    def __init__(
+        self,
+        variables: Sequence[Variable],
+        tracer: Optional[Tracer] = None,
+        backend: str = "auto",
+        max_steps: Optional[int] = None,
+    ):
+        self.problem: Problem = encode(variables)
+        self.tracer = tracer
+        self.backend = backend
+        self.max_steps = max_steps
+
+    def solve(self) -> List[Variable]:
+        backend = self.backend
+        if backend == "auto":
+            backend = "tpu" if _engine_usable() else "host"
+        if backend == "host":
+            installed, _ = HostEngine(
+                self.problem, tracer=self.tracer, max_steps=self.max_steps
+            ).solve()
+            return installed
+        if backend == "tpu":
+            from ..engine.driver import solve_one
+
+            return solve_one(self.problem, max_steps=self.max_steps)
+        raise InternalSolverError([f"unknown backend {backend!r}"])
+
+
+def _engine_usable() -> bool:
+    """True when the tensor engine and a JAX backend are both importable.
+    ``auto`` degrades to the host engine rather than failing, so the library
+    stays usable on machines without a working accelerator runtime."""
+    try:
+        import jax
+
+        jax.devices()
+        from ..engine import driver  # noqa: F401
+
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,59 @@
+"""Search observability hooks (reference /root/reference/pkg/sat/tracer.go).
+
+A ``Tracer`` is invoked at every backtrack with the current search position:
+the stack of guessed variables and the constraints implicated in the
+conflict that forced the backtrack (tracer.go:13-15, search.go:172-173).
+"""
+
+from __future__ import annotations
+
+from typing import IO, List, Protocol
+
+from .constraints import AppliedConstraint, Variable
+
+
+class SearchPosition(Protocol):
+    """Snapshot of the search at a backtrack point (tracer.go:8-11)."""
+
+    def variables(self) -> List[Variable]: ...
+
+    def conflicts(self) -> List[AppliedConstraint]: ...
+
+
+class Tracer(Protocol):
+    def trace(self, position: SearchPosition) -> None: ...
+
+
+class DefaultTracer:
+    """No-op tracer (tracer.go:17-20)."""
+
+    def trace(self, position: SearchPosition) -> None:
+        pass
+
+
+class LoggingTracer:
+    """Writes a human-readable transcript of each backtrack
+    (tracer.go:22-35); used by the conformance tests to dump failing
+    searches the same way solve_test.go:352-354 does."""
+
+    def __init__(self, writer: IO[str]):
+        self.writer = writer
+
+    def trace(self, position: SearchPosition) -> None:
+        self.writer.write("---\nAssumptions:\n")
+        for v in position.variables():
+            self.writer.write(f"- {v.identifier}\n")
+        self.writer.write("Conflicts:\n")
+        for c in position.conflicts():
+            self.writer.write(f"- {c}\n")
+
+
+class StatsTracer:
+    """Counts backtracks — the cheap always-on statistics channel the tensor
+    engine also reports (decisions/conflicts/propagation rounds)."""
+
+    def __init__(self) -> None:
+        self.backtracks = 0
+
+    def trace(self, position: SearchPosition) -> None:
+        self.backtracks += 1

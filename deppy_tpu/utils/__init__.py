@@ -1,0 +1,5 @@
+"""Shared utilities: solution verification, timing helpers."""
+
+from .verify import check_solution
+
+__all__ = ["check_solution"]

@@ -1,0 +1,69 @@
+"""Property-based stress tests for the host reference engine.
+
+Random instances in the reference benchmark's distribution
+(bench_test.go:10-64) across many seeds: every SAT answer must satisfy all
+constraints (independent oracle), every UNSAT answer must carry a core that
+is itself unsatisfiable and minimal-ish (removing any single member makes
+it satisfiable).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from deppy_tpu import sat
+from deppy_tpu.models import random_instance
+from deppy_tpu.utils import check_solution
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_random_instance(seed: int):
+    variables = random_instance(length=48, seed=seed)
+    solver = sat.Solver(variables, backend="host")
+    try:
+        installed = solver.solve()
+    except sat.NotSatisfiable as e:
+        # The core itself must be unsatisfiable…
+        core_constraints = e.constraints
+        assert core_constraints, "empty unsat core"
+        assert not _satisfiable_subset(variables, core_constraints)
+        # …and minimal: dropping any one member restores satisfiability.
+        for i in range(len(core_constraints)):
+            subset = core_constraints[:i] + core_constraints[i + 1 :]
+            assert _satisfiable_subset(variables, subset), (
+                f"core not minimal: member {i} removable"
+            )
+        return
+    ids = [v.identifier for v in installed]
+    assert check_solution(variables, ids) == []
+
+
+def _satisfiable_subset(variables, applied) -> bool:
+    """Brute-force check whether the given applied constraints (alone) are
+    jointly satisfiable, using the host engine on a reduced problem that
+    keeps every variable but only the listed constraints."""
+    reduced = []
+    for v in variables:
+        cons = tuple(
+            c for i, c in enumerate(v.constraints) if (v.identifier, i) in _positions(v, applied)
+        )
+        reduced.append(sat.Variable(v.identifier, cons))
+    try:
+        sat.Solver(reduced, backend="host").solve()
+        return True
+    except sat.NotSatisfiable:
+        return False
+
+
+def _con_index(ac) -> int:
+    return next(
+        i for i, c in enumerate(ac.variable.constraints) if c == ac.constraint
+    )
+
+
+def _positions(v, applied):
+    out = set()
+    for ac in applied:
+        if ac.variable.identifier == v.identifier:
+            out.add((v.identifier, _con_index(ac)))
+    return out
