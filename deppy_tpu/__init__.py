@@ -22,6 +22,6 @@ Layers (bottom-up, mirroring SURVEY.md §1):
 
 __version__ = "0.1.0"
 
-from . import sat
+from . import entity, models, resolution, sat, utils
 
-__all__ = ["sat", "__version__"]
+__all__ = ["entity", "models", "resolution", "sat", "utils", "__version__"]

@@ -1,0 +1,20 @@
+"""Constraint-generation API and the resolution facade.
+
+Rebuild of the reference's ``pkg/constraints`` (the plugin point where
+domain logic turns entities into constrained variables,
+constraint_generator.go:11-40) and ``pkg/solver`` (the ``DeppySolver``
+facade producing a ``Solution``, solver.go:16-64) — plus the batch-native
+``BatchResolver`` that resolves many independent problems in one TPU
+dispatch, which is this framework's reason to exist.
+"""
+
+from .generator import ConstraintAggregator, ConstraintGenerator
+from .facade import BatchResolver, Resolver, Solution
+
+__all__ = [
+    "BatchResolver",
+    "ConstraintAggregator",
+    "ConstraintGenerator",
+    "Resolver",
+    "Solution",
+]

@@ -1,0 +1,100 @@
+"""Resolution facade: entities + generators → Solution.
+
+Rebuild of /root/reference/pkg/solver/solver.go.  ``Resolver`` runs the
+pipeline for one problem: aggregate variables from constraint generators,
+solve, and report a ``Solution`` mapping every variable's entity id to
+selected/not-selected (solver.go:36-64 initializes all to False and flips
+the installed ones to True).
+
+``BatchResolver`` is the batch-native extension with no reference
+counterpart: N independent problems (e.g. 10k cluster states over a shared
+catalog) encoded once and dispatched to the TPU engine together.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+from ..entity.entity import EntityID
+from ..entity.source import EntityQuerier
+from ..sat.constraints import Variable
+from ..sat.errors import InternalSolverError, NotSatisfiable
+from ..sat.solver import Solver
+from ..sat.tracer import Tracer
+from .generator import ConstraintAggregator, GeneratorLike
+
+# Solution maps every input entity id to whether it was selected
+# (reference solver.go:12-16).
+Solution = Dict[EntityID, bool]
+
+
+def _to_solution(variables: Sequence[Variable], installed: Sequence[Variable]) -> Solution:
+    """Every input variable appears, installed ones True
+    (reference solver.go:52-62)."""
+    solution: Solution = {v.identifier: False for v in variables}
+    for v in installed:
+        solution[v.identifier] = True
+    return solution
+
+
+class Resolver:
+    """Single-problem resolution facade (reference DeppySolver,
+    solver.go:24-64)."""
+
+    def __init__(
+        self,
+        source: EntityQuerier,
+        *generators: GeneratorLike,
+        backend: str = "auto",
+        tracer: Optional[Tracer] = None,
+    ):
+        self.source = source
+        self.aggregator = ConstraintAggregator(*generators)
+        self.backend = backend
+        self.tracer = tracer
+
+    def solve(self) -> Solution:
+        """Aggregate variables, solve, and build the Solution map.  Raises
+        :class:`NotSatisfiable` (with its minimal constraint core) when
+        resolution is impossible."""
+        variables = self.aggregator.get_variables(self.source)
+        installed = Solver(
+            variables, backend=self.backend, tracer=self.tracer
+        ).solve()
+        return _to_solution(variables, installed)
+
+
+class BatchResolver:
+    """Resolve many independent problems in one device dispatch.
+
+    Each problem is its own variable list (typically: one per cluster state,
+    sharing a catalog's entity source).  Results come back per problem as
+    either a ``Solution`` or the ``NotSatisfiable`` error carrying that
+    problem's minimal constraint core.
+    """
+
+    def __init__(self, backend: str = "auto"):
+        self.backend = backend
+
+    def solve(
+        self, problems: Sequence[Sequence[Variable]]
+    ) -> List[Union[Solution, NotSatisfiable]]:
+        backend = self.backend
+        if backend == "auto":
+            from ..sat.solver import _engine_usable
+
+            backend = "tpu" if _engine_usable() else "host"
+        if backend == "host":
+            out: List[Union[Solution, NotSatisfiable]] = []
+            for variables in problems:
+                try:
+                    installed = Solver(variables, backend="host").solve()
+                    out.append(_to_solution(variables, installed))
+                except NotSatisfiable as e:
+                    out.append(e)
+            return out
+        if backend != "tpu":
+            raise InternalSolverError([f"unknown backend {self.backend!r}"])
+        from ..engine.driver import solve_batch
+
+        return solve_batch(problems)

@@ -1,0 +1,61 @@
+"""Constraint generators: the domain-logic plugin point.
+
+Rebuild of /root/reference/pkg/constraints/constraint_generator.go.  A
+``ConstraintGenerator`` inspects an entity store and emits constrained
+variables (e.g. "every required API group must have exactly one provider").
+The ``ConstraintAggregator`` fans over registered generators and
+concatenates their variables (constraint_generator.go:29-40) — here over a
+thread pool rather than serially, realizing the reference's own
+scatter-gather TODO (constraint_generator.go:30).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Protocol, Sequence, Union, runtime_checkable
+
+from ..entity.source import EntityQuerier
+from ..sat.constraints import Variable
+
+
+@runtime_checkable
+class ConstraintGenerator(Protocol):
+    """Generates solver variables from an entity store
+    (constraint_generator.go:11-13)."""
+
+    def get_variables(self, querier: EntityQuerier) -> Sequence[Variable]: ...
+
+
+# Plain functions are accepted wherever a generator is expected.
+GeneratorLike = Union[ConstraintGenerator, Callable[[EntityQuerier], Sequence[Variable]]]
+
+
+def _call(gen: GeneratorLike, querier: EntityQuerier) -> Sequence[Variable]:
+    if hasattr(gen, "get_variables"):
+        return gen.get_variables(querier)
+    return gen(querier)
+
+
+class ConstraintAggregator:
+    """Aggregates several generators, concatenating their variables in
+    registration order (constraint_generator.go:19-40).  Generators run
+    concurrently; results are joined in order so output is deterministic."""
+
+    def __init__(self, *generators: GeneratorLike, parallel: bool = True):
+        self._generators: List[GeneratorLike] = list(generators)
+        self._parallel = parallel
+
+    def get_variables(self, querier: EntityQuerier) -> List[Variable]:
+        if not self._generators:
+            return []
+        if not self._parallel or len(self._generators) == 1:
+            out: List[Variable] = []
+            for gen in self._generators:
+                out.extend(_call(gen, querier))
+            return out
+        with ThreadPoolExecutor(max_workers=len(self._generators)) as pool:
+            results = list(pool.map(lambda g: _call(g, querier), self._generators))
+        out = []
+        for r in results:
+            out.extend(r)
+        return out

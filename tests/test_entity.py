@@ -1,0 +1,103 @@
+"""Entity-layer tests.
+
+Covers the reference's Ginkgo entity specs (entity_test.go:10-26) and —
+going beyond the reference, whose Group/CacheQuerier/predicates are
+untested (SURVEY.md §4) — the querier, group multiplexing, and predicate
+combinators.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from deppy_tpu.entity import (
+    CacheQuerier,
+    Entity,
+    EntityPropertyNotFoundError,
+    Group,
+    NoContentSource,
+    and_,
+    collect_ids,
+    not_,
+    or_,
+)
+
+
+def test_entity_properties():
+    e = Entity("id", {"prop": "value"})
+    assert e.id == "id"
+    assert e.get_property("prop") == "value"
+
+
+def test_entity_property_not_found():
+    e = Entity("id", {"foo": "value"})
+    with pytest.raises(EntityPropertyNotFoundError) as exc:
+        e.get_property("bar")
+    assert str(exc.value) == "Property '(bar)' Not Found"
+
+
+@pytest.fixture
+def querier() -> CacheQuerier:
+    return CacheQuerier.from_entities(
+        [
+            Entity("a", {"package": "p1", "version": "1.0"}),
+            Entity("b", {"package": "p1", "version": "2.0"}),
+            Entity("c", {"package": "p2", "version": "1.0"}),
+        ]
+    )
+
+
+def test_cache_get(querier):
+    assert querier.get("a").get_property("version") == "1.0"
+    assert querier.get("missing") is None
+
+
+def test_cache_filter(querier):
+    p1 = querier.filter(lambda e: e.get_property("package") == "p1")
+    assert collect_ids(p1) == ["a", "b"]
+
+
+def test_cache_group_by(querier):
+    groups = querier.group_by(lambda e: [e.get_property("package")])
+    assert collect_ids(groups["p1"]) == ["a", "b"]
+    assert collect_ids(groups["p2"]) == ["c"]
+
+
+def test_cache_iterate(querier):
+    assert collect_ids(querier.iterate()) == ["a", "b", "c"]
+
+
+def test_predicates(querier):
+    is_p1 = lambda e: e.get_property("package") == "p1"  # noqa: E731
+    is_v1 = lambda e: e.get_property("version") == "1.0"  # noqa: E731
+    assert collect_ids(querier.filter(and_(is_p1, is_v1))) == ["a"]
+    assert collect_ids(querier.filter(or_(not_(is_p1), is_v1))) == ["a", "c"]
+    assert collect_ids(querier.filter(not_(and_(is_p1, is_v1)))) == ["b", "c"]
+
+
+def test_group_multiplexing(querier):
+    class ContentSource(CacheQuerier):
+        def __init__(self, entities, content):
+            super().__init__({e.id: e for e in entities})
+            self._content = content
+
+        def get_content(self, id):
+            return self._content.get(id)
+
+    s2 = ContentSource([Entity("d", {"package": "p3"})], {"d": b"payload"})
+    g = Group(querier, s2)
+    assert g.get("a").id == "a"
+    assert g.get("d").id == "d"
+    assert g.get("zzz") is None
+    assert collect_ids(g.iterate()) == ["a", "b", "c", "d"]
+    assert collect_ids(g.filter(lambda e: True)) == ["a", "b", "c", "d"]
+    groups = g.group_by(lambda e: [e.get_property("package")])
+    assert set(groups) == {"p1", "p2", "p3"}
+    # First-hit content; sources without get_content are skipped
+    # (fixes the reference's inverted condition, entity_source.go:103-110).
+    assert g.get_content("d") == b"payload"
+    assert g.get_content("a") is None
+
+
+def test_no_content_source():
+    assert NoContentSource().get_content("anything") is None

@@ -1,0 +1,137 @@
+"""Resolution facade tests: entity source + constraint generators →
+Solution map (reference pkg/solver/solver.go:36-64 semantics: every input
+variable appears in the Solution, installed ones True)."""
+
+from __future__ import annotations
+
+import pytest
+
+from deppy_tpu.entity import CacheQuerier, Entity, collect_ids
+from deppy_tpu.resolution import ConstraintAggregator, Resolver
+from deppy_tpu.sat import NotSatisfiable, at_most, dependency, mandatory, variable
+
+
+@pytest.fixture
+def catalog() -> CacheQuerier:
+    return CacheQuerier.from_entities(
+        [
+            Entity("pkgA.v2", {"package": "pkgA", "version": "2.0", "requires": "pkgB"}),
+            Entity("pkgA.v1", {"package": "pkgA", "version": "1.0", "requires": "pkgB"}),
+            Entity("pkgB.v1", {"package": "pkgB", "version": "1.0"}),
+            Entity("pkgC.v1", {"package": "pkgC", "version": "1.0"}),
+        ]
+    )
+
+
+def required_package(name):
+    """Generator: pseudo-variable mandating one version of ``name``,
+    preferring newest — the OLM 'required package' pattern."""
+
+    def gen(querier):
+        versions = querier.filter(lambda e: e.get_property("package") == name)
+        versions.sort(key=lambda e: e.get_property("version"), reverse=True)
+        ids = collect_ids(versions)
+        return [variable(f"required/{name}", mandatory(), dependency(*ids))]
+
+    return gen
+
+
+def bundles_and_deps(querier):
+    """Generator: one variable per bundle; requires-property becomes a
+    Dependency on any version of the required package (newest first)."""
+    out = []
+    for e in querier.iterate():
+        cons = []
+        req = e.properties.get("requires")
+        if req:
+            versions = querier.filter(lambda x: x.get_property("package") == req)
+            versions.sort(key=lambda x: x.get_property("version"), reverse=True)
+            cons.append(dependency(*collect_ids(versions)))
+        out.append(variable(e.id, *cons))
+    return out
+
+
+def version_uniqueness(querier):
+    """Generator: AtMost-1 per package name."""
+    out = []
+    groups = querier.group_by(lambda e: [e.get_property("package")])
+    for pkg in sorted(groups):
+        ids = collect_ids(groups[pkg])
+        out.append(variable(f"unique/{pkg}", at_most(1, *ids)))
+    return out
+
+
+def test_resolver_end_to_end(catalog):
+    solution = Resolver(
+        catalog,
+        required_package("pkgA"),
+        bundles_and_deps,
+        version_uniqueness,
+        backend="host",
+    ).solve()
+    # Newest pkgA version preferred, its dependency pulled in, pkgC untouched.
+    assert solution["pkgA.v2"] is True
+    assert solution["pkgA.v1"] is False
+    assert solution["pkgB.v1"] is True
+    assert solution["pkgC.v1"] is False
+    # Every input variable appears in the solution map (solver.go:52-62).
+    assert solution["required/pkgA"] is True
+    assert "unique/pkgA" in solution
+
+
+def test_resolver_unsat_surfaces_core(catalog):
+    def impossible(querier):
+        return [
+            variable("x", mandatory()),
+            variable("y", mandatory(), at_most(0, "x")),
+        ]
+
+    with pytest.raises(NotSatisfiable) as exc:
+        Resolver(catalog, impossible, backend="host").solve()
+    assert "constraints not satisfiable" in str(exc.value)
+
+
+def test_batch_resolver_host_path():
+    from deppy_tpu.resolution import BatchResolver
+    from deppy_tpu.sat import conflict
+
+    problems = [
+        [variable("a", mandatory())],
+        [
+            variable("b", mandatory(), conflict("b2")),
+            variable("b2", mandatory()),
+        ],
+        [variable("c"), variable("d", mandatory(), dependency("c"))],
+    ]
+    results = BatchResolver(backend="host").solve(problems)
+    assert results[0] == {"a": True}
+    assert isinstance(results[1], NotSatisfiable)
+    assert "b conflicts with b2" in str(results[1])
+    assert results[2] == {"c": True, "d": True}
+
+
+def test_batch_resolver_auto_degrades_without_engine():
+    """'auto' must fall back to host while the tensor engine is unbuilt
+    (and route to it transparently once it exists)."""
+    from deppy_tpu.resolution import BatchResolver
+
+    results = BatchResolver(backend="auto").solve([[variable("a", mandatory())]])
+    assert results == [{"a": True}]
+
+
+def test_batch_resolver_unknown_backend():
+    from deppy_tpu.resolution import BatchResolver
+    from deppy_tpu.sat import InternalSolverError
+
+    with pytest.raises(InternalSolverError):
+        BatchResolver(backend="hsot").solve([[variable("a")]])
+
+
+def test_aggregator_order_and_parallelism(catalog):
+    agg = ConstraintAggregator(
+        lambda q: [variable("g1")],
+        lambda q: [variable("g2a"), variable("g2b")],
+        lambda q: [variable("g3")],
+    )
+    got = [v.identifier for v in agg.get_variables(catalog)]
+    assert got == ["g1", "g2a", "g2b", "g3"]
