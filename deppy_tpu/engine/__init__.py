@@ -1,0 +1,19 @@
+"""Batched tensor solve engine.
+
+The TPU-native replacement for the reference's gini CDCL engine plus search
+driver (/root/reference/pkg/sat/{solve,search}.go): the complete solve
+algorithm — baseline propagation, preference-ordered guess search, DPLL leaf
+solves, extras-only cardinality minimization, and deletion-based unsat-core
+extraction — expressed as fixed-shape tensor programs inside
+``lax.while_loop``/``lax.switch``, vmapped over a batch of independent
+problems and jit-compiled once per padded shape bucket.
+
+Modules:
+  * :mod:`deppy_tpu.engine.core`   — per-problem solve as pure JAX functions;
+  * :mod:`deppy_tpu.engine.driver` — padding/bucketing, batching, jit cache,
+    and host-side decode back to variables / unsat cores.
+"""
+
+from .driver import solve_batch, solve_one
+
+__all__ = ["solve_batch", "solve_one"]

@@ -1,0 +1,549 @@
+"""Per-problem tensor solve: the reference algorithm as pure JAX.
+
+This module re-implements, with dense fixed-shape state, exactly the
+algorithm the host reference engine (:mod:`deppy_tpu.sat.host`) specifies —
+which in turn mirrors /root/reference/pkg/sat/solve.go:53-119 and
+search.go:34-203:
+
+  * :func:`bcp` — boolean-constraint propagation to fixpoint over the padded
+    clause matrix plus native cardinality rows.  One round evaluates every
+    clause simultaneously (a masked gather + reduce, MXU/VPU-friendly) —
+    the dense analog of gini's sequential watched-literal propagation.
+  * :func:`dpll` — complete search under assumptions (the analog of gini
+    ``Solve()``): chronological DPLL on a fixed-size decision stack,
+    deciding the lowest-index unassigned variable false-first.  Instead of
+    snapshotting assignments per level, each iteration re-propagates from
+    the fixed assumptions plus the decision stack — O(stack) extra BCP work
+    for O(V) instead of O(V²) memory, the right trade on HBM.
+  * :func:`search` — the preference-ordered guess search (search.go:34-203):
+    the choice deque and guess stack become fixed-capacity circular-buffer /
+    stack tensors; each loop iteration dispatches one of the four reference
+    loop arms through ``lax.switch``.
+  * :func:`solve_full` — the whole pipeline including extras-only
+    cardinality minimization (solve.go:86-113) and deletion-based
+    unsat-core minimization (the engine-agnostic analog of gini ``Why``,
+    lit_mapping.go:198-207), each gated behind ``lax.cond`` so only the
+    relevant phase runs.
+
+Everything here is shape-static and batchable with ``jax.vmap``; no Python
+control flow depends on traced values.  The batch axis and device-mesh
+sharding live in :mod:`deppy_tpu.engine.driver` and
+:mod:`deppy_tpu.parallel`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Assignment values (same convention as the host engine).
+TRUE = 1
+FALSE = -1
+UNASSIGNED = 0
+
+# Outcomes (reference solve.go:43-47).  RUNNING doubles as UNKNOWN.
+SAT = 1
+UNSAT = -1
+RUNNING = 0
+
+
+class ProblemTensors(NamedTuple):
+    """One lowered problem, padded to the batch's common shapes.
+
+    Produced by :func:`deppy_tpu.engine.driver.pad_problem` from
+    :class:`deppy_tpu.sat.encode.Problem`.  Conventions: clause literals are
+    signed 1-based with 0 padding; every other index tensor is 0-based with
+    -1 padding.  ``n_vars``/``n_cons`` are the problem's true sizes inside
+    the padding.
+    """
+
+    clauses: jax.Array      # i32[C, K]
+    card_ids: jax.Array     # i32[NA, M]
+    card_n: jax.Array       # i32[NA]
+    card_act: jax.Array     # i32[NA]  (-1 on padded rows)
+    anchors: jax.Array      # i32[A]   (-1 padded)
+    choice_cand: jax.Array  # i32[NC, Kc]
+    var_choices: jax.Array  # i32[NV, W]
+    n_vars: jax.Array       # i32 scalar
+    n_cons: jax.Array       # i32 scalar
+
+
+class SolveResult(NamedTuple):
+    outcome: jax.Array     # i32: SAT / UNSAT / RUNNING (= incomplete)
+    installed: jax.Array   # bool[V] (problem-var region)
+    core: jax.Array        # bool[NCON] active applied constraints (UNSAT only)
+    steps: jax.Array       # i32 step counter (tests + DPLL iterations)
+
+
+# --------------------------------------------------------------------------
+# assignment construction
+
+
+def _base_assignment(pt: ProblemTensors, V: int, NCON: int,
+                     act_enabled: jax.Array | None = None) -> jax.Array:
+    """All problem vars unassigned; activation vars true (the analog of
+    ``AssumeConstraints``, reference lit_mapping.go:136-140) unless an
+    explicit ``act_enabled: bool[NCON]`` subset is given (unsat-core mode);
+    padding slots pinned false so they never read as unassigned."""
+    idx = jnp.arange(V, dtype=jnp.int32)
+    in_act = (idx >= pt.n_vars) & (idx < pt.n_vars + pt.n_cons)
+    if act_enabled is None:
+        act_val = jnp.int32(TRUE)
+    else:
+        j = jnp.clip(idx - pt.n_vars, 0, NCON - 1)
+        act_val = jnp.where(act_enabled[j], TRUE, UNASSIGNED).astype(jnp.int32)
+    return jnp.where(
+        idx < pt.n_vars,
+        jnp.int32(UNASSIGNED),
+        jnp.where(in_act, act_val, jnp.int32(FALSE)),
+    )
+
+
+def _apply_anchors(pt: ProblemTensors, assign: jax.Array, V: int) -> jax.Array:
+    """Assume every anchor (Mandatory variable) true (solve.go:67-75)."""
+    tgt = jnp.where(pt.anchors >= 0, pt.anchors, V)
+    return assign.at[tgt].set(TRUE, mode="drop")
+
+
+def _anchor_mask(pt: ProblemTensors, V: int) -> jax.Array:
+    tgt = jnp.where(pt.anchors >= 0, pt.anchors, V)
+    return jnp.zeros(V, bool).at[tgt].set(True, mode="drop")
+
+
+# --------------------------------------------------------------------------
+# BCP
+
+
+def bcp_round(pt: ProblemTensors, assign: jax.Array,
+              min_mask: jax.Array, min_w: jax.Array
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One propagation round: evaluate every clause and cardinality row,
+    derive implied literals, detect conflicts.  Returns
+    (conflict, new_assign, changed).  This is the hot op the Pallas kernel
+    (:mod:`deppy_tpu.engine.pallas_bcp`) specializes."""
+    V = assign.shape[0]
+    cls_mask = pt.clauses != 0
+    cls_var = jnp.where(cls_mask, jnp.abs(pt.clauses) - 1, 0)
+    cls_sign = jnp.sign(pt.clauses)
+    cls_valid = cls_mask.any(axis=1)
+
+    vals = assign[cls_var] * cls_sign
+    vals = jnp.where(cls_mask, vals, jnp.int32(FALSE))
+    satc = (vals == TRUE).any(axis=1)
+    n_un = (vals == UNASSIGNED).sum(axis=1)
+    dead = cls_valid & ~satc & (n_un == 0)
+    unit = cls_valid & ~satc & (n_un == 1)
+    ucol = jnp.argmax(vals == UNASSIGNED, axis=1)
+    uvar = jnp.take_along_axis(cls_var, ucol[:, None], axis=1)[:, 0]
+    usign = jnp.take_along_axis(cls_sign, ucol[:, None], axis=1)[:, 0]
+    wpos = jnp.zeros(V, jnp.int32).at[uvar].max((unit & (usign > 0)).astype(jnp.int32))
+    wneg = jnp.zeros(V, jnp.int32).at[uvar].max((unit & (usign < 0)).astype(jnp.int32))
+
+    # Native cardinality rows (AtMost): count true members; > n is a
+    # conflict, == n forces every unassigned member false — the
+    # arc-consistency equivalent of gini's CardSort network.
+    card_mask = pt.card_ids >= 0
+    card_var = jnp.where(card_mask, pt.card_ids, 0)
+    card_valid = pt.card_act >= 0
+    act_idx = jnp.where(card_valid, pt.card_act, 0)
+    mvals = assign[card_var]
+    trues = ((mvals == TRUE) & card_mask).sum(axis=1)
+    unk = ((mvals == UNASSIGNED) & card_mask).sum(axis=1)
+    active = card_valid & (assign[act_idx] == TRUE)
+    over = active & (trues > pt.card_n)
+    full = active & (trues == pt.card_n) & (unk > 0)
+    force = full[:, None] & card_mask & (mvals == UNASSIGNED)
+    wneg = wneg.at[card_var].max(force.astype(jnp.int32))
+
+    # Dynamic "at most w of the extras" side-constraint used by the
+    # minimization loop (the native replacement for CardinalityConstrainer
+    # + Leq(w), solve.go:100-110).
+    mtrues = ((assign == TRUE) & min_mask).sum()
+    min_over = mtrues > min_w
+    min_force = (mtrues == min_w) & (assign == UNASSIGNED) & min_mask
+    wneg = jnp.maximum(wneg, min_force.astype(jnp.int32))
+
+    conflict = dead.any() | over.any() | min_over | ((wpos == 1) & (wneg == 1)).any()
+    unas = assign == UNASSIGNED
+    new = jnp.where(
+        unas & (wpos == 1),
+        jnp.int32(TRUE),
+        jnp.where(unas & (wneg == 1), jnp.int32(FALSE), assign),
+    )
+    changed = (new != assign).any() & ~conflict
+    return conflict, new, changed
+
+
+def bcp(pt: ProblemTensors, assign: jax.Array,
+        min_mask: jax.Array, min_w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Propagate to fixpoint (the analog of gini ``Test`` propagation;
+    host reference: HostEngine._bcp).  Returns (conflict, assignment)."""
+
+    def cond(state):
+        conflict, _, changed = state
+        return ~conflict & changed
+
+    def body(state):
+        _, a, _ = state
+        return bcp_round(pt, a, min_mask, min_w)
+
+    state = (jnp.bool_(False), assign, jnp.bool_(True))
+    conflict, assign, _ = lax.while_loop(cond, body, state)
+    return conflict, assign
+
+
+# --------------------------------------------------------------------------
+# Test
+
+
+def run_test(pt: ProblemTensors, assumed: jax.Array, V: int, NCON: int
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Propagation-only check of the current assumption set — the analog of
+    gini's ``Test`` (solve.go:79, search.go:76): anchors + activations +
+    guessed variables assumed, then BCP; SAT only when propagation alone
+    totalizes the problem-var region."""
+    a = _base_assignment(pt, V, NCON)
+    a = _apply_anchors(pt, a, V)
+    a = jnp.where(assumed, jnp.int32(TRUE), a)
+    no_min = jnp.zeros(V, bool)
+    conflict, a = bcp(pt, a, no_min, jnp.int32(0))
+    idx = jnp.arange(V, dtype=jnp.int32)
+    all_assigned = ((idx >= pt.n_vars) | (a != UNASSIGNED)).all()
+    outcome = jnp.where(
+        conflict, jnp.int32(UNSAT), jnp.where(all_assigned, jnp.int32(SAT), jnp.int32(RUNNING))
+    )
+    return outcome, a
+
+
+# --------------------------------------------------------------------------
+# DPLL
+
+
+def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
+         min_w: jax.Array, budget: jax.Array, steps: jax.Array, NV: int
+         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Complete search under the fixed partial assignment ``init`` — the
+    analog of gini ``Solve()`` (search.go:168, solve.go:107) and of
+    HostEngine._dpll: false-first decisions on the lowest-index unassigned
+    problem variable, chronological backtracking that flips the deepest
+    unflipped decision.  Each iteration rebuilds the assignment from
+    ``init`` plus the decision stack and re-propagates — fixed-shape state,
+    no snapshot stack.  Returns (status, model, steps)."""
+    V = init.shape[0]
+    idxV = jnp.arange(V, dtype=jnp.int32)
+    lvl = jnp.arange(NV, dtype=jnp.int32)
+
+    def body(st):
+        dec_var, dec_phase, sp, status, model, steps = st
+        live = lvl < sp
+        tgt = jnp.where(live, dec_var, V)
+        a = init.at[tgt].set(jnp.where(live, dec_phase, 0), mode="drop")
+        conflict, a = bcp(pt, a, min_mask, min_w)
+
+        pv_un = (idxV < pt.n_vars) & (a == UNASSIGNED)
+        first_un = jnp.min(jnp.where(pv_un, idxV, V))
+        done_sat = ~conflict & (first_un == V)
+
+        # Deepest decision still on its first (false) phase.
+        cand = live & (dec_phase == FALSE)
+        l = jnp.max(jnp.where(cand, lvl, -1))
+        no_bt = l < 0
+
+        status = jnp.where(
+            conflict,
+            jnp.where(no_bt, jnp.int32(UNSAT), status),
+            jnp.where(done_sat, jnp.int32(SAT), status),
+        )
+        model = jnp.where(done_sat, a, model)
+
+        do_bt = conflict & ~no_bt
+        do_push = ~conflict & ~done_sat
+        dec_phase = dec_phase.at[jnp.where(do_bt, l, NV)].set(TRUE, mode="drop")
+        dec_var = dec_var.at[jnp.where(do_push, sp, NV)].set(first_un, mode="drop")
+        dec_phase = dec_phase.at[jnp.where(do_push, sp, NV)].set(FALSE, mode="drop")
+        sp = jnp.where(do_bt, l + 1, jnp.where(do_push, sp + 1, sp))
+        return dec_var, dec_phase, sp, status, model, steps + 1
+
+    def cond(st):
+        _, _, _, status, _, steps = st
+        return (status == RUNNING) & (steps <= budget)
+
+    st = (
+        jnp.zeros(NV, jnp.int32),
+        jnp.zeros(NV, jnp.int32),
+        jnp.int32(0),
+        jnp.int32(RUNNING),
+        init,
+        steps,
+    )
+    _, _, _, status, model, steps = lax.while_loop(cond, body, st)
+    return status, model, steps
+
+
+# --------------------------------------------------------------------------
+# preference-ordered guess search
+
+
+def search(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
+           V: int, NCON: int, NV: int
+           ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The reference guess search (search.go:158-203; host: _search).
+
+    Fixed-shape translation: the choice deque is a circular buffer of
+    (choice row, candidate index) pairs with capacity NC+1 (each choice row
+    lives in at most one place at a time — deque or guess stack); the guess
+    stack holds (choice, index, var, children).  One loop iteration executes
+    exactly one arm of the reference loop, selected by ``lax.switch`` in the
+    reference's precedence order:
+
+      0. deque empty, outcome unknown  → full DPLL solve  (search.go:167-169)
+      1. outcome unsat                 → backtrack / give up (:172-179)
+      2. deque empty, outcome sat      → done              (:182-184)
+      3. otherwise                     → push next guess   (:187, :34-77)
+
+    Returns (result, guessed_mask, model, steps)."""
+    NC, Kc = pt.choice_cand.shape
+    W = pt.var_choices.shape[1]
+    DQ = NC + 1
+    GS = NC + 1
+    dq_pos = jnp.arange(DQ, dtype=jnp.int32)
+
+    na = (pt.anchors >= 0).sum().astype(jnp.int32)
+    # Anchor choice rows are rows 0..na-1 of the choice table, seeded in
+    # input order (search.go:159-161).
+    dq_c = jnp.where(dq_pos < na, dq_pos, 0)
+    dq_i = jnp.zeros(DQ, jnp.int32)
+
+    State = Tuple  # noqa: N806 - documentation alias
+
+    def arm_leaf(st):
+        """Deque empty & unknown: run the complete solver (search.go:167-169)."""
+        (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
+         result, model, assumed, done, steps) = st
+        init = _base_assignment(pt, V, NCON)
+        init = _apply_anchors(pt, init, V)
+        init = jnp.where(assumed, jnp.int32(TRUE), init)
+        no_min = jnp.zeros(V, bool)
+        status, m, steps = dpll(pt, init, no_min, jnp.int32(0), budget, steps, NV)
+        result = status
+        model = jnp.where(status == SAT, m, model)
+        # Budget exhaustion leaves status RUNNING; the outer cond exits.
+        return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
+                result, model, assumed, done, steps)
+
+    def arm_backtrack(st):
+        """Unsat: pop the last guess, requeue its choice advanced by one
+        candidate, drop its children from the deque's back
+        (PopGuess, search.go:79-98); give up when the stack is empty."""
+        (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
+         result, model, assumed, done, steps) = st
+        give_up = gsp == 0
+
+        gsp2 = gsp - 1
+        gc = g_c[jnp.clip(gsp2, 0)]
+        gi = g_i[jnp.clip(gsp2, 0)]
+        gv = g_v[jnp.clip(gsp2, 0)]
+        gch = g_ch[jnp.clip(gsp2, 0)]
+        cnt2 = cnt - gch                      # children drop off the back
+        head2 = jnp.mod(head - 1, DQ)         # requeue at the front
+        dq_c2 = dq_c.at[head2].set(gc)
+        dq_i2 = dq_i.at[head2].set(gi + (gv >= 0).astype(jnp.int32))
+        cnt2 = cnt2 + 1
+        assumed2 = jnp.where(
+            gv >= 0, assumed.at[jnp.clip(gv, 0)].set(False), assumed
+        )
+        outcome, a = run_test(pt, assumed2, V, NCON)
+        # Only a real (var >= 0) un-guess re-tests; popping a null guess
+        # leaves the unsat outcome standing so popping continues.
+        result2 = jnp.where(gv >= 0, outcome, result)
+        model2 = jnp.where((gv >= 0) & (outcome == SAT), a, model)
+
+        def keep(_):
+            return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
+                    result, model, assumed, jnp.bool_(True), steps)
+
+        def popped(_):
+            return (dq_c2, dq_i2, head2, cnt2, g_c, g_i, g_v, g_ch, gsp2,
+                    result2, model2, assumed2, done, steps + 1)
+
+        return lax.cond(give_up, keep, popped, None)
+
+    def arm_done(st):
+        (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
+         result, model, assumed, done, steps) = st
+        return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
+                result, model, assumed, jnp.bool_(True), steps)
+
+    def arm_push(st):
+        """PushGuess (search.go:34-77): pop the front choice, pick its next
+        candidate (skipped entirely if some candidate is already assumed),
+        enqueue the guessed variable's own dependency choices at the back,
+        assume and re-test."""
+        (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
+         result, model, assumed, done, steps) = st
+        cid = dq_c[head]
+        idx = dq_i[head]
+        head = jnp.mod(head + 1, DQ)
+        cnt = cnt - 1
+
+        cands = pt.choice_cand[cid]                       # i32[Kc]
+        ncand = (cands >= 0).sum()
+        cand_var = cands[jnp.clip(idx, 0, Kc - 1)]
+        var = jnp.where(idx < ncand, cand_var, -1)
+        already = ((cands >= 0) & assumed[jnp.clip(cands, 0)]).any()
+        var = jnp.where(already, jnp.int32(-1), var)
+
+        ch_row = pt.var_choices[jnp.clip(var, 0)]          # i32[W]
+        valid_ch = (var >= 0) & (ch_row >= 0)
+        nch = valid_ch.sum().astype(jnp.int32)
+        offs = jnp.cumsum(valid_ch.astype(jnp.int32)) - valid_ch.astype(jnp.int32)
+        pos = jnp.mod(head + cnt + offs, DQ)
+        tgt = jnp.where(valid_ch, pos, DQ)
+        dq_c = dq_c.at[tgt].set(ch_row, mode="drop")
+        dq_i = dq_i.at[tgt].set(0, mode="drop")
+        cnt = cnt + nch
+
+        g_c = g_c.at[jnp.clip(gsp, 0, GS - 1)].set(cid)
+        g_i = g_i.at[jnp.clip(gsp, 0, GS - 1)].set(idx)
+        g_v = g_v.at[jnp.clip(gsp, 0, GS - 1)].set(var)
+        g_ch = g_ch.at[jnp.clip(gsp, 0, GS - 1)].set(nch)
+        gsp = gsp + 1
+
+        assumed = jnp.where(
+            var >= 0, assumed.at[jnp.clip(var, 0)].set(True), assumed
+        )
+        outcome, a = run_test(pt, assumed, V, NCON)
+        result = jnp.where(var >= 0, outcome, result)
+        model = jnp.where((var >= 0) & (outcome == SAT), a, model)
+        return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
+                result, model, assumed, done, steps + 1)
+
+    def body(st):
+        (_, _, _, cnt, _, _, _, _, _, result, _, _, _, _) = st
+        arm = jnp.where(
+            (cnt == 0) & (result == RUNNING),
+            0,
+            jnp.where(result == UNSAT, 1, jnp.where(cnt == 0, 2, 3)),
+        )
+        return lax.switch(arm, [arm_leaf, arm_backtrack, arm_done, arm_push], st)
+
+    def cond(st):
+        (_, _, _, _, _, _, _, _, _, _, _, _, done, steps) = st
+        return ~done & (steps <= budget)
+
+    st = (
+        dq_c, dq_i, jnp.int32(0), na,
+        jnp.zeros(GS, jnp.int32), jnp.zeros(GS, jnp.int32),
+        jnp.zeros(GS, jnp.int32), jnp.zeros(GS, jnp.int32), jnp.int32(0),
+        jnp.int32(RUNNING), jnp.zeros(V, jnp.int32), jnp.zeros(V, bool),
+        jnp.bool_(False), steps,
+    )
+    st = lax.while_loop(cond, body, st)
+    (_, _, _, _, _, _, _, _, _, result, model, assumed, done, steps) = st
+    result = jnp.where(done, result, jnp.int32(RUNNING))
+    return result, assumed, model, steps
+
+
+# --------------------------------------------------------------------------
+# full pipeline
+
+
+def solve_full(pt: ProblemTensors, budget: jax.Array,
+               *, V: int, NCON: int, NV: int) -> SolveResult:
+    """One problem end to end (host: HostEngine.solve; reference
+    solve.go:53-119): baseline Test, guess search if undetermined,
+    extras-only minimization on SAT, deletion-based core on UNSAT."""
+    idxV = jnp.arange(V, dtype=jnp.int32)
+    pv_mask = idxV < pt.n_vars
+    steps0 = jnp.int32(1)
+    outcome0, a0 = run_test(pt, jnp.zeros(V, bool), V, NCON)
+
+    def do_search(_):
+        return search(pt, budget, steps0, V, NCON, NV)
+
+    def skip_search(_):
+        # Baseline already decided: the anchors play the guess-set role for
+        # minimization (solve.go:77-83).
+        return outcome0, _anchor_mask(pt, V), a0, steps0
+
+    result, guessed, model, steps = lax.cond(
+        outcome0 == RUNNING, do_search, skip_search, None
+    )
+
+    # ---- SAT: extras-only cardinality minimization (solve.go:86-113) ----
+    def minimize(steps):
+        extras = (model == TRUE) & ~guessed & pv_mask
+        excluded = (model != TRUE) & ~guessed & pv_mask
+        init = _base_assignment(pt, V, NCON)
+        init = _apply_anchors(pt, init, V)
+        init = jnp.where(guessed, jnp.int32(TRUE), init)
+        init = jnp.where(excluded, jnp.int32(FALSE), init)
+        n_extras = extras.sum()
+
+        def mcond(c):
+            w, found, _, steps = c
+            return ~found & (w <= n_extras) & (steps <= budget)
+
+        def mbody(c):
+            w, found, m2, steps = c
+            status, m, steps = dpll(pt, init, extras, w, budget, steps, NV)
+            found = status == SAT
+            m2 = jnp.where(found, m, m2)
+            return w + 1, found, m2, steps
+
+        _, found, m2, steps = lax.while_loop(
+            mcond, mbody, (jnp.int32(0), jnp.bool_(False), model, steps)
+        )
+        installed = (m2 == TRUE) & pv_mask & found
+        return installed, found, steps
+
+    def skip_minimize(steps):
+        return jnp.zeros(V, bool), jnp.bool_(False), steps
+
+    installed, min_found, steps = lax.cond(
+        result == SAT, minimize, skip_minimize, steps
+    )
+
+    # ---- UNSAT: deletion-based unsat-core minimization ----
+    # Start from all applied constraints active and drop any whose removal
+    # keeps the remainder unsatisfiable (host: _unsat_core; the analog of
+    # gini's failed-assumption Why, lit_mapping.go:198-207).
+    def core_fn(steps):
+        active = jnp.arange(NCON, dtype=jnp.int32) < pt.n_cons
+
+        def cbody(j, c):
+            active, steps = c
+            trial = active.at[j].set(False)
+            init = _base_assignment(pt, V, NCON, act_enabled=trial)
+            no_min = jnp.zeros(V, bool)
+            status, _, steps = dpll(pt, init, no_min, jnp.int32(0), budget, steps, NV)
+            drop = (j < pt.n_cons) & (status == UNSAT)
+            active = jnp.where(drop, trial, active)
+            return active, steps
+
+        active, steps = lax.fori_loop(0, NCON, cbody, (active, steps))
+        return active, steps
+
+    def skip_core(steps):
+        return jnp.zeros(NCON, bool), steps
+
+    core, steps = lax.cond(result == UNSAT, core_fn, skip_core, steps)
+
+    incomplete = (steps > budget) | (result == RUNNING) | (
+        (result == SAT) & ~min_found
+    )
+    outcome = jnp.where(incomplete, jnp.int32(RUNNING), result)
+    return SolveResult(outcome=outcome, installed=installed, core=core, steps=steps)
+
+
+@functools.lru_cache(maxsize=128)
+def batched_solve(V: int, NCON: int, NV: int):
+    """Jitted, vmapped solve for one padded shape signature.  Cached so each
+    shape bucket compiles exactly once per process (the driver buckets
+    padded dims to powers of two to bound the number of entries)."""
+    fn = functools.partial(solve_full, V=V, NCON=NCON, NV=NV)
+    return jax.jit(jax.vmap(fn, in_axes=(0, None)))

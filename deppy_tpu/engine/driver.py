@@ -1,0 +1,174 @@
+"""Host-side driver: pad, batch, dispatch, decode.
+
+Bridges the symbolic layer (:class:`deppy_tpu.sat.encode.Problem`) and the
+tensor engine (:mod:`deppy_tpu.engine.core`):
+
+  * pads each lowered problem's tensors to the batch's common shapes,
+    bucketing every dimension up to a power of two so the number of
+    distinct compiled programs stays bounded (the padding-economics policy
+    from SURVEY.md §7.3);
+  * stacks problems along a leading batch axis and dispatches one jitted,
+    vmapped solve for the whole batch;
+  * decodes outcome masks back to installed variables, and active-constraint
+    masks back to :class:`NotSatisfiable` unsat cores, exactly like the
+    reference maps lits back through LitMapping
+    (/root/reference/pkg/sat/lit_mapping.go:176-207).
+
+Batch entries behind a padded batch dimension are empty problems (zero
+variables) which solve trivially and are dropped on decode.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..sat.constraints import Variable
+from ..sat.encode import Problem, encode
+from ..sat.errors import Incomplete, InternalSolverError, NotSatisfiable
+from . import core
+
+# Default step budget when the caller sets none: generous enough for any
+# realistic catalog problem, small enough that a pathological instance
+# yields Incomplete rather than an unbounded device loop (the reference
+# quirk of unhonored cancellation — SURVEY.md §3.1 — done better).
+DEFAULT_MAX_STEPS = 1 << 24
+
+
+def _bucket(n: int, minimum: int = 1) -> int:
+    """Round up to the next power of two (≥ minimum)."""
+    n = max(n, minimum)
+    out = 1
+    while out < n:
+        out <<= 1
+    return out
+
+
+def _pad2(a: np.ndarray, rows: int, cols: int, fill: int) -> np.ndarray:
+    out = np.full((rows, cols), fill, dtype=np.int32)
+    r, c = a.shape
+    out[:r, :c] = a
+    return out
+
+
+def _pad1(a: np.ndarray, n: int, fill: int) -> np.ndarray:
+    out = np.full((n,), fill, dtype=np.int32)
+    out[: a.shape[0]] = a
+    return out
+
+
+class _Dims:
+    """Common padded dimensions for a batch of problems."""
+
+    def __init__(self, problems: Sequence[Problem], batch: int):
+        self.C = _bucket(max((p.clauses.shape[0] for p in problems), default=1))
+        self.K = _bucket(max((p.clauses.shape[1] for p in problems), default=1), 2)
+        self.NA = _bucket(max((p.card_ids.shape[0] for p in problems), default=1))
+        self.M = _bucket(max((p.card_ids.shape[1] for p in problems), default=1))
+        self.A = _bucket(max((p.anchors.shape[0] for p in problems), default=1))
+        self.NC = _bucket(max((p.choice_cand.shape[0] for p in problems), default=1))
+        self.Kc = _bucket(max((p.choice_cand.shape[1] for p in problems), default=1))
+        self.NV = _bucket(max((p.n_vars for p in problems), default=1))
+        self.W = _bucket(max((p.var_choices.shape[1] for p in problems), default=1))
+        self.NCON = _bucket(max((p.n_cons for p in problems), default=1))
+        self.V = self.NV + self.NCON
+        self.B = _bucket(batch)
+
+
+def pad_problem(p: Problem, d: _Dims) -> core.ProblemTensors:
+    """Pad one lowered problem to the batch dims (numpy, host-side)."""
+    return core.ProblemTensors(
+        clauses=_pad2(p.clauses, d.C, d.K, 0),
+        card_ids=_pad2(p.card_ids, d.NA, d.M, -1),
+        card_n=_pad1(p.card_n, d.NA, 0),
+        card_act=_pad1(p.card_act, d.NA, -1),
+        anchors=_pad1(p.anchors, d.A, -1),
+        choice_cand=_pad2(p.choice_cand, d.NC, d.Kc, -1),
+        var_choices=_pad2(p.var_choices, d.NV, d.W, -1),
+        n_vars=np.int32(p.n_vars),
+        n_cons=np.int32(p.n_cons),
+    )
+
+
+_EMPTY_PROBLEM: Optional[Problem] = None
+
+
+def _empty_problem() -> Problem:
+    global _EMPTY_PROBLEM
+    if _EMPTY_PROBLEM is None:
+        _EMPTY_PROBLEM = encode([])
+    return _EMPTY_PROBLEM
+
+
+def _stack(pts: Sequence[core.ProblemTensors]) -> core.ProblemTensors:
+    return core.ProblemTensors(
+        *[np.stack([getattr(p, f) for p in pts]) for f in core.ProblemTensors._fields]
+    )
+
+
+def solve_problems(
+    problems: Sequence[Problem], max_steps: Optional[int] = None
+) -> List[core.SolveResult]:
+    """Solve lowered problems as one device batch; per-problem results with
+    host numpy arrays."""
+    for p in problems:
+        if p.errors:
+            raise InternalSolverError(p.errors)
+    n = len(problems)
+    d = _Dims(problems, max(n, 1))
+    padded = list(problems) + [_empty_problem()] * (d.B - n)
+    pts = _stack([pad_problem(p, d) for p in padded])
+    budget = np.int32(min(max_steps if max_steps is not None else DEFAULT_MAX_STEPS,
+                          np.iinfo(np.int32).max - 1))
+    fn = core.batched_solve(d.V, d.NCON, d.NV)
+    res = fn(pts, budget)
+    outcome = np.asarray(res.outcome)
+    installed = np.asarray(res.installed)
+    cores = np.asarray(res.core)
+    steps = np.asarray(res.steps)
+    return [
+        core.SolveResult(outcome[i], installed[i], cores[i], steps[i])
+        for i in range(n)
+    ]
+
+
+def _decode_installed(p: Problem, installed: np.ndarray) -> List[Variable]:
+    return [p.variables[i] for i in range(p.n_vars) if installed[i]]
+
+
+def _decode_core(p: Problem, active: np.ndarray) -> NotSatisfiable:
+    return NotSatisfiable([p.applied[j] for j in range(p.n_cons) if active[j]])
+
+
+def solve_one(problem: Problem, max_steps: Optional[int] = None) -> List[Variable]:
+    """Single-problem entry used by :class:`deppy_tpu.sat.solver.Solver`
+    (batch of one).  Same error contract as the host engine."""
+    (res,) = solve_problems([problem], max_steps=max_steps)
+    if res.outcome == core.SAT:
+        return _decode_installed(problem, res.installed)
+    if res.outcome == core.UNSAT:
+        raise _decode_core(problem, res.core)
+    raise Incomplete()
+
+
+def solve_batch(
+    problem_vars: Sequence[Sequence[Variable]], max_steps: Optional[int] = None
+):
+    """Batch entry used by :class:`deppy_tpu.resolution.facade.BatchResolver`:
+    N independent variable lists → per-problem ``Solution`` dict or the
+    problem's :class:`NotSatisfiable` error."""
+    problems = [encode(vs) for vs in problem_vars]
+    results = solve_problems(problems, max_steps=max_steps)
+    out: List[Union[dict, NotSatisfiable]] = []
+    for p, res in zip(problems, results):
+        if res.outcome == core.SAT:
+            solution = {v.identifier: False for v in p.variables}
+            for v in _decode_installed(p, res.installed):
+                solution[v.identifier] = True
+            out.append(solution)
+        elif res.outcome == core.UNSAT:
+            out.append(_decode_core(p, res.core))
+        else:
+            raise Incomplete()
+    return out

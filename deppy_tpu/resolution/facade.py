@@ -47,11 +47,16 @@ class Resolver:
         *generators: GeneratorLike,
         backend: str = "auto",
         tracer: Optional[Tracer] = None,
+        max_steps: Optional[int] = None,
+        parallel_generators: bool = False,
     ):
         self.source = source
-        self.aggregator = ConstraintAggregator(*generators)
+        self.aggregator = ConstraintAggregator(
+            *generators, parallel=parallel_generators
+        )
         self.backend = backend
         self.tracer = tracer
+        self.max_steps = max_steps
 
     def solve(self) -> Solution:
         """Aggregate variables, solve, and build the Solution map.  Raises
@@ -59,7 +64,10 @@ class Resolver:
         resolution is impossible."""
         variables = self.aggregator.get_variables(self.source)
         installed = Solver(
-            variables, backend=self.backend, tracer=self.tracer
+            variables,
+            backend=self.backend,
+            tracer=self.tracer,
+            max_steps=self.max_steps,
         ).solve()
         return _to_solution(variables, installed)
 
@@ -73,28 +81,27 @@ class BatchResolver:
     problem's minimal constraint core.
     """
 
-    def __init__(self, backend: str = "auto"):
+    def __init__(self, backend: str = "auto", max_steps: Optional[int] = None):
         self.backend = backend
+        self.max_steps = max_steps
 
     def solve(
         self, problems: Sequence[Sequence[Variable]]
     ) -> List[Union[Solution, NotSatisfiable]]:
-        backend = self.backend
-        if backend == "auto":
-            from ..sat.solver import _engine_usable
+        from ..sat.solver import resolve_backend
 
-            backend = "tpu" if _engine_usable() else "host"
+        backend = resolve_backend(self.backend)
         if backend == "host":
             out: List[Union[Solution, NotSatisfiable]] = []
             for variables in problems:
                 try:
-                    installed = Solver(variables, backend="host").solve()
+                    installed = Solver(
+                        variables, backend="host", max_steps=self.max_steps
+                    ).solve()
                     out.append(_to_solution(variables, installed))
                 except NotSatisfiable as e:
                     out.append(e)
             return out
-        if backend != "tpu":
-            raise InternalSolverError([f"unknown backend {self.backend!r}"])
         from ..engine.driver import solve_batch
 
-        return solve_batch(problems)
+        return solve_batch(problems, max_steps=self.max_steps)

@@ -38,10 +38,14 @@ def _call(gen: GeneratorLike, querier: EntityQuerier) -> Sequence[Variable]:
 
 class ConstraintAggregator:
     """Aggregates several generators, concatenating their variables in
-    registration order (constraint_generator.go:19-40).  Generators run
-    concurrently; results are joined in order so output is deterministic."""
+    registration order (constraint_generator.go:19-40).  With
+    ``parallel=True`` generators run over a thread pool — the reference's
+    own scatter-gather TODO (constraint_generator.go:30) — joined in
+    registration order so output stays deterministic; the default is the
+    reference's serial behavior, safe for queriers that aren't thread-safe.
+    """
 
-    def __init__(self, *generators: GeneratorLike, parallel: bool = True):
+    def __init__(self, *generators: GeneratorLike, parallel: bool = False):
         self._generators: List[GeneratorLike] = list(generators)
         self._parallel = parallel
 

@@ -51,19 +51,26 @@ class Solver:
         self.max_steps = max_steps
 
     def solve(self) -> List[Variable]:
-        backend = self.backend
-        if backend == "auto":
-            backend = "tpu" if _engine_usable() else "host"
+        backend = resolve_backend(self.backend)
         if backend == "host":
             installed, _ = HostEngine(
                 self.problem, tracer=self.tracer, max_steps=self.max_steps
             ).solve()
             return installed
-        if backend == "tpu":
-            from ..engine.driver import solve_one
+        from ..engine.driver import solve_one
 
-            return solve_one(self.problem, max_steps=self.max_steps)
-        raise InternalSolverError([f"unknown backend {backend!r}"])
+        return solve_one(self.problem, max_steps=self.max_steps)
+
+
+def resolve_backend(backend: str) -> str:
+    """Resolve a backend name to ``"host"`` or ``"tpu"``: the single place
+    the ``auto`` policy lives (shared by :class:`Solver` and the resolution
+    facade).  Raises on unknown names."""
+    if backend == "auto":
+        return "tpu" if _engine_usable() else "host"
+    if backend in ("host", "tpu"):
+        return backend
+    raise InternalSolverError([f"unknown backend {backend!r}"])
 
 
 def _engine_usable() -> bool:
