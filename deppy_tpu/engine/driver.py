@@ -61,7 +61,7 @@ def _pad1(a: np.ndarray, n: int, fill: int) -> np.ndarray:
 class _Dims:
     """Common padded dimensions for a batch of problems."""
 
-    def __init__(self, problems: Sequence[Problem], batch: int):
+    def __init__(self, problems: Sequence[Problem], batch: int, batch_multiple: int = 1):
         self.C = _bucket(max((p.clauses.shape[0] for p in problems), default=1))
         self.K = _bucket(max((p.clauses.shape[1] for p in problems), default=1), 2)
         self.NA = _bucket(max((p.card_ids.shape[0] for p in problems), default=1))
@@ -73,7 +73,12 @@ class _Dims:
         self.W = _bucket(max((p.var_choices.shape[1] for p in problems), default=1))
         self.NCON = _bucket(max((p.n_cons for p in problems), default=1))
         self.V = self.NV + self.NCON
-        self.B = _bucket(batch)
+        # Batch padded to a power of two AND a multiple of the mesh size so
+        # the batch axis shards evenly.
+        b = _bucket(batch)
+        if b % batch_multiple:
+            b *= batch_multiple // np.gcd(b, batch_multiple)
+        self.B = b
 
 
 def pad_problem(p: Problem, d: _Dims) -> core.ProblemTensors:
@@ -108,17 +113,25 @@ def _stack(pts: Sequence[core.ProblemTensors]) -> core.ProblemTensors:
 
 
 def solve_problems(
-    problems: Sequence[Problem], max_steps: Optional[int] = None
+    problems: Sequence[Problem],
+    max_steps: Optional[int] = None,
+    mesh=None,
 ) -> List[core.SolveResult]:
     """Solve lowered problems as one device batch; per-problem results with
-    host numpy arrays."""
+    host numpy arrays.  With ``mesh`` (a 1-D ``jax.sharding.Mesh`` from
+    :mod:`deppy_tpu.parallel`), the batch axis is sharded over the mesh's
+    devices and XLA partitions the solve — the fleet-scale path."""
     for p in problems:
         if p.errors:
             raise InternalSolverError(p.errors)
     n = len(problems)
-    d = _Dims(problems, max(n, 1))
+    d = _Dims(problems, max(n, 1), batch_multiple=mesh.size if mesh is not None else 1)
     padded = list(problems) + [_empty_problem()] * (d.B - n)
     pts = _stack([pad_problem(p, d) for p in padded])
+    if mesh is not None:
+        from ..parallel.mesh import shard_batch
+
+        pts = shard_batch(mesh, pts)
     budget = np.int32(min(max_steps if max_steps is not None else DEFAULT_MAX_STEPS,
                           np.iinfo(np.int32).max - 1))
     fn = core.batched_solve(d.V, d.NCON, d.NV)
@@ -153,13 +166,15 @@ def solve_one(problem: Problem, max_steps: Optional[int] = None) -> List[Variabl
 
 
 def solve_batch(
-    problem_vars: Sequence[Sequence[Variable]], max_steps: Optional[int] = None
+    problem_vars: Sequence[Sequence[Variable]],
+    max_steps: Optional[int] = None,
+    mesh=None,
 ):
     """Batch entry used by :class:`deppy_tpu.resolution.facade.BatchResolver`:
     N independent variable lists → per-problem ``Solution`` dict or the
     problem's :class:`NotSatisfiable` error."""
     problems = [encode(vs) for vs in problem_vars]
-    results = solve_problems(problems, max_steps=max_steps)
+    results = solve_problems(problems, max_steps=max_steps, mesh=mesh)
     out: List[Union[dict, NotSatisfiable]] = []
     for p, res in zip(problems, results):
         if res.outcome == core.SAT:

@@ -1,0 +1,16 @@
+"""Device-mesh scale-out for batched resolution.
+
+The reference has no distributed runtime at all (SURVEY.md §2.7) — its only
+concurrency is two TODO comments and controller leader election, which
+serializes work.  This package is therefore new, tpu-first design: the batch
+axis of independent resolution problems is sharded over a
+``jax.sharding.Mesh`` with ``NamedSharding``; XLA partitions the vmapped
+solve with zero steady-state cross-device traffic (problems are independent
+— the only collective is the implicit final gather of outcome tensors back
+to host).  The same code scales to multi-host DCN fleets via
+``jax.distributed`` initialization.
+"""
+
+from .mesh import BATCH_AXIS, default_mesh, initialize_distributed, shard_batch
+
+__all__ = ["BATCH_AXIS", "default_mesh", "initialize_distributed", "shard_batch"]

@@ -1,0 +1,61 @@
+"""Mesh construction and batch-axis sharding.
+
+The communication design (SURVEY.md §2.7, §5): one 1-D logical axis,
+``"batch"``, laid over all available devices (ICI within a host/slice, DCN
+across hosts).  Each device solves its shard of the problem batch in
+lockstep; no collectives are needed during the solve because problems are
+independent — an all-gather of the small outcome/selection tensors happens
+implicitly when results are fetched.  This replaces, tpu-natively, what a
+NCCL/MPI backend would be in a GPU framework: the mesh axes + shardings ARE
+the communication topology, and XLA inserts the transfers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+BATCH_AXIS = "batch"
+
+
+def default_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1-D mesh over ``devices`` (default: all local devices) with the
+    single ``"batch"`` axis used by the batched solver."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), (BATCH_AXIS,))
+
+
+def batch_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard a rank-``ndim`` array's leading (batch) axis over the mesh;
+    all trailing axes replicated per shard."""
+    return NamedSharding(mesh, PartitionSpec(BATCH_AXIS, *([None] * (ndim - 1))))
+
+
+def shard_batch(mesh: Mesh, tree):
+    """Device-put every leaf of a stacked problem pytree with its batch axis
+    sharded over the mesh.  Scalars-per-problem (rank-1 leaves) shard too;
+    the batch size must divide evenly (the driver pads to a multiple of the
+    mesh size)."""
+    def put(leaf):
+        arr = np.asarray(leaf)
+        return jax.device_put(arr, batch_sharding(mesh, arr.ndim))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def initialize_distributed(**kwargs) -> None:
+    """Multi-host entry: initialize the JAX distributed runtime so
+    ``jax.devices()`` spans the fleet and ``default_mesh()`` lays the batch
+    axis over ICI + DCN.  Thin passthrough to ``jax.distributed.initialize``
+    (coordinator_address / num_processes / process_id kwargs); call once per
+    process before building a mesh.  On a single host it is a no-op
+    convenience so launch scripts can call it unconditionally."""
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (ValueError, RuntimeError):
+        if kwargs:
+            raise
+        # Single-process default: nothing to initialize.

@@ -81,9 +81,15 @@ class BatchResolver:
     problem's minimal constraint core.
     """
 
-    def __init__(self, backend: str = "auto", max_steps: Optional[int] = None):
+    def __init__(
+        self,
+        backend: str = "auto",
+        max_steps: Optional[int] = None,
+        mesh=None,
+    ):
         self.backend = backend
         self.max_steps = max_steps
+        self.mesh = mesh  # jax.sharding.Mesh from deppy_tpu.parallel
 
     def solve(
         self, problems: Sequence[Sequence[Variable]]
@@ -104,4 +110,4 @@ class BatchResolver:
             return out
         from ..engine.driver import solve_batch
 
-        return solve_batch(problems, max_steps=self.max_steps)
+        return solve_batch(problems, max_steps=self.max_steps, mesh=self.mesh)

@@ -7,9 +7,17 @@ are exercised without TPU hardware, per the multi-chip test strategy
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the 8-device CPU platform.  Env vars alone are not enough on
+# machines whose sitecustomize imports jax at interpreter startup (this one
+# registers a TPU PJRT plugin that way), so set XLA_FLAGS for the lazily
+# created CPU client and then override the platform through jax.config.
+# Set DEPPY_TEST_PLATFORM to run the suite on real hardware instead.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ.get("DEPPY_TEST_PLATFORM", "cpu"))
