@@ -34,6 +34,7 @@ sharding live in :mod:`deppy_tpu.engine.driver` and
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Tuple
 
 import jax
@@ -49,6 +50,12 @@ UNASSIGNED = 0
 SAT = 1
 UNSAT = -1
 RUNNING = 0
+
+# Bits per bitplane word.  Bitplanes encode clause/assignment sets as packed
+# int32 words (logical-shift arithmetic throughout — Mosaic has no unsigned
+# reductions), turning BCP's per-literal gather into dense bitwise algebra:
+# the TPU-native formulation of watched-literal propagation.
+WORD = 32
 
 
 class ProblemTensors(NamedTuple):
@@ -70,6 +77,13 @@ class ProblemTensors(NamedTuple):
     var_choices: jax.Array  # i32[NV, W]
     n_vars: jax.Array       # i32 scalar
     n_cons: jax.Array       # i32 scalar
+    # Bitplane mirrors of the clause matrix and cardinality rows (packed
+    # int32, Wv = ceil(V/32) words): the "bits"/"pallas" BCP paths evaluate
+    # every clause with bitwise algebra instead of a [C, K] gather.
+    pos_bits: jax.Array         # i32[C, Wv]  positive-literal membership
+    neg_bits: jax.Array         # i32[C, Wv]  negative-literal membership
+    card_member_bits: jax.Array  # i32[NA, Wv] AtMost member sets
+    card_act_bits: jax.Array    # i32[NA, Wv] one-hot activation var (0 = pad)
 
 
 class SolveResult(NamedTuple):
@@ -112,6 +126,103 @@ def _apply_anchors(pt: ProblemTensors, assign: jax.Array, V: int) -> jax.Array:
 def _anchor_mask(pt: ProblemTensors, V: int) -> jax.Array:
     tgt = jnp.where(pt.anchors >= 0, pt.anchors, V)
     return jnp.zeros(V, bool).at[tgt].set(True, mode="drop")
+
+
+# --------------------------------------------------------------------------
+# bitplane algebra (shared by the jnp "bits" path and the Pallas kernel)
+
+
+def _srl(x: jax.Array, n) -> jax.Array:
+    """Logical right shift on int32 (sign bit is data, not sign)."""
+    return lax.shift_right_logical(x, n)
+
+
+def popcount32(v: jax.Array) -> jax.Array:
+    """Per-word SWAR popcount on int32 bitplanes (no unsigned types:
+    Mosaic cannot reduce unsigned ints; logical shifts keep this exact)."""
+    v = v - (_srl(v, 1) & 0x55555555)
+    v = (v & 0x33333333) + (_srl(v, 2) & 0x33333333)
+    v = (v + _srl(v, 4)) & 0x0F0F0F0F
+    return (v + _srl(v, 8) + _srl(v, 16) + _srl(v, 24)) & 0x3F
+
+
+def or_reduce_rows(x: jax.Array) -> jax.Array:
+    """Bitwise-OR reduce over axis 0 → shape [1, ...].  Static halving tree
+    (works inside Pallas kernels, where ufunc or-reductions don't lower);
+    rows are zero-padded to a power of two first."""
+    n = x.shape[0]
+    p = 1
+    while p < n:
+        p <<= 1
+    if p != n:
+        x = jnp.concatenate(
+            [x, jnp.zeros((p - n,) + x.shape[1:], x.dtype)], axis=0
+        )
+    while p > 1:
+        x = x[: p // 2] | x[p // 2 :]
+        p //= 2
+    return x
+
+
+def pack_mask(mask: jax.Array, Wv: int) -> jax.Array:
+    """bool[V] → packed i32[1, Wv] bitplane.  Distinct bit positions make the
+    int32 sum carry-free, i.e. an OR."""
+    V = mask.shape[0]
+    pad = Wv * WORD - V
+    m = mask
+    if pad:
+        m = jnp.concatenate([m, jnp.zeros(pad, bool)])
+    m = m.reshape(Wv, WORD).astype(jnp.int32)
+    shifts = jnp.arange(WORD, dtype=jnp.int32)[None, :]
+    return (m << shifts).sum(axis=1, dtype=jnp.int32)[None, :]
+
+
+def unpack_mask(words: jax.Array, V: int) -> jax.Array:
+    """packed i32[1, Wv] → bool[V]."""
+    shifts = jnp.arange(WORD, dtype=jnp.int32)[None, :]
+    bits = (_srl(words.reshape(-1, 1), shifts) & 1).astype(bool)
+    return bits.reshape(-1)[:V]
+
+
+def round_planes(pos, neg, mem, act, card_n2, min_bits, min_w, t, f):
+    """One propagation round on bitplanes — the exact bitwise translation of
+    :func:`bcp_round` (itself the dense analog of gini's watched-literal BCP).
+    Shapes: pos/neg i32[C, Wv]; mem/act i32[NA, Wv]; card_n2 i32[NA, 1];
+    min_bits/t/f i32[1, Wv]; min_w i32 scalar.  Returns
+    (conflict, new_t, new_f, changed).  Runs unchanged under jit and inside
+    the Pallas kernel (:mod:`deppy_tpu.engine.pallas_bcp`)."""
+    a = t | f
+    sat = (((pos & t) | (neg & f)) != 0).any(axis=1, keepdims=True)   # [C,1]
+    upos = pos & ~a
+    uneg = neg & ~a
+    n_un = popcount32(upos).sum(axis=1, keepdims=True) + popcount32(uneg).sum(
+        axis=1, keepdims=True
+    )                                                                  # [C,1]
+    valid = ((pos | neg) != 0).any(axis=1, keepdims=True)
+    dead = valid & ~sat & (n_un == 0)
+    unit = valid & ~sat & (n_un == 1)
+    wpos = or_reduce_rows(jnp.where(unit, upos, 0))                    # [1,Wv]
+    wneg = or_reduce_rows(jnp.where(unit, uneg, 0))
+
+    # AtMost rows: active iff the activation bit is set true; count true /
+    # unassigned members; > n conflicts, == n forces the rest false.
+    active = ((act & t) != 0).any(axis=1, keepdims=True)               # [NA,1]
+    trues = popcount32(mem & t).sum(axis=1, keepdims=True)
+    unk = popcount32(mem & ~a).sum(axis=1, keepdims=True)
+    over = active & (trues > card_n2)
+    full = active & (trues == card_n2) & (unk > 0)
+    wneg = wneg | or_reduce_rows(jnp.where(full, mem & ~a, 0))
+
+    # Dynamic "at most w of the extras" bound for the minimization loop.
+    mtrues = popcount32(min_bits & t).sum()
+    min_over = mtrues > min_w
+    wneg = jnp.where(mtrues == min_w, wneg | (min_bits & ~a), wneg)
+
+    conflict = dead.any() | over.any() | min_over | ((wpos & wneg) != 0).any()
+    new_t = t | (wpos & ~a)
+    new_f = f | (wneg & ~a)
+    changed = ((new_t != t) | (new_f != f)).any() & ~conflict
+    return conflict, new_t, new_f, changed
 
 
 # --------------------------------------------------------------------------
@@ -178,11 +289,37 @@ def bcp_round(pt: ProblemTensors, assign: jax.Array,
     return conflict, new, changed
 
 
-def bcp(pt: ProblemTensors, assign: jax.Array,
-        min_mask: jax.Array, min_w: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Propagate to fixpoint (the analog of gini ``Test`` propagation;
-    host reference: HostEngine._bcp).  Returns (conflict, assignment)."""
+# BCP implementation selection: "gather" = the [C, K] literal-gather round
+# above; "bits" = jnp bitplane algebra; "pallas" = the fused fixpoint kernel
+# holding the planes in VMEM across rounds.  "auto" = "bits": measured on a
+# real v5-lite chip (256-problem random-catalog batch), bits is 18.7× faster
+# than gather (368/s vs 19.7/s) and 1.8× faster than the Pallas kernel —
+# under vmap, XLA vectorizes the batch axis of the bitplane algebra across
+# VPU lanes, while a vmapped pallas_call serializes problems into grid
+# steps.  The kernel pays off only for single very large problems (clause
+# planes near VMEM capacity), so it stays opt-in.
+_BCP_IMPL = os.environ.get("DEPPY_TPU_BCP", "auto")
 
+
+def set_bcp_impl(name: str) -> None:
+    """Select the BCP implementation ('auto'|'gather'|'bits'|'pallas') and
+    invalidate compiled solves."""
+    global _BCP_IMPL
+    if name not in ("auto", "gather", "bits", "pallas"):
+        raise ValueError(f"unknown BCP impl {name!r}")
+    _BCP_IMPL = name
+    batched_solve.cache_clear()
+
+
+def _resolved_impl() -> str:
+    if _BCP_IMPL == "auto":
+        return "bits"
+    return _BCP_IMPL
+
+
+def _bcp_gather(pt: ProblemTensors, assign: jax.Array,
+                min_mask: jax.Array, min_w: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
     def cond(state):
         conflict, _, changed = state
         return ~conflict & changed
@@ -194,6 +331,56 @@ def bcp(pt: ProblemTensors, assign: jax.Array,
     state = (jnp.bool_(False), assign, jnp.bool_(True))
     conflict, assign, _ = lax.while_loop(cond, body, state)
     return conflict, assign
+
+
+def _bcp_planes(pt: ProblemTensors, assign: jax.Array,
+                min_mask: jax.Array, min_w: jax.Array, use_pallas: bool
+                ) -> Tuple[jax.Array, jax.Array]:
+    V = assign.shape[0]
+    Wv = pt.pos_bits.shape[1]
+    t = pack_mask(assign == TRUE, Wv)
+    f = pack_mask(assign == FALSE, Wv)
+    min_bits = pack_mask(min_mask, Wv)
+    card_n2 = pt.card_n[:, None]
+    if use_pallas:
+        from . import pallas_bcp
+
+        conflict, t, f = pallas_bcp.bcp_fixpoint(
+            pt.pos_bits, pt.neg_bits, pt.card_member_bits, pt.card_act_bits,
+            card_n2, min_bits, min_w, t, f,
+        )
+    else:
+        def cond(state):
+            conflict, _, _, changed = state
+            return ~conflict & changed
+
+        def body(state):
+            _, t, f, _ = state
+            return round_planes(
+                pt.pos_bits, pt.neg_bits, pt.card_member_bits,
+                pt.card_act_bits, card_n2, min_bits, min_w, t, f,
+            )
+
+        state = (jnp.bool_(False), t, f, jnp.bool_(True))
+        conflict, t, f, _ = lax.while_loop(cond, body, state)
+    tb = unpack_mask(t, V)
+    fb = unpack_mask(f, V)
+    new_assign = jnp.where(
+        tb, jnp.int32(TRUE), jnp.where(fb, jnp.int32(FALSE), jnp.int32(UNASSIGNED))
+    )
+    return conflict, new_assign
+
+
+def bcp(pt: ProblemTensors, assign: jax.Array,
+        min_mask: jax.Array, min_w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Propagate to fixpoint (the analog of gini ``Test`` propagation;
+    host reference: HostEngine._bcp).  Returns (conflict, assignment).
+    Dispatches to the implementation chosen by :func:`set_bcp_impl` /
+    ``DEPPY_TPU_BCP``."""
+    impl = _resolved_impl()
+    if impl == "gather":
+        return _bcp_gather(pt, assign, min_mask, min_w)
+    return _bcp_planes(pt, assign, min_mask, min_w, use_pallas=impl == "pallas")
 
 
 # --------------------------------------------------------------------------

@@ -73,6 +73,7 @@ class _Dims:
         self.W = _bucket(max((p.var_choices.shape[1] for p in problems), default=1))
         self.NCON = _bucket(max((p.n_cons for p in problems), default=1))
         self.V = self.NV + self.NCON
+        self.Wv = -(-self.V // core.WORD)  # bitplane words per variable set
         # Batch padded to a power of two AND a multiple of the mesh size so
         # the batch axis shards evenly.
         b = _bucket(batch)
@@ -81,18 +82,49 @@ class _Dims:
         self.B = b
 
 
+def _pack_planes(clauses: np.ndarray, Wv: int) -> tuple:
+    """Signed clause matrix → (pos, neg) packed int32 bitplanes."""
+    C = clauses.shape[0]
+    W = core.WORD
+    pos = np.zeros((C, Wv), np.uint32)
+    neg = np.zeros((C, Wv), np.uint32)
+    for plane, mask in ((pos, clauses > 0), (neg, clauses < 0)):
+        r, c = np.nonzero(mask)
+        v = np.abs(clauses[r, c]).astype(np.int64) - 1
+        np.bitwise_or.at(plane, (r, v // W), np.uint32(1) << np.uint32(v % W))
+    return pos.view(np.int32), neg.view(np.int32)
+
+
+def _pack_index_rows(rows: np.ndarray, Wv: int) -> np.ndarray:
+    """0-based index matrix (-1 pad) → packed int32 membership bitplanes."""
+    W = core.WORD
+    out = np.zeros((rows.shape[0], Wv), np.uint32)
+    r, c = np.nonzero(rows >= 0)
+    v = rows[r, c].astype(np.int64)
+    np.bitwise_or.at(out, (r, v // W), np.uint32(1) << np.uint32(v % W))
+    return out.view(np.int32)
+
+
 def pad_problem(p: Problem, d: _Dims) -> core.ProblemTensors:
     """Pad one lowered problem to the batch dims (numpy, host-side)."""
+    clauses = _pad2(p.clauses, d.C, d.K, 0)
+    card_ids = _pad2(p.card_ids, d.NA, d.M, -1)
+    card_act = _pad1(p.card_act, d.NA, -1)
+    pos_bits, neg_bits = _pack_planes(clauses, d.Wv)
     return core.ProblemTensors(
-        clauses=_pad2(p.clauses, d.C, d.K, 0),
-        card_ids=_pad2(p.card_ids, d.NA, d.M, -1),
+        clauses=clauses,
+        card_ids=card_ids,
         card_n=_pad1(p.card_n, d.NA, 0),
-        card_act=_pad1(p.card_act, d.NA, -1),
+        card_act=card_act,
         anchors=_pad1(p.anchors, d.A, -1),
         choice_cand=_pad2(p.choice_cand, d.NC, d.Kc, -1),
         var_choices=_pad2(p.var_choices, d.NV, d.W, -1),
         n_vars=np.int32(p.n_vars),
         n_cons=np.int32(p.n_cons),
+        pos_bits=pos_bits,
+        neg_bits=neg_bits,
+        card_member_bits=_pack_index_rows(card_ids, d.Wv),
+        card_act_bits=_pack_index_rows(card_act[:, None], d.Wv),
     )
 
 

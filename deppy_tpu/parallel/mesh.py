@@ -51,11 +51,19 @@ def initialize_distributed(**kwargs) -> None:
     ``jax.devices()`` spans the fleet and ``default_mesh()`` lays the batch
     axis over ICI + DCN.  Thin passthrough to ``jax.distributed.initialize``
     (coordinator_address / num_processes / process_id kwargs); call once per
-    process before building a mesh.  On a single host it is a no-op
-    convenience so launch scripts can call it unconditionally."""
-    try:
-        jax.distributed.initialize(**kwargs)
-    except (ValueError, RuntimeError):
-        if kwargs:
-            raise
-        # Single-process default: nothing to initialize.
+    process before building a mesh.  On a single host with no cluster
+    environment it is a no-op convenience so launch scripts can call it
+    unconditionally; when a cluster IS configured (kwargs given or a
+    recognized cluster environment), failures propagate — silently falling
+    back to single-host there would make every host redundantly solve the
+    full batch."""
+    if not kwargs:
+        try:
+            from jax._src.clusters import ClusterEnv
+
+            detected = any(c.is_env_present() for c in ClusterEnv._cluster_types)
+        except Exception:  # private API moved: assume plain single-host
+            detected = False
+        if not detected:
+            return  # plain single-process launch: nothing to initialize
+    jax.distributed.initialize(**kwargs)

@@ -105,6 +105,12 @@ class AtMost:
     (gini ``logic.CardSort``); here it lowers to a native cardinality row
     propagated directly by the tensor engine (see encode.py), which avoids
     the pointer-heavy network entirely.
+
+    Deliberate divergence for degenerate input: duplicate ``ids`` are
+    counted once ("at most n *distinct* members"), whereas gini's CardSort
+    counts occurrences.  Set semantics keeps every engine path (host,
+    gather, bitplane) in exact agreement; no reference behavior or test
+    depends on multiset counting.
     """
 
     n: int

@@ -166,10 +166,15 @@ def encode(variables: Sequence[Variable]) -> Problem:
                 # disjunction as an Or-gate fold (constraints.go:117-123).  An
                 # empty Dependency degenerates to (¬act ∨ ¬subject): the
                 # subject cannot be installed (constraints.go:107-108).
+                # Duplicate target literals are dropped (x ∨ x ≡ x) so the
+                # per-occurrence and per-variable (bitplane) propagation
+                # counts agree on every clause.
                 row = [-(act + 1), -(subj + 1)]
+                seen_lits = set(row)
                 for ident in con.ids:
                     t = lookup(ident)
-                    if t >= 0:
+                    if t >= 0 and (t + 1) not in seen_lits:
+                        seen_lits.add(t + 1)
                         row.append(t + 1)
                 clause_rows.append(row)
                 clause_con.append(j)
@@ -179,15 +184,23 @@ def encode(variables: Sequence[Variable]) -> Problem:
                     dep_choice_rows.append([lit - 1 for lit in row[2:]])
                     var_dep_choices[i].append(cid)
             elif isinstance(con, Conflict):
+                # Self-conflict (id == subject) degenerates to ¬subject;
+                # dedup keeps the per-occurrence and bitplane counts equal.
                 t = lookup(con.id)
                 row = [-(act + 1), -(subj + 1)]
-                if t >= 0:
+                if t >= 0 and -(t + 1) not in row:
                     row.append(-(t + 1))
                 clause_rows.append(row)
                 clause_con.append(j)
             elif isinstance(con, AtMost):
-                members = [lookup(ident) for ident in con.ids]
-                card_rows.append([m for m in members if m >= 0])
+                # Dedup members: bitplane cardinality rows count each
+                # variable once, so the dense row must as well.
+                members = []
+                for ident in con.ids:
+                    m = lookup(ident)
+                    if m >= 0 and m not in members:
+                        members.append(m)
+                card_rows.append(members)
                 card_n.append(con.n)
                 card_act.append(act)
                 card_con.append(j)

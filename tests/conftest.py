@@ -18,6 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
+try:
+    import jax  # noqa: E402
+except ImportError:  # jax-less install: importorskip guards handle the rest
+    jax = None
 
-jax.config.update("jax_platforms", os.environ.get("DEPPY_TEST_PLATFORM", "cpu"))
+if jax is not None:
+    jax.config.update(
+        "jax_platforms", os.environ.get("DEPPY_TEST_PLATFORM", "cpu")
+    )

@@ -1,0 +1,221 @@
+"""BCP implementation equivalence: gather vs bits vs pallas.
+
+Kernel-level tests on hand-built clause tensors plus randomized
+differential checks, per the rebuild test plan (SURVEY.md §4 item 4).  The
+gather path is the executable spec (it mirrors the host engine's
+per-occurrence counting); the bitplane paths must reach the same fixpoints,
+conflicts, and full-solve outcomes.
+"""
+
+import numpy as np
+import pytest
+
+from deppy_tpu.engine import core, driver
+from deppy_tpu.models import random_instance
+from deppy_tpu.sat import at_most, conflict, dependency, mandatory, variable
+from deppy_tpu.sat.encode import encode
+
+IMPLS = ["gather", "bits", "pallas"]
+
+
+@pytest.fixture(autouse=True)
+def _restore_impl():
+    yield
+    core.set_bcp_impl("auto")
+
+
+def _tensors(variables):
+    p = encode(variables)
+    d = driver._Dims([p], 1)
+    return p, driver.pad_problem(p, d), d
+
+
+def _bcp(pt, d, assign, impl, min_mask=None, min_w=0):
+    import jax.numpy as jnp
+
+    core.set_bcp_impl(impl)
+    mm = (
+        jnp.zeros(d.V, bool)
+        if min_mask is None
+        else jnp.asarray(min_mask, bool)
+    )
+    conflict, out = core.bcp(pt, jnp.asarray(assign, jnp.int32), mm, jnp.int32(min_w))
+    return bool(conflict), np.asarray(out)
+
+
+def _base(pt, d):
+    import jax.numpy as jnp
+
+    a = core._base_assignment(pt, d.V, d.NCON)
+    return np.array(a)
+
+
+class TestHandBuilt:
+    def test_unit_chain_propagates(self):
+        # a mandatory; a→b→c dependency chain: BCP alone must derive all
+        # three true once the anchor is assumed.
+        vs = [
+            variable("a", mandatory(), dependency("b")),
+            variable("b", dependency("c")),
+            variable("c"),
+        ]
+        p, pt, d = _tensors(vs)
+        base = _base(pt, d)
+        base[p.id_to_index["a"]] = core.TRUE
+        for impl in IMPLS:
+            conf, out = _bcp(pt, d, base, impl)
+            assert not conf, impl
+            assert out[p.id_to_index["b"]] == core.TRUE, impl
+            assert out[p.id_to_index["c"]] == core.TRUE, impl
+
+    def test_conflict_detected(self):
+        # a mandatory and prohibited via conflict pair: assigning both true
+        # must conflict in one round.
+        vs = [
+            variable("a", mandatory(), conflict("b")),
+            variable("b"),
+        ]
+        p, pt, d = _tensors(vs)
+        base = _base(pt, d)
+        base[p.id_to_index["a"]] = core.TRUE
+        base[p.id_to_index["b"]] = core.TRUE
+        for impl in IMPLS:
+            conf, _ = _bcp(pt, d, base, impl)
+            assert conf, impl
+
+    def test_atmost_forces_rest_false(self):
+        # AtMost(1, b, c): with b true, c must be forced false.
+        vs = [
+            variable("a", at_most(1, "b", "c")),
+            variable("b"),
+            variable("c"),
+        ]
+        p, pt, d = _tensors(vs)
+        base = _base(pt, d)
+        base[p.id_to_index["b"]] = core.TRUE
+        for impl in IMPLS:
+            conf, out = _bcp(pt, d, base, impl)
+            assert not conf, impl
+            assert out[p.id_to_index["c"]] == core.FALSE, impl
+
+    def test_atmost_overflow_conflicts(self):
+        vs = [
+            variable("a", at_most(1, "b", "c")),
+            variable("b"),
+            variable("c"),
+        ]
+        p, pt, d = _tensors(vs)
+        base = _base(pt, d)
+        base[p.id_to_index["b"]] = core.TRUE
+        base[p.id_to_index["c"]] = core.TRUE
+        for impl in IMPLS:
+            conf, _ = _bcp(pt, d, base, impl)
+            assert conf, impl
+
+    def test_min_mask_bound(self):
+        # Dynamic extras bound: with min_w=0, any true extra conflicts.
+        vs = [variable("a", mandatory()), variable("b")]
+        p, pt, d = _tensors(vs)
+        base = _base(pt, d)
+        base[p.id_to_index["b"]] = core.TRUE
+        mm = np.zeros(d.V, bool)
+        mm[p.id_to_index["b"]] = True
+        for impl in IMPLS:
+            conf, _ = _bcp(pt, d, base, impl, min_mask=mm, min_w=0)
+            assert conf, impl
+            conf, _ = _bcp(pt, d, base, impl, min_mask=mm, min_w=1)
+            assert not conf, impl
+
+    def test_min_mask_saturation_forces_false(self):
+        # min_w reached: remaining unassigned extras are forced false.
+        vs = [
+            variable("a", mandatory()),
+            variable("b"),
+            variable("c"),
+        ]
+        p, pt, d = _tensors(vs)
+        base = _base(pt, d)
+        base[p.id_to_index["b"]] = core.TRUE
+        mm = np.zeros(d.V, bool)
+        mm[p.id_to_index["b"]] = True
+        mm[p.id_to_index["c"]] = True
+        for impl in IMPLS:
+            conf, out = _bcp(pt, d, base, impl, min_mask=mm, min_w=1)
+            assert not conf, impl
+            assert out[p.id_to_index["c"]] == core.FALSE, impl
+
+
+class TestDegenerateDuplicates:
+    """Duplicate identifiers in constraint argument lists must not make the
+    per-occurrence (gather/host) and per-variable (bitplane) paths diverge:
+    the encoder canonicalizes to set semantics (see encode.py)."""
+
+    def test_duplicate_atmost_members_count_once(self):
+        vs = [
+            variable("a", at_most(1, "b", "b")),
+            variable("b", mandatory()),
+        ]
+        p = encode(vs)
+        for impl in IMPLS:
+            core.set_bcp_impl(impl)
+            (res,) = driver.solve_problems([p])
+            assert int(res.outcome) == core.SAT, impl
+
+    def test_self_conflict_prohibits(self):
+        vs = [variable("a", mandatory(), conflict("a"))]
+        p = encode(vs)
+        for impl in IMPLS:
+            core.set_bcp_impl(impl)
+            (res,) = driver.solve_problems([p])
+            assert int(res.outcome) == core.UNSAT, impl
+
+    def test_duplicate_dependency_targets(self):
+        vs = [
+            variable("a", mandatory(), dependency("b", "b", "c")),
+            variable("b"),
+            variable("c"),
+        ]
+        p = encode(vs)
+        for impl in IMPLS:
+            core.set_bcp_impl(impl)
+            (res,) = driver.solve_problems([p])
+            assert int(res.outcome) == core.SAT, impl
+            installed = np.asarray(res.installed)
+            assert installed[p.id_to_index["b"]], impl
+            assert not installed[p.id_to_index["c"]], impl
+
+
+class TestRandomizedEquivalence:
+    def test_fixpoints_agree(self):
+        # Random instances, random partial assignments: all impls must
+        # agree on (conflict, fixpoint assignment).
+        rng = np.random.default_rng(7)
+        for seed in range(8):
+            p = encode(random_instance(length=24, seed=seed))
+            d = driver._Dims([p], 1)
+            pt = driver.pad_problem(p, d)
+            base = _base(pt, d)
+            k = rng.integers(0, 4)
+            picks = rng.choice(p.n_vars, size=k, replace=False) if k else []
+            for v in picks:
+                base[v] = rng.choice([core.TRUE, core.FALSE])
+            ref = _bcp(pt, d, base, "gather")
+            for impl in ("bits", "pallas"):
+                got = _bcp(pt, d, base, impl)
+                assert got[0] == ref[0], (seed, impl)
+                if not ref[0]:
+                    np.testing.assert_array_equal(got[1], ref[1], err_msg=f"{seed} {impl}")
+
+    def test_full_solves_agree(self):
+        problems = [encode(random_instance(length=20, seed=s)) for s in range(6)]
+        outcomes = {}
+        installs = {}
+        for impl in IMPLS:
+            core.set_bcp_impl(impl)
+            res = driver.solve_problems(problems)
+            outcomes[impl] = [int(r.outcome) for r in res]
+            installs[impl] = [np.asarray(r.installed).tolist() for r in res]
+        assert outcomes["bits"] == outcomes["gather"]
+        assert outcomes["pallas"] == outcomes["gather"]
+        assert installs["bits"] == installs["gather"]
+        assert installs["pallas"] == installs["gather"]
