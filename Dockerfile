@@ -1,0 +1,19 @@
+# Service image — the analog of the reference's distroless manager image
+# (/root/reference/Dockerfile:1-5).  The runtime needs Python + JAX with a
+# TPU-capable jaxlib; on a TPU VM base image the libtpu plugin is present.
+FROM python:3.12-slim
+
+WORKDIR /app
+COPY deppy_tpu/ deppy_tpu/
+RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+# Non-root so the Deployment's runAsNonRoot admission check passes.
+RUN useradd --uid 65532 --create-home resolver
+USER 65532
+
+# API + Prometheus metrics.
+EXPOSE 8080
+# Liveness/readiness probes.
+EXPOSE 8081
+
+ENTRYPOINT ["python", "-m", "deppy_tpu", "serve"]

@@ -1,0 +1,63 @@
+# Build/test/deploy entry points — the analog of the reference Makefile
+# (/root/reference/Makefile:72-126: unit, e2e, build, cli, deploy targets).
+# The rebuild is pure Python + JAX, so "build" is a no-op beyond bytecode
+# sanity; the deployable unit is the batch-resolution service image.
+
+PYTHON ?= python
+IMG ?= deppy-tpu:latest
+
+.PHONY: all
+all: verify unit
+
+##@ Development
+
+.PHONY: unit
+unit: ## Run the test suite (8-device virtual CPU mesh, see tests/conftest.py).
+	$(PYTHON) -m pytest tests/ -q
+
+.PHONY: unit-fast
+unit-fast: ## Tests minus the slow randomized-equivalence suites.
+	$(PYTHON) -m pytest tests/ -q -k "not Randomized and not fleet"
+
+.PHONY: verify
+verify: ## Sanity: everything compiles and collects (reference `make verify` analog).
+	$(PYTHON) -m compileall -q deppy_tpu tests bench.py __graft_entry__.py
+	$(PYTHON) -m pytest tests/ -q --collect-only >/dev/null
+
+##@ Benchmarks
+
+.PHONY: bench
+bench: ## Headline benchmark (one JSON line; the driver's bench.py contract).
+	$(PYTHON) bench.py
+
+.PHONY: bench-suite
+bench-suite: ## All five BASELINE.json workload configs.
+	$(PYTHON) -m deppy_tpu.benchmarks.suite --out BENCH_SUITE.json
+
+.PHONY: bench-suite-quick
+bench-suite-quick: ## Suite at ~1/8 batch sizes (smoke).
+	$(PYTHON) -m deppy_tpu.benchmarks.suite --quick
+
+##@ Run
+
+.PHONY: serve
+serve: ## Run the batch-resolution service (API+metrics :8080, probes :8081).
+	$(PYTHON) -m deppy_tpu serve
+
+.PHONY: cli
+cli: ## Show CLI help (reference `make cli` builds the cobra stub; ours is live).
+	$(PYTHON) -m deppy_tpu --help
+
+##@ Deployment
+
+.PHONY: docker-build
+docker-build: ## Build the service image.
+	docker build -t $(IMG) .
+
+.PHONY: deploy
+deploy: ## Apply the kustomize tree (reference Makefile:106-126 analog).
+	kubectl apply -k config/default
+
+.PHONY: undeploy
+undeploy:
+	kubectl delete -k config/default

@@ -1,86 +1,13 @@
-"""Headline benchmark: batched catalog resolutions/sec, device vs host.
-
-Workload: BASELINE.json config 2 — a batch of independent catalog
-resolutions (random catalog subsets in the reference benchmark's instance
-distribution, bench_test.go:10-64) dispatched to the tensor engine in one
-vmapped solve.  The baseline denominator is the serial host reference
-engine (the rebuild's stand-in for the reference's single-threaded gini
-solver, which publishes no numbers of its own — see BASELINE.md).
+"""Driver benchmark entry point.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-plus human-readable detail on stderr.
+
+Logic lives in :mod:`deppy_tpu.benchmarks.headline` (also reachable as
+``deppy bench``); this wrapper keeps the repo-root contract stable.
 """
 
-from __future__ import annotations
-
-import json
-import sys
-import time
-
-N_PROBLEMS = 512
-LENGTH = 48
-HOST_SAMPLE = 24
-
-
-def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
-
-
-def main() -> None:
-    import jax
-
-    from deppy_tpu.engine import driver
-    from deppy_tpu.models import random_instance
-    from deppy_tpu.sat.encode import encode
-    from deppy_tpu.sat.errors import NotSatisfiable
-    from deppy_tpu.sat.host import HostEngine
-
-    log(f"jax backend: {jax.default_backend()} devices={jax.devices()}")
-
-    problems = [
-        encode(random_instance(length=LENGTH, seed=s)) for s in range(N_PROBLEMS)
-    ]
-
-    # --- host serial baseline (sampled) ---
-    t0 = time.perf_counter()
-    for p in problems[:HOST_SAMPLE]:
-        try:
-            HostEngine(p).solve()
-        except NotSatisfiable:
-            pass  # UNSAT is a valid (timed) outcome; real errors propagate
-    host_s = (time.perf_counter() - t0) / HOST_SAMPLE
-    host_rate = 1.0 / host_s
-    log(f"host engine: {host_s * 1e3:.2f} ms/problem ({host_rate:.1f}/s serial)")
-
-    # --- device batched ---
-    t0 = time.perf_counter()
-    driver.solve_problems(problems)  # includes compile
-    warm_s = time.perf_counter() - t0
-    log(f"device warm-up (incl. compile): {warm_s:.1f}s")
-
-    t0 = time.perf_counter()
-    results = driver.solve_problems(problems)
-    dev_s = time.perf_counter() - t0
-    n_sat = sum(1 for r in results if r.outcome == 1)
-    n_unsat = sum(1 for r in results if r.outcome == -1)
-    rate = N_PROBLEMS / dev_s
-    log(
-        f"device: {N_PROBLEMS} problems in {dev_s:.2f}s = {rate:.1f}/s "
-        f"({n_sat} sat / {n_unsat} unsat)"
-    )
-
-    print(
-        json.dumps(
-            {
-                "metric": "catalog resolutions/sec (batched device vs serial host)",
-                "value": round(rate, 2),
-                "unit": "problems/s",
-                "vs_baseline": round(rate / host_rate, 3),
-            }
-        )
-    )
-
+from deppy_tpu.benchmarks import headline
 
 if __name__ == "__main__":
-    main()
+    headline.run()

@@ -1,0 +1,8 @@
+"""``python -m deppy_tpu`` — the CLI entry point (reference cmd/main.go)."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Shared measurement harness for all benchmarks.
+
+One methodology, used by both the headline benchmark and the full suite:
+sampled serial host-engine baseline (the stand-in for the reference's
+single-threaded gini solver), an untimed compile warm-up, then one timed
+batched device dispatch.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, Sequence
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_problems(problems: Sequence, host_sample: int = 16,
+                   mesh=None) -> Dict:
+    """Measure a list of lowered problems: host ms/problem (serial,
+    sampled), device rate (batched, post-warm-up).  Returns the raw
+    numbers; callers shape them into their own output records."""
+    from ..engine import driver
+    from ..sat.errors import NotSatisfiable
+    from ..sat.host import HostEngine
+
+    if not problems:
+        raise ValueError("problems must be non-empty")
+    if host_sample <= 0:
+        raise ValueError("host_sample must be positive")
+    n = len(problems)
+
+    sample = problems[: min(host_sample, n)]
+    t0 = time.perf_counter()
+    for p in sample:
+        try:
+            HostEngine(p).solve()
+        except NotSatisfiable:
+            pass  # UNSAT is a valid (timed) outcome; real errors propagate
+    host_s = (time.perf_counter() - t0) / len(sample)
+    log(f"host: {host_s * 1e3:.2f} ms/problem ({1.0 / host_s:.1f}/s serial)")
+
+    t0 = time.perf_counter()
+    driver.solve_problems(problems, mesh=mesh)  # includes compile
+    warm_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    results = driver.solve_problems(problems, mesh=mesh)
+    dev_s = time.perf_counter() - t0
+    n_sat = sum(1 for r in results if r.outcome == 1)
+    n_unsat = sum(1 for r in results if r.outcome == -1)
+    rate = n / dev_s
+    log(
+        f"device: {n} in {dev_s:.3f}s = {rate:.1f}/s "
+        f"({n_sat} sat / {n_unsat} unsat; warm-up {warm_s:.1f}s)"
+    )
+    return {
+        "n_problems": n,
+        "host_s_per_problem": host_s,
+        "device_seconds": dev_s,
+        "device_rate": rate,
+        "warmup_seconds": warm_s,
+        "sat": n_sat,
+        "unsat": n_unsat,
+    }

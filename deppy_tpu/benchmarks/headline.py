@@ -1,0 +1,44 @@
+"""Headline benchmark: batched catalog resolutions/sec, device vs host.
+
+Workload: BASELINE.json config 2 — a batch of independent catalog
+resolutions (random catalog subsets in the reference benchmark's instance
+distribution, /root/reference/pkg/sat/bench_test.go:10-64) dispatched to
+the tensor engine in one vmapped solve.  Measurement methodology lives in
+:mod:`deppy_tpu.benchmarks.harness` (shared with the full suite).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+plus human-readable detail on stderr.  Invoked by the repo-root
+``bench.py`` (the driver's entry point) and ``deppy bench``.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .harness import bench_problems, log
+
+
+def run(n_problems: int = 512, length: int = 48, host_sample: int = 24) -> dict:
+    import jax
+
+    from ..models import random_instance
+    from ..sat.encode import encode
+
+    if n_problems <= 0:
+        raise ValueError("n_problems must be positive")
+
+    log(f"jax backend: {jax.default_backend()} devices={jax.devices()}")
+    problems = [
+        encode(random_instance(length=length, seed=s)) for s in range(n_problems)
+    ]
+    m = bench_problems(problems, host_sample=host_sample)
+
+    result = {
+        "metric": "catalog resolutions/sec (batched device vs serial host)",
+        "value": round(m["device_rate"], 2),
+        "unit": "problems/s",
+        "vs_baseline": round(m["device_rate"] * m["host_s_per_problem"], 3),
+    }
+    print(json.dumps(result))
+    return result

@@ -1,0 +1,136 @@
+"""Full benchmark suite: the five BASELINE.json workload configs.
+
+The reference publishes no numbers (SURVEY.md §6), so this suite produces
+the rebuild's own: for each config, a sampled serial host-engine baseline
+(the stand-in for the reference's single-threaded gini solver) and the
+batched device rate.  Results feed BASELINE.md.
+
+Run: ``python -m deppy_tpu.benchmarks.suite [--quick] [--out FILE]``.
+Prints one JSON object per config on stdout (one line each), detail on
+stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Dict, List, Optional
+
+from ..models import (
+    gvk_conflict_catalog,
+    operatorhub_catalog,
+    random_instance,
+    version_pinned_chains,
+)
+from .harness import log
+
+
+def _configs(quick: bool) -> List[Dict]:
+    """The five BASELINE.json configs.  ``quick`` shrinks batch sizes for
+    CI smoke runs; full sizes match the config descriptions."""
+    scale = 8 if quick else 1
+    return [
+        {
+            "name": "single operatorhub catalog resolve (~200 bundles)",
+            "gen": lambda s: operatorhub_catalog(
+                n_packages=40, versions_per_package=5, seed=s
+            ),
+            "n": 1,
+        },
+        {
+            "name": "batched 1k independent resolves (random catalog subsets)",
+            "gen": lambda s: random_instance(length=48, seed=s),
+            "n": 1024 // scale,
+        },
+        {
+            "name": "version-pin + deep transitive chains (AtMost-1 per package)",
+            "gen": lambda s: version_pinned_chains(depth=20, width=3, seed=s),
+            "n": 256 // scale,
+        },
+        {
+            "name": "GVK-uniqueness Conflict-heavy",
+            "gen": lambda s: gvk_conflict_catalog(
+                n_groups=20, providers_per_group=4, n_required=10, seed=s
+            ),
+            "n": 256 // scale,
+        },
+        {
+            "name": "fleet-scale: 10k cluster-states x shared catalog (mesh)",
+            "gen": lambda s: gvk_conflict_catalog(
+                n_groups=12, providers_per_group=3, n_required=6, seed=s
+            ),
+            "n": 10_000 // scale,
+            "mesh": True,
+        },
+    ]
+
+
+def _bench_config(cfg: Dict, host_sample: int = 16) -> Dict:
+    from ..sat.encode import encode
+    from .harness import bench_problems
+
+    n = cfg["n"]
+    log(f"--- {cfg['name']} (n={n})")
+    t0 = time.perf_counter()
+    problems = [encode(cfg["gen"](s)) for s in range(n)]
+    encode_s = time.perf_counter() - t0
+    log(f"encode: {n} problems in {encode_s:.2f}s")
+
+    mesh = None
+    if cfg.get("mesh"):
+        import jax
+
+        from ..parallel import default_mesh
+
+        if len(jax.devices()) > 1:
+            mesh = default_mesh(jax.devices())
+            log(f"mesh: {len(jax.devices())} devices")
+
+    m = bench_problems(problems, host_sample=host_sample, mesh=mesh)
+    host_s = m["host_s_per_problem"]
+    return {
+        "config": cfg["name"],
+        "n_problems": n,
+        "host_ms_per_problem": round(host_s * 1e3, 3),
+        "host_rate": round(1.0 / host_s, 2),
+        "device_seconds": round(m["device_seconds"], 4),
+        "device_rate": round(m["device_rate"], 2),
+        "speedup_vs_serial_host": round(m["device_rate"] * host_s, 3),
+        "sat": m["sat"],
+        "unsat": m["unsat"],
+    }
+
+
+def run(quick: bool = False, out_path: Optional[str] = None,
+        only: Optional[int] = None) -> List[Dict]:
+    import jax
+
+    log(f"jax backend: {jax.default_backend()} devices={jax.devices()}")
+    results = []
+    for i, cfg in enumerate(_configs(quick)):
+        if only is not None and i != only:
+            continue
+        res = _bench_config(cfg)
+        print(json.dumps(res), flush=True)
+        results.append(res)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+        log(f"wrote {out_path}")
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="shrink batch sizes ~8x for smoke runs")
+    ap.add_argument("--out", default=None, help="also write a JSON file")
+    ap.add_argument("--only", type=int, default=None,
+                    help="run a single config by index (0-4)")
+    args = ap.parse_args()
+    run(quick=args.quick, out_path=args.out, only=args.only)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,178 @@
+"""Command-line interface.
+
+The reference CLI is an empty cobra root command — "deppy, the open-source
+constraint solver framework" with zero subcommands
+(/root/reference/cmd/root/root.go:7-14, cmd/main.go:10-16).  SURVEY.md §3.3
+directs the rebuild to make it real:
+
+  * ``deppy resolve FILE``  — read a problem (or batch) file, print each
+    Solution or the NotSatisfiable conflict set;
+  * ``deppy bench``         — run the headline benchmark and print its one
+    JSON line;
+  * ``deppy serve``         — run the batch-resolution service (the analog
+    of the reference's controller manager, main.go:46-86).
+
+Exit codes: 0 = all problems satisfiable, 1 = at least one unsatisfiable,
+2 = bad input / usage, 3 = incomplete (iteration budget exhausted before a
+definitive answer — the reference's ErrIncomplete, solve.go:14).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import io as problem_io
+from .sat.errors import DuplicateIdentifier, InternalSolverError
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="deppy",
+        description="deppy-tpu: an open-source constraint solver framework, "
+        "TPU-native rebuild",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    p_resolve = sub.add_parser(
+        "resolve", help="resolve a problem file and print the solution(s)"
+    )
+    p_resolve.add_argument("file", help="JSON problem file (see deppy_tpu.io)")
+    p_resolve.add_argument(
+        "--backend",
+        choices=["auto", "host", "tpu"],
+        default="auto",
+        help="solver backend (default: auto — tensor engine when a JAX "
+        "device is usable, else the host engine)",
+    )
+    p_resolve.add_argument(
+        "--output",
+        choices=["text", "json"],
+        default="text",
+        help="output format (default: text)",
+    )
+    p_resolve.add_argument(
+        "--max-steps",
+        type=int,
+        default=None,
+        help="iteration budget per problem; exceeding it reports incomplete",
+    )
+
+    p_bench = sub.add_parser(
+        "bench", help="run the headline benchmark (one JSON line on stdout)"
+    )
+    p_bench.add_argument("--problems", type=int, default=512)
+    p_bench.add_argument("--length", type=int, default=48)
+
+    p_serve = sub.add_parser(
+        "serve", help="run the batch-resolution service"
+    )
+    p_serve.add_argument(
+        "--bind-address", default=":8080",
+        help="API + metrics listen address (reference main.go:48-49 "
+        "metrics-bind-address; default :8080)",
+    )
+    p_serve.add_argument(
+        "--health-probe-bind-address", default=":8081",
+        help="healthz/readyz listen address (reference main.go:50; "
+        "default :8081)",
+    )
+    p_serve.add_argument(
+        "--backend", choices=["auto", "host", "tpu"], default="auto"
+    )
+    p_serve.add_argument("--max-steps", type=int, default=None)
+    return parser
+
+
+def _cmd_resolve(args) -> int:
+    try:
+        problems, is_batch = problem_io.load_document(args.file)
+    except FileNotFoundError:
+        print(f"error: no such file: {args.file}", file=sys.stderr)
+        return 2
+    except problem_io.ProblemFormatError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    from .resolution.facade import BatchResolver
+
+    try:
+        results = BatchResolver(
+            backend=args.backend, max_steps=args.max_steps
+        ).solve(problems)
+    except (DuplicateIdentifier, InternalSolverError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    rendered = [problem_io.result_to_dict(res) for res in results]
+    statuses = {r["status"] for r in rendered}
+    rc = 3 if "incomplete" in statuses else (1 if "unsat" in statuses else 0)
+
+    if args.output == "json":
+        # Output shape is a function of the *input* form: a batch document
+        # always yields {"results": [...]}, a single problem a bare object.
+        doc = {"results": rendered} if is_batch else rendered[0]
+        json.dump(doc, sys.stdout, indent=2)
+        print()
+        return rc
+
+    for i, r in enumerate(rendered):
+        prefix = f"problem {i}: " if is_batch else ""
+        if r["status"] == "sat":
+            sel = ", ".join(r["selected"]) if r["selected"] else "(nothing)"
+            print(f"{prefix}resolution set: {sel}")
+        elif r["status"] == "unsat":
+            print(f"{prefix}constraints not satisfiable: "
+                  + ", ".join(r["conflicts"]))
+        else:
+            print(f"{prefix}resolution incomplete: {r['error']}")
+    return rc
+
+
+def _cmd_bench(args) -> int:
+    from .benchmarks import headline
+
+    try:
+        headline.run(n_problems=args.problems, length=args.length)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def _cmd_serve(args) -> int:
+    from .service import serve
+
+    try:
+        serve(
+            bind_address=args.bind_address,
+            probe_address=args.health_probe_bind_address,
+            backend=args.backend,
+            max_steps=args.max_steps,
+        )
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+    if args.command is None:
+        parser.print_help()
+        return 0
+    if args.command == "resolve":
+        return _cmd_resolve(args)
+    if args.command == "bench":
+        return _cmd_bench(args)
+    if args.command == "serve":
+        return _cmd_serve(args)
+    parser.error(f"unknown command {args.command!r}")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

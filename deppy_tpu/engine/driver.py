@@ -186,10 +186,18 @@ def _decode_core(p: Problem, active: np.ndarray) -> NotSatisfiable:
     return NotSatisfiable([p.applied[j] for j in range(p.n_cons) if active[j]])
 
 
-def solve_one(problem: Problem, max_steps: Optional[int] = None) -> List[Variable]:
+def solve_one(
+    problem: Problem,
+    max_steps: Optional[int] = None,
+    stats: Optional[dict] = None,
+) -> List[Variable]:
     """Single-problem entry used by :class:`deppy_tpu.sat.solver.Solver`
-    (batch of one).  Same error contract as the host engine."""
+    (batch of one).  Same error contract as the host engine.  A ``stats``
+    dict, when given, receives ``{"steps": N}`` — the engine iteration count
+    (SURVEY.md §5 observability)."""
     (res,) = solve_problems([problem], max_steps=max_steps)
+    if stats is not None:
+        stats["steps"] = int(res.steps)
     if res.outcome == core.SAT:
         return _decode_installed(problem, res.installed)
     if res.outcome == core.UNSAT:
@@ -201,13 +209,20 @@ def solve_batch(
     problem_vars: Sequence[Sequence[Variable]],
     max_steps: Optional[int] = None,
     mesh=None,
+    stats: Optional[dict] = None,
 ):
     """Batch entry used by :class:`deppy_tpu.resolution.facade.BatchResolver`:
-    N independent variable lists → per-problem ``Solution`` dict or the
-    problem's :class:`NotSatisfiable` error."""
+    N independent variable lists → per-problem result: a ``Solution`` dict,
+    the problem's :class:`NotSatisfiable` error, or an :class:`Incomplete`
+    marker when that problem exhausted the step budget (problems are
+    independent, so one straggler never voids its batchmates' answers).  A
+    ``stats`` dict, when given, receives ``{"steps": N}`` summed over the
+    batch."""
     problems = [encode(vs) for vs in problem_vars]
     results = solve_problems(problems, max_steps=max_steps, mesh=mesh)
-    out: List[Union[dict, NotSatisfiable]] = []
+    if stats is not None:
+        stats["steps"] = int(sum(int(r.steps) for r in results))
+    out: List[Union[dict, NotSatisfiable, Incomplete]] = []
     for p, res in zip(problems, results):
         if res.outcome == core.SAT:
             solution = {v.identifier: False for v in p.variables}
@@ -217,5 +232,5 @@ def solve_batch(
         elif res.outcome == core.UNSAT:
             out.append(_decode_core(p, res.core))
         else:
-            raise Incomplete()
+            out.append(Incomplete())
     return out

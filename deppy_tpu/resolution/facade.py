@@ -18,7 +18,7 @@ from typing import Dict, List, Optional, Sequence, Union
 from ..entity.entity import EntityID
 from ..entity.source import EntityQuerier
 from ..sat.constraints import Variable
-from ..sat.errors import InternalSolverError, NotSatisfiable
+from ..sat.errors import Incomplete, InternalSolverError, NotSatisfiable
 from ..sat.solver import Solver
 from ..sat.tracer import Tracer
 from .generator import ConstraintAggregator, GeneratorLike
@@ -76,9 +76,10 @@ class BatchResolver:
     """Resolve many independent problems in one device dispatch.
 
     Each problem is its own variable list (typically: one per cluster state,
-    sharing a catalog's entity source).  Results come back per problem as
-    either a ``Solution`` or the ``NotSatisfiable`` error carrying that
-    problem's minimal constraint core.
+    sharing a catalog's entity source).  Results come back per problem as a
+    ``Solution``, the ``NotSatisfiable`` error carrying that problem's
+    minimal constraint core, or an ``Incomplete`` marker when that problem
+    exhausted the step budget (stragglers never void their batchmates).
     """
 
     def __init__(
@@ -90,24 +91,39 @@ class BatchResolver:
         self.backend = backend
         self.max_steps = max_steps
         self.mesh = mesh  # jax.sharding.Mesh from deppy_tpu.parallel
+        # Engine iterations consumed by the last solve, summed over the
+        # batch (SURVEY.md §5 observability; exported by the service).
+        self.last_steps: int = 0
 
     def solve(
         self, problems: Sequence[Sequence[Variable]]
-    ) -> List[Union[Solution, NotSatisfiable]]:
+    ) -> List[Union[Solution, NotSatisfiable, Incomplete]]:
         from ..sat.solver import resolve_backend
 
         backend = resolve_backend(self.backend)
+        self.last_steps = 0
         if backend == "host":
-            out: List[Union[Solution, NotSatisfiable]] = []
+            out: List[Union[Solution, NotSatisfiable, Incomplete]] = []
             for variables in problems:
+                solver = Solver(
+                    variables, backend="host", max_steps=self.max_steps
+                )
                 try:
-                    installed = Solver(
-                        variables, backend="host", max_steps=self.max_steps
-                    ).solve()
+                    installed = solver.solve()
                     out.append(_to_solution(variables, installed))
                 except NotSatisfiable as e:
                     out.append(e)
+                except Incomplete as e:
+                    out.append(e)
+                finally:
+                    self.last_steps += solver.steps
             return out
         from ..engine.driver import solve_batch
 
-        return solve_batch(problems, max_steps=self.max_steps, mesh=self.mesh)
+        stats: dict = {}
+        try:
+            return solve_batch(
+                problems, max_steps=self.max_steps, mesh=self.mesh, stats=stats
+            )
+        finally:
+            self.last_steps = stats.get("steps", 0)

@@ -105,6 +105,13 @@ class HostEngine:
             self._base[self.n :] = _TRUE
         self.last_conflicts: List[AppliedConstraint] = []
 
+    @property
+    def steps(self) -> int:
+        """Engine iterations consumed so far (tests, decisions, backtracks) —
+        the host-side counterpart of the tensor engine's SolveResult.steps
+        (SURVEY.md §5 observability)."""
+        return self._steps
+
     # ------------------------------------------------------------------ BCP
 
     def _bcp(

@@ -49,17 +49,27 @@ class Solver:
         self.tracer = tracer
         self.backend = backend
         self.max_steps = max_steps
+        # Engine iterations consumed by the last solve (SURVEY.md §5).
+        self.steps: int = 0
 
     def solve(self) -> List[Variable]:
         backend = resolve_backend(self.backend)
         if backend == "host":
-            installed, _ = HostEngine(
+            engine = HostEngine(
                 self.problem, tracer=self.tracer, max_steps=self.max_steps
-            ).solve()
+            )
+            try:
+                installed, _ = engine.solve()
+            finally:
+                self.steps = engine.steps
             return installed
         from ..engine.driver import solve_one
 
-        return solve_one(self.problem, max_steps=self.max_steps)
+        stats: dict = {}
+        try:
+            return solve_one(self.problem, max_steps=self.max_steps, stats=stats)
+        finally:
+            self.steps = stats.get("steps", 0)
 
 
 def resolve_backend(backend: str) -> str:

@@ -1,0 +1,288 @@
+"""Batch-resolution service: the rebuild's long-running process.
+
+The reference's deployable binary is a controller-runtime manager scaffold
+with metrics on :8080, health probes on :8081, and no reconcilers
+(/root/reference/main.go:46-86; SURVEY.md §3.4 directs the rebuild to make
+this a real batch-resolution service with the same health/metrics
+surface).  This module is that service, on the stdlib HTTP server so the
+library stays dependency-free:
+
+  * ``POST /v1/resolve`` on the main address — accepts a problem document
+    (the :mod:`deppy_tpu.io` format: one problem or a batch), dispatches it
+    to the solver backend, returns per-problem solutions / conflict sets;
+  * ``GET /metrics`` on the main address — Prometheus text format
+    (the analog of controller-runtime's metrics registry, main.go:63-64,
+    scraped via config/prometheus/monitor.yaml);
+  * ``GET /healthz`` and ``GET /readyz`` on the probe address — liveness
+    and readiness pings (main.go:75-81's healthz.Ping).
+
+Counters follow SURVEY.md §5's observability plan: problems resolved by
+outcome, batches, solve seconds, engine steps (propagation/decision
+iterations as counted by the engine's step budget).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from . import io as problem_io
+from .sat.errors import DuplicateIdentifier, InternalSolverError
+
+
+class _V6HTTPServer(ThreadingHTTPServer):
+    address_family = socket.AF_INET6
+
+
+def _make_http_server(addr: Tuple[str, int], handler) -> ThreadingHTTPServer:
+    """Bind a threading HTTP server on an IPv4 or IPv6 address."""
+    host, _ = addr
+    if ":" in host:  # IPv6 literal (brackets already stripped by _parse_addr)
+        return _V6HTTPServer(addr, handler)
+    return ThreadingHTTPServer(addr, handler)
+
+
+class Metrics:
+    """Thread-safe counters rendered in Prometheus text exposition format."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.resolutions: Dict[str, int] = {"sat": 0, "unsat": 0, "incomplete": 0}
+        self.batches = 0
+        self.errors = 0
+        self.solve_seconds = 0.0
+        self.engine_steps = 0
+
+    def observe_batch(self, outcomes: Dict[str, int], seconds: float,
+                      steps: int = 0) -> None:
+        with self._lock:
+            self.batches += 1
+            for k, v in outcomes.items():
+                self.resolutions[k] = self.resolutions.get(k, 0) + v
+            self.solve_seconds += seconds
+            self.engine_steps += steps
+
+    def observe_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def render(self) -> str:
+        with self._lock:
+            lines = [
+                "# HELP deppy_resolutions_total Problems resolved by outcome.",
+                "# TYPE deppy_resolutions_total counter",
+            ]
+            for outcome, n in sorted(self.resolutions.items()):
+                lines.append(
+                    f'deppy_resolutions_total{{outcome="{outcome}"}} {n}'
+                )
+            lines += [
+                "# HELP deppy_batches_total Resolution batches dispatched.",
+                "# TYPE deppy_batches_total counter",
+                f"deppy_batches_total {self.batches}",
+                "# HELP deppy_request_errors_total Malformed or failed requests.",
+                "# TYPE deppy_request_errors_total counter",
+                f"deppy_request_errors_total {self.errors}",
+                "# HELP deppy_solve_seconds_total Wall-clock seconds spent solving.",
+                "# TYPE deppy_solve_seconds_total counter",
+                f"deppy_solve_seconds_total {self.solve_seconds}",
+                "# HELP deppy_engine_steps_total Engine iterations (tests, decisions, backtracks).",
+                "# TYPE deppy_engine_steps_total counter",
+                f"deppy_engine_steps_total {self.engine_steps}",
+            ]
+        return "\n".join(lines) + "\n"
+
+
+class Server:
+    """The service: one HTTP server for API+metrics, one for health probes
+    (mirroring the reference's two bind addresses, main.go:48-50)."""
+
+    def __init__(
+        self,
+        bind_address: str = ":8080",
+        probe_address: str = ":8081",
+        backend: str = "auto",
+        max_steps: Optional[int] = None,
+    ):
+        self.backend = backend
+        self.max_steps = max_steps
+        self.metrics = Metrics()
+        self.ready = threading.Event()
+        self._api = _make_http_server(
+            _parse_addr(bind_address), _api_handler(self)
+        )
+        try:
+            self._probe = _make_http_server(
+                _parse_addr(probe_address), _probe_handler(self)
+            )
+        except OSError:
+            self._api.server_close()  # don't leak the already-bound socket
+            raise
+        self._threads: list = []
+
+    @property
+    def api_port(self) -> int:
+        return self._api.server_address[1]
+
+    @property
+    def probe_port(self) -> int:
+        return self._probe.server_address[1]
+
+    def resolve_document(self, doc) -> Tuple[int, dict]:
+        """Resolve one request body; returns (http_status, response_doc)."""
+        try:
+            problems = problem_io.problems_from_document(doc)
+        except problem_io.ProblemFormatError as e:
+            self.metrics.observe_error()
+            return 400, {"error": str(e)}
+
+        from .resolution.facade import BatchResolver
+
+        resolver = BatchResolver(backend=self.backend, max_steps=self.max_steps)
+        t0 = time.perf_counter()
+        try:
+            results = resolver.solve(problems)
+        except (DuplicateIdentifier, InternalSolverError) as e:
+            self.metrics.observe_error()
+            return 400, {"error": str(e)}
+
+        outcomes = {"sat": 0, "unsat": 0, "incomplete": 0}
+        rendered = []
+        for res in results:
+            r = problem_io.result_to_dict(res)
+            outcomes[r["status"]] += 1
+            rendered.append(r)
+        self.metrics.observe_batch(outcomes, time.perf_counter() - t0,
+                                   steps=resolver.last_steps)
+        return 200, {"results": rendered}
+
+    def start(self) -> None:
+        """Start both listeners on daemon threads (non-blocking)."""
+        for srv in (self._api, self._probe):
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self.ready.set()
+
+    def shutdown(self) -> None:
+        self.ready.clear()
+        for srv in (self._api, self._probe):
+            if self._threads:
+                # BaseServer.shutdown blocks forever unless serve_forever is
+                # running — only call it on a started server.
+                srv.shutdown()
+            srv.server_close()
+        self._threads = []
+
+
+def _parse_addr(addr: str) -> Tuple[str, int]:
+    """':8080', 'host:8080', '[::1]:8080', or a bare port → (host, port).
+    Raises ``ValueError`` with a usable message on anything else (callers
+    surface it as a usage error)."""
+    host, sep, port = addr.rpartition(":")
+    if not sep:
+        host, port = "", addr
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]  # IPv6 literal
+    elif ":" in host:
+        # An unbracketed IPv6 literal would silently misparse at the last
+        # colon ('::1' -> host '::', port 1) — require brackets instead.
+        raise ValueError(
+            f"invalid listen address {addr!r}: bracket IPv6 literals, "
+            "e.g. '[::1]:8080'"
+        )
+    try:
+        port_n = int(port)
+    except ValueError:
+        raise ValueError(
+            f"invalid listen address {addr!r}: want ':PORT', 'HOST:PORT', "
+            "or a bare port number"
+        ) from None
+    return host or "0.0.0.0", port_n
+
+
+def _api_handler(server: Server):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # keep the library print-free
+            pass
+
+        def _send(self, status: int, body: str, ctype: str) -> None:
+            data = body.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _send_json(self, status: int, doc: dict) -> None:
+            self._send(status, json.dumps(doc), "application/json")
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._send(200, server.metrics.render(),
+                           "text/plain; version=0.0.4")
+            else:
+                self._send_json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v1/resolve":
+                self._send_json(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(length) or b"null")
+            except (ValueError, json.JSONDecodeError) as e:
+                server.metrics.observe_error()
+                self._send_json(400, {"error": f"invalid JSON body: {e}"})
+                return
+            status, resp = server.resolve_document(doc)
+            self._send_json(status, resp)
+
+    return Handler
+
+
+def _probe_handler(server: Server):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            if self.path in ("/healthz", "/readyz"):
+                ok = self.path == "/healthz" or server.ready.is_set()
+                body = b"ok" if ok else b"not ready"
+                self.send_response(200 if ok else 503)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+    return Handler
+
+
+def serve(
+    bind_address: str = ":8080",
+    probe_address: str = ":8081",
+    backend: str = "auto",
+    max_steps: Optional[int] = None,
+) -> None:
+    """Blocking entry point used by ``deppy serve`` (the analog of
+    mgr.Start, main.go:85)."""
+    srv = Server(bind_address, probe_address, backend, max_steps)
+    srv.start()
+    print(
+        f"deppy service listening on :{srv.api_port} "
+        f"(probes on :{srv.probe_port})",
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.shutdown()

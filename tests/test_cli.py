@@ -1,0 +1,196 @@
+"""CLI + problem-file codec tests.
+
+The reference CLI is an empty cobra stub (cmd/root/root.go:7-14); the
+rebuild makes it real (SURVEY.md §3.3), so these tests pin the actual
+behavior: problem-file parsing, resolve output in both formats, exit
+codes, and error paths.
+"""
+
+import json
+
+import pytest
+
+from deppy_tpu import io as problem_io
+from deppy_tpu.cli import main
+from deppy_tpu.sat.constraints import (
+    AtMost,
+    Conflict,
+    Dependency,
+    Mandatory,
+    Prohibited,
+    variable,
+)
+
+
+def write_doc(tmp_path, doc, name="problem.json"):
+    path = tmp_path / name
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+class TestCodec:
+    def test_round_trip_all_constraint_types(self):
+        v = variable(
+            "a",
+            Mandatory(),
+            Prohibited(),
+            Dependency(("b", "c")),
+            Conflict("d"),
+            AtMost(1, ("x", "y")),
+        )
+        d = problem_io.variable_to_dict(v)
+        assert problem_io.variable_from_dict(d) == v
+
+    def test_dependency_order_preserved(self):
+        d = {"id": "a", "constraints": [{"type": "dependency", "ids": ["z", "b", "m"]}]}
+        v = problem_io.variable_from_dict(d)
+        assert v.constraints[0].ids == ("z", "b", "m")
+
+    def test_single_problem_document(self):
+        doc = {"variables": [{"id": "a"}, {"id": "b"}]}
+        probs = problem_io.problems_from_document(doc)
+        assert len(probs) == 1
+        assert [v.identifier for v in probs[0]] == ["a", "b"]
+
+    def test_batch_document(self):
+        doc = {"problems": [{"variables": [{"id": "a"}]}, {"variables": [{"id": "b"}]}]}
+        probs = problem_io.problems_from_document(doc)
+        assert len(probs) == 2
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            {"variables": [{"id": 3}]},
+            {"variables": [{"id": "a", "constraints": [{"type": "nope"}]}]},
+            {"variables": [{"id": "a", "constraints": [{"type": "conflict"}]}]},
+            {"variables": [{"id": "a", "constraints": [{"type": "atMost", "n": -1, "ids": []}]}]},
+            {"variables": [{"id": "a", "constraints": [{"type": "atMost", "n": True, "ids": []}]}]},
+            {"variables": [{"id": "a", "constraints": [{"type": "dependency", "ids": "b"}]}]},
+            {"variables": "x"},
+            [],
+        ],
+    )
+    def test_malformed_documents_raise(self, bad):
+        with pytest.raises(problem_io.ProblemFormatError):
+            problem_io.problems_from_document(bad)
+
+
+class TestResolveCommand:
+    def test_sat_text_output(self, tmp_path, capsys):
+        # The reference README's successful-resolution example
+        # (README.md:40-66): a depends on c, b depends on d.
+        path = write_doc(tmp_path, {
+            "variables": [
+                {"id": "a", "constraints": [
+                    {"type": "mandatory"}, {"type": "dependency", "ids": ["c"]}]},
+                {"id": "b", "constraints": [
+                    {"type": "mandatory"}, {"type": "dependency", "ids": ["d"]}]},
+                {"id": "c"}, {"id": "d"},
+            ]
+        })
+        rc = main(["resolve", path, "--backend", "host"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "resolution set: a, b, c, d" in out
+
+    def test_unsat_text_output_and_exit_code(self, tmp_path, capsys):
+        path = write_doc(tmp_path, {
+            "variables": [{"id": "a", "constraints": [
+                {"type": "mandatory"}, {"type": "prohibited"}]}]
+        })
+        rc = main(["resolve", path, "--backend", "host"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "constraints not satisfiable" in out
+        assert "a is mandatory" in out
+        assert "a is prohibited" in out
+
+    def test_sat_json_output(self, tmp_path, capsys):
+        path = write_doc(tmp_path, {
+            "variables": [
+                {"id": "a", "constraints": [
+                    {"type": "mandatory"}, {"type": "dependency", "ids": ["b", "c"]}]},
+                {"id": "b"}, {"id": "c"},
+            ]
+        })
+        rc = main(["resolve", path, "--backend", "host", "--output", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["status"] == "sat"
+        # Preference: the first dependency candidate is selected
+        # (reference solve_test.go:151-158).
+        assert doc["selected"] == ["a", "b"]
+        assert doc["solution"] == {"a": True, "b": True, "c": False}
+
+    def test_batch_json_output(self, tmp_path, capsys):
+        path = write_doc(tmp_path, {"problems": [
+            {"variables": [{"id": "a", "constraints": [{"type": "mandatory"}]}]},
+            {"variables": [{"id": "b", "constraints": [
+                {"type": "mandatory"}, {"type": "prohibited"}]}]},
+        ]})
+        rc = main(["resolve", path, "--backend", "host", "--output", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert [r["status"] for r in doc["results"]] == ["sat", "unsat"]
+
+    def test_missing_file(self, tmp_path, capsys):
+        rc = main(["resolve", str(tmp_path / "nope.json")])
+        assert rc == 2
+        assert "no such file" in capsys.readouterr().err
+
+    def test_invalid_json(self, tmp_path, capsys):
+        path = tmp_path / "bad.json"
+        path.write_text("{nope")
+        rc = main(["resolve", str(path)])
+        assert rc == 2
+        assert "invalid JSON" in capsys.readouterr().err
+
+    def test_duplicate_identifier(self, tmp_path, capsys):
+        path = write_doc(tmp_path, {"variables": [{"id": "a"}, {"id": "a"}]})
+        rc = main(["resolve", path, "--backend", "host"])
+        assert rc == 2
+        assert "duplicate identifier" in capsys.readouterr().err
+
+    def test_device_backend_matches_host(self, tmp_path, capsys):
+        path = write_doc(tmp_path, {
+            "variables": [
+                {"id": "a", "constraints": [
+                    {"type": "mandatory"}, {"type": "dependency", "ids": ["b", "c"]}]},
+                {"id": "b"}, {"id": "c"},
+            ]
+        })
+        rc = main(["resolve", path, "--backend", "tpu", "--output", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["selected"] == ["a", "b"]
+
+    def test_no_command_prints_help(self, capsys):
+        assert main([]) == 0
+        assert "resolve" in capsys.readouterr().out
+
+    def test_single_problem_batch_keeps_results_shape(self, tmp_path, capsys):
+        # Output schema is a function of the input form: a batch document
+        # with one problem still yields {"results": [...]}.
+        path = write_doc(tmp_path, {"problems": [
+            {"variables": [{"id": "a", "constraints": [{"type": "mandatory"}]}]},
+        ]})
+        rc = main(["resolve", path, "--backend", "host", "--output", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert [r["status"] for r in doc["results"]] == ["sat"]
+
+    def test_incomplete_exit_code(self, tmp_path, capsys):
+        # A budget too small to finish the search reports incomplete (exit
+        # 3), distinct from unsat (exit 1).
+        path = write_doc(tmp_path, {
+            "variables": [
+                {"id": "a", "constraints": [
+                    {"type": "mandatory"},
+                    {"type": "dependency", "ids": ["b", "c"]}]},
+                {"id": "b", "constraints": [{"type": "dependency", "ids": ["d"]}]},
+                {"id": "c"}, {"id": "d", "constraints": [{"type": "conflict", "id": "c"}]},
+            ]
+        })
+        rc = main(["resolve", path, "--backend", "host", "--max-steps", "1"])
+        assert rc == 3
+        assert "resolution incomplete" in capsys.readouterr().out

@@ -1,0 +1,160 @@
+"""Scripted-outcome tests for the preference-ordered guess search.
+
+The reference drives ``search.Do`` against a generated fake solver whose
+``Test``/``Solve`` outcomes are scripted per call (search_test.go:31-106 +
+zz_search_test.go FakeS: ``TestReturnsOnCall(i, result)`` sequences), so
+the branch/backtrack driver is verified engine-free: candidate order,
+candidate advancement after unsat, children popped from the deque's back,
+and exhaustion → give-up.  This is the rebuild's equivalent: a HostEngine
+subclass whose ``_test`` and ``_dpll`` pop scripted outcomes and record
+the assumption set of every call.
+"""
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from deppy_tpu.sat.encode import encode
+from deppy_tpu.sat.host import SAT, UNKNOWN, UNSAT, HostEngine
+from deppy_tpu.sat.constraints import dependency, mandatory, variable
+
+
+class ScriptedEngine(HostEngine):
+    """HostEngine with scripted propagation outcomes.
+
+    ``script`` is consumed one entry per ``_test`` call; ``dpll_script``
+    one per ``_dpll`` call.  Every call records the guessed-variable
+    identifiers so tests can assert the exact search trajectory.
+    """
+
+    def __init__(self, problem, script: Sequence[int],
+                 dpll_script: Sequence[bool] = ()):
+        super().__init__(problem)
+        self.script = list(script)
+        self.dpll_script = list(dpll_script)
+        self.test_calls: List[Tuple[str, ...]] = []
+        self.dpll_calls: List[Tuple[str, ...]] = []
+
+    def _ids(self, idxs) -> Tuple[str, ...]:
+        return tuple(self.p.variables[int(i)].identifier for i in idxs)
+
+    def _test(self, guessed, **kwargs):
+        self.test_calls.append(self._ids(guessed))
+        assert self.script, "search made more _test calls than scripted"
+        outcome = self.script.pop(0)
+        # A fabricated total/empty assignment; the scripted driver tests
+        # never decode it.
+        assign = np.zeros(self.v, dtype=np.int8)
+        return outcome, assign
+
+    def _dpll(self, fixed_true=(), **kwargs):
+        self.dpll_calls.append(self._ids(fixed_true))
+        assert self.dpll_script, "search made more _dpll calls than scripted"
+        ok = self.dpll_script.pop(0)
+        return ok, (np.zeros(self.v, dtype=np.int8) if ok else None)
+
+
+def chain_problem():
+    """a (mandatory) depends on b or c — one anchor choice, one dependency
+    choice with two preference-ordered candidates."""
+    return encode([
+        variable("a", mandatory(), dependency("b", "c")),
+        variable("b"),
+        variable("c"),
+    ])
+
+
+class TestScriptedSearch:
+    def test_first_candidate_tried_first(self):
+        # UNKNOWN after guessing a, SAT after guessing its first candidate.
+        eng = ScriptedEngine(chain_problem(), script=[UNKNOWN, SAT])
+        result, assumed, _ = eng._search()
+        assert result == SAT
+        assert eng.test_calls == [("a",), ("a", "b")]
+        assert eng._ids(assumed) == ("a", "b")
+        assert eng.script == []  # scope balance: every scripted call consumed
+
+    def test_unsat_advances_to_next_candidate(self):
+        # b fails; the backtrack requeues the choice advanced by one
+        # candidate, and c succeeds (search.go:79-98 candidate increment).
+        eng = ScriptedEngine(
+            chain_problem(),
+            script=[UNKNOWN, UNSAT, UNKNOWN, SAT],
+        )
+        result, assumed, _ = eng._search()
+        assert result == SAT
+        assert eng.test_calls == [("a",), ("a", "b"), ("a",), ("a", "c")]
+        assert eng._ids(assumed) == ("a", "c")
+
+    def test_candidate_exhaustion_gives_up(self):
+        # Both candidates fail, the exhausted choice yields a null guess,
+        # the leaf _dpll refutes, and unwinding pops every guess: give up
+        # with UNSAT and an empty assumption set (search.go:172-179).
+        eng = ScriptedEngine(
+            chain_problem(),
+            script=[UNKNOWN, UNSAT, UNKNOWN, UNSAT, UNKNOWN, UNSAT],
+            dpll_script=[False],
+        )
+        result, assumed, _ = eng._search()
+        assert result == UNSAT
+        assert assumed == []
+        # Trajectory: guess a; try b (unsat); retest a; try c (unsat);
+        # retest a; exhausted choice -> null guess -> leaf dpll under {a};
+        # unsat pops a and retests empty.
+        assert eng.test_calls == [
+            ("a",), ("a", "b"), ("a",), ("a", "c"), ("a",), (),
+        ]
+        assert eng.dpll_calls == [("a",)]
+
+    def test_already_assumed_candidate_satisfies_choice(self):
+        # Two dependency constraints with a shared candidate: once b is
+        # assumed, the second choice is satisfied without a new guess or
+        # test call (search.go:55-60).
+        p = encode([
+            variable("a", mandatory(), dependency("b"), dependency("b", "c")),
+            variable("b"),
+            variable("c"),
+        ])
+        eng = ScriptedEngine(p, script=[UNKNOWN, SAT])
+        result, assumed, _ = eng._search()
+        assert result == SAT
+        # Only a and the first b-guess hit the engine; the second choice
+        # produced a null guess with no test.
+        assert eng.test_calls == [("a",), ("a", "b")]
+        assert eng._ids(assumed) == ("a", "b")
+
+    def test_backtrack_pops_children_from_deque_back(self):
+        # Nested dependencies: guessing x enqueues its dependency choice at
+        # the back; when x's guess is popped, that child choice is dropped
+        # with it (search.go:88-92) — so y's candidates are never probed
+        # after the pop.
+        p = encode([
+            variable("r", mandatory(), dependency("x", "z")),
+            variable("x", dependency("y")),
+            variable("y"),
+            variable("z"),
+        ])
+        eng = ScriptedEngine(
+            p,
+            # r unknown; x unsat -> pop x (dropping the y-choice it
+            # enqueued); retest r unknown; z sat.
+            script=[UNKNOWN, UNSAT, UNKNOWN, SAT],
+        )
+        result, assumed, _ = eng._search()
+        assert result == SAT
+        assert eng.test_calls == [("r",), ("r", "x"), ("r",), ("r", "z")]
+        assert eng._ids(assumed) == ("r", "z")
+        # y never appears in any probe: its choice died with x's guess.
+        assert all("y" not in call for call in eng.test_calls)
+
+    def test_unknown_everywhere_falls_to_leaf_dpll(self):
+        # The deque drains with outcome still UNKNOWN -> the full solver
+        # runs under the accumulated assumptions (search.go:167-169).
+        eng = ScriptedEngine(
+            chain_problem(),
+            script=[UNKNOWN, UNKNOWN],
+            dpll_script=[True],
+        )
+        result, assumed, _ = eng._search()
+        assert result == SAT
+        assert eng.dpll_calls == [("a", "b")]

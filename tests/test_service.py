@@ -1,0 +1,223 @@
+"""Batch-resolution service tests.
+
+The analog of the reference's deployable surface (main.go:46-86): health
+and readiness probes, Prometheus metrics, and the resolve API.  Servers
+bind port 0 so tests never collide.
+"""
+
+import json
+from http.client import HTTPConnection
+
+import pytest
+
+from deppy_tpu.service import Metrics, Server, _parse_addr
+
+
+@pytest.fixture()
+def server():
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host")
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def request(port, method, path, body=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=10)
+    headers = {"Content-Type": "application/json"} if body is not None else {}
+    conn.request(method, path, body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+class TestProbes:
+    def test_healthz(self, server):
+        status, body = request(server.probe_port, "GET", "/healthz")
+        assert (status, body) == (200, b"ok")
+
+    def test_readyz(self, server):
+        status, body = request(server.probe_port, "GET", "/readyz")
+        assert (status, body) == (200, b"ok")
+
+    def test_readyz_not_ready_after_shutdown_flag(self, server):
+        server.ready.clear()
+        status, _ = request(server.probe_port, "GET", "/readyz")
+        assert status == 503
+
+    def test_unknown_probe_path(self, server):
+        status, _ = request(server.probe_port, "GET", "/other")
+        assert status == 404
+
+
+class TestResolveAPI:
+    def test_resolve_sat(self, server):
+        status, data = request(server.api_port, "POST", "/v1/resolve", {
+            "variables": [
+                {"id": "a", "constraints": [
+                    {"type": "mandatory"},
+                    {"type": "dependency", "ids": ["b", "c"]}]},
+                {"id": "b"}, {"id": "c"},
+            ]
+        })
+        assert status == 200
+        doc = json.loads(data)
+        assert doc["results"][0]["status"] == "sat"
+        assert doc["results"][0]["selected"] == ["a", "b"]
+
+    def test_resolve_batch_mixed(self, server):
+        status, data = request(server.api_port, "POST", "/v1/resolve", {
+            "problems": [
+                {"variables": [{"id": "a", "constraints": [{"type": "mandatory"}]}]},
+                {"variables": [{"id": "b", "constraints": [
+                    {"type": "mandatory"}, {"type": "prohibited"}]}]},
+            ]
+        })
+        assert status == 200
+        doc = json.loads(data)
+        assert [r["status"] for r in doc["results"]] == ["sat", "unsat"]
+        assert doc["results"][1]["conflicts"] == [
+            "b is mandatory", "b is prohibited",
+        ]
+
+    def test_malformed_document(self, server):
+        status, data = request(server.api_port, "POST", "/v1/resolve",
+                               {"variables": "nope"})
+        assert status == 400
+        assert "error" in json.loads(data)
+
+    def test_invalid_json_body(self, server):
+        conn = HTTPConnection("127.0.0.1", server.api_port, timeout=10)
+        conn.request("POST", "/v1/resolve", body="{nope",
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 400
+        resp.read()
+        conn.close()
+
+    def test_unknown_path(self, server):
+        status, _ = request(server.api_port, "POST", "/other", {})
+        assert status == 404
+        status, _ = request(server.api_port, "GET", "/other")
+        assert status == 404
+
+
+class TestMetrics:
+    def test_counters_advance(self, server):
+        request(server.api_port, "POST", "/v1/resolve", {
+            "problems": [
+                {"variables": [{"id": "a", "constraints": [{"type": "mandatory"}]}]},
+                {"variables": [{"id": "b", "constraints": [
+                    {"type": "mandatory"}, {"type": "prohibited"}]}]},
+            ]
+        })
+        request(server.api_port, "POST", "/v1/resolve", {"variables": "nope"})
+        status, data = request(server.api_port, "GET", "/metrics")
+        assert status == 200
+        text = data.decode()
+        assert 'deppy_resolutions_total{outcome="sat"} 1' in text
+        assert 'deppy_resolutions_total{outcome="unsat"} 1' in text
+        assert "deppy_batches_total 1" in text
+        assert "deppy_request_errors_total 1" in text
+
+    def test_render_format(self):
+        m = Metrics()
+        m.observe_batch({"sat": 3}, 0.5, steps=42)
+        text = m.render()
+        assert "# TYPE deppy_resolutions_total counter" in text
+        assert "deppy_engine_steps_total 42" in text
+
+
+def test_parse_addr():
+    assert _parse_addr(":8080") == ("0.0.0.0", 8080)
+    assert _parse_addr("127.0.0.1:0") == ("127.0.0.1", 0)
+    assert _parse_addr("9090") == ("0.0.0.0", 9090)
+    assert _parse_addr("[::1]:8080") == ("::1", 8080)
+    with pytest.raises(ValueError, match="invalid listen address"):
+        _parse_addr("localhost")
+
+
+def test_incomplete_counted_per_problem(tmp_path):
+    # A batch where one problem exhausts the budget: completed batchmates
+    # still report sat; only the straggler counts as incomplete.
+    # Budget of 3: enough for the trivial problem (2 steps) but not the
+    # search-heavy one (5 steps).
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host", max_steps=3)
+    srv.start()
+    try:
+        status, data = request(srv.api_port, "POST", "/v1/resolve", {
+            "problems": [
+                {"variables": [{"id": "a", "constraints": [{"type": "mandatory"}]}]},
+                {"variables": [
+                    {"id": "x", "constraints": [
+                        {"type": "mandatory"},
+                        {"type": "dependency", "ids": ["y", "z"]}]},
+                    {"id": "y", "constraints": [{"type": "dependency", "ids": ["w"]}]},
+                    {"id": "z"},
+                    {"id": "w", "constraints": [{"type": "conflict", "id": "z"}]},
+                ]},
+            ]
+        })
+        assert status == 200
+        doc = json.loads(data)
+        assert doc["results"][0]["status"] == "sat"
+        assert doc["results"][1]["status"] == "incomplete"
+        _, mdata = request(srv.api_port, "GET", "/metrics")
+        text = mdata.decode()
+        assert 'deppy_resolutions_total{outcome="sat"} 1' in text
+        assert 'deppy_resolutions_total{outcome="incomplete"} 1' in text
+    finally:
+        srv.shutdown()
+
+
+def test_probe_port_conflict_does_not_leak_api_socket():
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host")
+    try:
+        with pytest.raises(OSError):
+            Server(bind_address="127.0.0.1:0",
+                   probe_address=f"127.0.0.1:{srv.api_port}",
+                   backend="host")
+        # The failed construction must not hold its API port open.
+        retry = Server(bind_address="127.0.0.1:0",
+                       probe_address="127.0.0.1:0", backend="host")
+        retry.shutdown()
+    finally:
+        srv.shutdown()
+
+
+def test_ipv6_bind():
+    try:
+        srv = Server(bind_address="[::1]:0", probe_address="[::1]:0",
+                     backend="host")
+    except OSError:
+        pytest.skip("IPv6 loopback unavailable")
+    srv.start()
+    try:
+        conn = __import__("http.client", fromlist=["HTTPConnection"]).HTTPConnection(
+            "::1", srv.probe_port, timeout=10)
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().status == 200
+        conn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_engine_steps_metric_advances(server):
+    request(server.api_port, "POST", "/v1/resolve", {
+        "variables": [
+            {"id": "a", "constraints": [
+                {"type": "mandatory"},
+                {"type": "dependency", "ids": ["b", "c"]}]},
+            {"id": "b", "constraints": [{"type": "conflict", "id": "d"}]},
+            {"id": "c", "constraints": [{"type": "dependency", "ids": ["d"]}]},
+            {"id": "d"},
+        ]
+    })
+    _, data = request(server.api_port, "GET", "/metrics")
+    steps = [l for l in data.decode().splitlines()
+             if l.startswith("deppy_engine_steps_total")]
+    assert steps and int(steps[0].split()[-1]) > 0
