@@ -22,7 +22,7 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
     """Measure a list of lowered problems: host ms/problem (serial,
     sampled), device rate (batched, post-warm-up).  Returns the raw
     numbers; callers shape them into their own output records."""
-    from ..engine import driver
+    from ..engine import core, driver
     from ..sat.errors import NotSatisfiable
     from ..sat.host import HostEngine
 
@@ -48,8 +48,8 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
     t0 = time.perf_counter()
     results = driver.solve_problems(problems, mesh=mesh)
     dev_s = time.perf_counter() - t0
-    n_sat = sum(1 for r in results if r.outcome == 1)
-    n_unsat = sum(1 for r in results if r.outcome == -1)
+    n_sat = sum(1 for r in results if r.outcome == core.SAT)
+    n_unsat = sum(1 for r in results if r.outcome == core.UNSAT)
     rate = n / dev_s
     log(
         f"device: {n} in {dev_s:.3f}s = {rate:.1f}/s "

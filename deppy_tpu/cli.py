@@ -92,6 +92,9 @@ def _cmd_resolve(args) -> int:
     except FileNotFoundError:
         print(f"error: no such file: {args.file}", file=sys.stderr)
         return 2
+    except OSError as e:
+        print(f"error: cannot read {args.file}: {e}", file=sys.stderr)
+        return 2
     except problem_io.ProblemFormatError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

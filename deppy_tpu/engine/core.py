@@ -318,7 +318,7 @@ def _resolved_impl() -> str:
 
 
 def _bcp_gather(pt: ProblemTensors, assign: jax.Array,
-                min_mask: jax.Array, min_w: jax.Array
+                min_mask: jax.Array, min_w: jax.Array, enabled: jax.Array
                 ) -> Tuple[jax.Array, jax.Array]:
     def cond(state):
         conflict, _, changed = state
@@ -328,14 +328,14 @@ def _bcp_gather(pt: ProblemTensors, assign: jax.Array,
         _, a, _ = state
         return bcp_round(pt, a, min_mask, min_w)
 
-    state = (jnp.bool_(False), assign, jnp.bool_(True))
+    state = (jnp.bool_(False), assign, enabled)
     conflict, assign, _ = lax.while_loop(cond, body, state)
     return conflict, assign
 
 
 def _bcp_planes(pt: ProblemTensors, assign: jax.Array,
-                min_mask: jax.Array, min_w: jax.Array, use_pallas: bool
-                ) -> Tuple[jax.Array, jax.Array]:
+                min_mask: jax.Array, min_w: jax.Array, use_pallas: bool,
+                enabled: jax.Array) -> Tuple[jax.Array, jax.Array]:
     V = assign.shape[0]
     Wv = pt.pos_bits.shape[1]
     t = pack_mask(assign == TRUE, Wv)
@@ -347,7 +347,7 @@ def _bcp_planes(pt: ProblemTensors, assign: jax.Array,
 
         conflict, t, f = pallas_bcp.bcp_fixpoint(
             pt.pos_bits, pt.neg_bits, pt.card_member_bits, pt.card_act_bits,
-            card_n2, min_bits, min_w, t, f,
+            card_n2, min_bits, min_w, t, f, enabled,
         )
     else:
         def cond(state):
@@ -361,7 +361,7 @@ def _bcp_planes(pt: ProblemTensors, assign: jax.Array,
                 pt.card_act_bits, card_n2, min_bits, min_w, t, f,
             )
 
-        state = (jnp.bool_(False), t, f, jnp.bool_(True))
+        state = (jnp.bool_(False), t, f, enabled)
         conflict, t, f, _ = lax.while_loop(cond, body, state)
     tb = unpack_mask(t, V)
     fb = unpack_mask(f, V)
@@ -372,32 +372,43 @@ def _bcp_planes(pt: ProblemTensors, assign: jax.Array,
 
 
 def bcp(pt: ProblemTensors, assign: jax.Array,
-        min_mask: jax.Array, min_w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        min_mask: jax.Array, min_w: jax.Array,
+        enabled: jax.Array = jnp.bool_(True)) -> Tuple[jax.Array, jax.Array]:
     """Propagate to fixpoint (the analog of gini ``Test`` propagation;
     host reference: HostEngine._bcp).  Returns (conflict, assignment).
     Dispatches to the implementation chosen by :func:`set_bcp_impl` /
-    ``DEPPY_TPU_BCP``."""
+    ``DEPPY_TPU_BCP``.
+
+    ``enabled`` seeds the fixpoint loop's ``changed`` flag: a disabled lane
+    runs **zero** rounds.  This is the lane-gating idiom used throughout
+    the engine — under ``vmap``, ``lax.cond``/``lax.switch`` lower to
+    select (every branch executes for every lane), so skipping work must be
+    expressed as a ``while_loop`` whose condition is immediately false for
+    inactive lanes."""
     impl = _resolved_impl()
     if impl == "gather":
-        return _bcp_gather(pt, assign, min_mask, min_w)
-    return _bcp_planes(pt, assign, min_mask, min_w, use_pallas=impl == "pallas")
+        return _bcp_gather(pt, assign, min_mask, min_w, enabled)
+    return _bcp_planes(pt, assign, min_mask, min_w,
+                       use_pallas=impl == "pallas", enabled=enabled)
 
 
 # --------------------------------------------------------------------------
 # Test
 
 
-def run_test(pt: ProblemTensors, assumed: jax.Array, V: int, NCON: int
+def run_test(pt: ProblemTensors, assumed: jax.Array, V: int, NCON: int,
+             enabled: jax.Array = jnp.bool_(True)
              ) -> Tuple[jax.Array, jax.Array]:
     """Propagation-only check of the current assumption set — the analog of
     gini's ``Test`` (solve.go:79, search.go:76): anchors + activations +
     guessed variables assumed, then BCP; SAT only when propagation alone
-    totalizes the problem-var region."""
+    totalizes the problem-var region.  A disabled lane runs zero BCP rounds
+    and its outcome must be discarded by the caller."""
     a = _base_assignment(pt, V, NCON)
     a = _apply_anchors(pt, a, V)
     a = jnp.where(assumed, jnp.int32(TRUE), a)
     no_min = jnp.zeros(V, bool)
-    conflict, a = bcp(pt, a, no_min, jnp.int32(0))
+    conflict, a = bcp(pt, a, no_min, jnp.int32(0), enabled=enabled)
     idx = jnp.arange(V, dtype=jnp.int32)
     all_assigned = ((idx >= pt.n_vars) | (a != UNASSIGNED)).all()
     outcome = jnp.where(
@@ -411,7 +422,8 @@ def run_test(pt: ProblemTensors, assumed: jax.Array, V: int, NCON: int
 
 
 def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
-         min_w: jax.Array, budget: jax.Array, steps: jax.Array, NV: int
+         min_w: jax.Array, budget: jax.Array, steps: jax.Array, NV: int,
+         enabled: jax.Array = jnp.bool_(True)
          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Complete search under the fixed partial assignment ``init`` — the
     analog of gini ``Solve()`` (search.go:168, solve.go:107) and of
@@ -419,7 +431,10 @@ def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
     problem variable, chronological backtracking that flips the deepest
     unflipped decision.  Each iteration rebuilds the assignment from
     ``init`` plus the decision stack and re-propagates — fixed-shape state,
-    no snapshot stack.  Returns (status, model, steps)."""
+    no snapshot stack.  Returns (status, model, steps).
+
+    A disabled lane runs zero iterations and returns status RUNNING; the
+    caller must discard it (see :func:`bcp` for the lane-gating idiom)."""
     V = init.shape[0]
     idxV = jnp.arange(V, dtype=jnp.int32)
     lvl = jnp.arange(NV, dtype=jnp.int32)
@@ -457,7 +472,7 @@ def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
 
     def cond(st):
         _, _, _, status, _, steps = st
-        return (status == RUNNING) & (steps <= budget)
+        return enabled & (status == RUNNING) & (steps <= budget)
 
     st = (
         jnp.zeros(NV, jnp.int32),
@@ -476,7 +491,8 @@ def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
 
 
 def search(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
-           V: int, NCON: int, NV: int
+           V: int, NCON: int, NV: int,
+           enabled: jax.Array = jnp.bool_(True)
            ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """The reference guess search (search.go:158-203; host: _search).
 
@@ -484,17 +500,24 @@ def search(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
     (choice row, candidate index) pairs with capacity NC+1 (each choice row
     lives in at most one place at a time — deque or guess stack); the guess
     stack holds (choice, index, var, children).  One loop iteration executes
-    exactly one arm of the reference loop, selected by ``lax.switch`` in the
-    reference's precedence order:
+    exactly one arm of the reference loop, in the reference's precedence
+    order:
 
       0. deque empty, outcome unknown  → full DPLL solve  (search.go:167-169)
       1. outcome unsat                 → backtrack / give up (:172-179)
       2. deque empty, outcome sat      → done              (:182-184)
       3. otherwise                     → push next guess   (:187, :34-77)
 
+    The arms are *not* dispatched through ``lax.switch``: under ``vmap``
+    switch lowers to select, which would execute a full DPLL solve plus two
+    BCP fixpoints on every iteration of every lane.  Instead the body
+    computes every arm's (cheap) bookkeeping with masked selects and runs
+    exactly one lane-gated DPLL and one lane-gated propagation fixpoint per
+    iteration — the expensive ops cost nothing on lanes whose arm doesn't
+    need them.
+
     Returns (result, guessed_mask, model, steps)."""
     NC, Kc = pt.choice_cand.shape
-    W = pt.var_choices.shape[1]
     DQ = NC + 1
     GS = NC + 1
     dq_pos = jnp.arange(DQ, dtype=jnp.int32)
@@ -502,128 +525,104 @@ def search(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
     na = (pt.anchors >= 0).sum().astype(jnp.int32)
     # Anchor choice rows are rows 0..na-1 of the choice table, seeded in
     # input order (search.go:159-161).
-    dq_c = jnp.where(dq_pos < na, dq_pos, 0)
-    dq_i = jnp.zeros(DQ, jnp.int32)
+    dq_c0 = jnp.where(dq_pos < na, dq_pos, 0)
+    dq_i0 = jnp.zeros(DQ, jnp.int32)
 
-    State = Tuple  # noqa: N806 - documentation alias
-
-    def arm_leaf(st):
-        """Deque empty & unknown: run the complete solver (search.go:167-169)."""
+    def body(st):
         (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
          result, model, assumed, done, steps) = st
+
+        # Arm selection (mutually exclusive; reference precedence order).
+        is_leaf = (cnt == 0) & (result == RUNNING)
+        is_bt = ~is_leaf & (result == UNSAT)
+        is_done = ~is_leaf & ~is_bt & (cnt == 0)
+        is_push = ~is_leaf & ~is_bt & ~is_done
+
+        # --- arm 0: leaf DPLL (search.go:167-169), lane-gated -----------
         init = _base_assignment(pt, V, NCON)
         init = _apply_anchors(pt, init, V)
         init = jnp.where(assumed, jnp.int32(TRUE), init)
         no_min = jnp.zeros(V, bool)
-        status, m, steps = dpll(pt, init, no_min, jnp.int32(0), budget, steps, NV)
-        result = status
-        model = jnp.where(status == SAT, m, model)
+        leaf_status, leaf_model, steps = dpll(
+            pt, init, no_min, jnp.int32(0), budget, steps, NV, enabled=is_leaf
+        )
+        result = jnp.where(is_leaf, leaf_status, result)
+        model = jnp.where(is_leaf & (leaf_status == SAT), leaf_model, model)
         # Budget exhaustion leaves status RUNNING; the outer cond exits.
-        return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-                result, model, assumed, done, steps)
 
-    def arm_backtrack(st):
-        """Unsat: pop the last guess, requeue its choice advanced by one
-        candidate, drop its children from the deque's back
-        (PopGuess, search.go:79-98); give up when the stack is empty."""
-        (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-         result, model, assumed, done, steps) = st
-        give_up = gsp == 0
-
+        # --- arm 1: backtrack bookkeeping (PopGuess, search.go:79-98) ---
+        give_up = is_bt & (gsp == 0)
+        bt = is_bt & ~give_up
         gsp2 = gsp - 1
         gc = g_c[jnp.clip(gsp2, 0)]
         gi = g_i[jnp.clip(gsp2, 0)]
         gv = g_v[jnp.clip(gsp2, 0)]
         gch = g_ch[jnp.clip(gsp2, 0)]
-        cnt2 = cnt - gch                      # children drop off the back
-        head2 = jnp.mod(head - 1, DQ)         # requeue at the front
-        dq_c2 = dq_c.at[head2].set(gc)
-        dq_i2 = dq_i.at[head2].set(gi + (gv >= 0).astype(jnp.int32))
-        cnt2 = cnt2 + 1
-        assumed2 = jnp.where(
-            gv >= 0, assumed.at[jnp.clip(gv, 0)].set(False), assumed
-        )
-        outcome, a = run_test(pt, assumed2, V, NCON)
-        # Only a real (var >= 0) un-guess re-tests; popping a null guess
-        # leaves the unsat outcome standing so popping continues.
-        result2 = jnp.where(gv >= 0, outcome, result)
-        model2 = jnp.where((gv >= 0) & (outcome == SAT), a, model)
+        head_bt = jnp.mod(head - 1, DQ)  # requeue popped choice at the front
 
-        def keep(_):
-            return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-                    result, model, assumed, jnp.bool_(True), steps)
-
-        def popped(_):
-            return (dq_c2, dq_i2, head2, cnt2, g_c, g_i, g_v, g_ch, gsp2,
-                    result2, model2, assumed2, done, steps + 1)
-
-        return lax.cond(give_up, keep, popped, None)
-
-    def arm_done(st):
-        (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-         result, model, assumed, done, steps) = st
-        return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-                result, model, assumed, jnp.bool_(True), steps)
-
-    def arm_push(st):
-        """PushGuess (search.go:34-77): pop the front choice, pick its next
-        candidate (skipped entirely if some candidate is already assumed),
-        enqueue the guessed variable's own dependency choices at the back,
-        assume and re-test."""
-        (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-         result, model, assumed, done, steps) = st
-        cid = dq_c[head]
-        idx = dq_i[head]
-        head = jnp.mod(head + 1, DQ)
-        cnt = cnt - 1
-
-        cands = pt.choice_cand[cid]                       # i32[Kc]
+        # --- arm 3: push bookkeeping (PushGuess, search.go:34-77) -------
+        cid = dq_c[jnp.clip(head, 0, DQ - 1)]
+        idx = dq_i[jnp.clip(head, 0, DQ - 1)]
+        head_push = jnp.mod(head + 1, DQ)
+        cands = pt.choice_cand[jnp.clip(cid, 0, NC - 1)]   # i32[Kc]
         ncand = (cands >= 0).sum()
         cand_var = cands[jnp.clip(idx, 0, Kc - 1)]
         var = jnp.where(idx < ncand, cand_var, -1)
         already = ((cands >= 0) & assumed[jnp.clip(cands, 0)]).any()
         var = jnp.where(already, jnp.int32(-1), var)
-
         ch_row = pt.var_choices[jnp.clip(var, 0)]          # i32[W]
-        valid_ch = (var >= 0) & (ch_row >= 0)
+        valid_ch = is_push & (var >= 0) & (ch_row >= 0)
         nch = valid_ch.sum().astype(jnp.int32)
         offs = jnp.cumsum(valid_ch.astype(jnp.int32)) - valid_ch.astype(jnp.int32)
-        pos = jnp.mod(head + cnt + offs, DQ)
+        pos = jnp.mod(head_push + (cnt - 1) + offs, DQ)
+
+        # --- merged state updates (each write gated by its arm) ---------
+        head = jnp.where(bt, head_bt, jnp.where(is_push, head_push, head))
+        cnt = jnp.where(bt, cnt - gch + 1,
+                        jnp.where(is_push, cnt - 1 + nch, cnt))
+        # Backtrack: requeue the popped choice, its candidate index
+        # advanced past a real guess (children died with the pop — the
+        # cnt shrink above removes them from the live window).
+        dq_c = dq_c.at[jnp.where(bt, head_bt, DQ)].set(gc, mode="drop")
+        dq_i = dq_i.at[jnp.where(bt, head_bt, DQ)].set(
+            gi + (gv >= 0).astype(jnp.int32), mode="drop")
+        # Push: enqueue the guessed variable's dependency choices.
         tgt = jnp.where(valid_ch, pos, DQ)
         dq_c = dq_c.at[tgt].set(ch_row, mode="drop")
         dq_i = dq_i.at[tgt].set(0, mode="drop")
-        cnt = cnt + nch
+        # Push always records a guess entry, null (var == -1) or not.
+        g_idx = jnp.where(is_push, jnp.clip(gsp, 0, GS - 1), GS)
+        g_c = g_c.at[g_idx].set(cid, mode="drop")
+        g_i = g_i.at[g_idx].set(idx, mode="drop")
+        g_v = g_v.at[g_idx].set(var, mode="drop")
+        g_ch = g_ch.at[g_idx].set(nch, mode="drop")
+        gsp = jnp.where(bt, gsp2, jnp.where(is_push, gsp + 1, gsp))
 
-        g_c = g_c.at[jnp.clip(gsp, 0, GS - 1)].set(cid)
-        g_i = g_i.at[jnp.clip(gsp, 0, GS - 1)].set(idx)
-        g_v = g_v.at[jnp.clip(gsp, 0, GS - 1)].set(var)
-        g_ch = g_ch.at[jnp.clip(gsp, 0, GS - 1)].set(nch)
-        gsp = gsp + 1
+        assumed = assumed.at[jnp.where(bt & (gv >= 0), jnp.clip(gv, 0), V)
+                             ].set(False, mode="drop")
+        assumed = assumed.at[jnp.where(is_push & (var >= 0), jnp.clip(var, 0), V)
+                             ].set(True, mode="drop")
 
-        assumed = jnp.where(
-            var >= 0, assumed.at[jnp.clip(var, 0)].set(True), assumed
-        )
-        outcome, a = run_test(pt, assumed, V, NCON)
-        result = jnp.where(var >= 0, outcome, result)
-        model = jnp.where((var >= 0) & (outcome == SAT), a, model)
+        # One lane-gated propagation test per iteration: a backtrack that
+        # un-assumed a real variable, or a push that assumed one.  Popping
+        # or pushing a null guess leaves the prior outcome standing
+        # (search.go:55-60; a standing UNSAT keeps the pop loop going).
+        test_en = (bt & (gv >= 0)) | (is_push & (var >= 0))
+        outcome, a = run_test(pt, assumed, V, NCON, enabled=test_en)
+        result = jnp.where(test_en, outcome, result)
+        model = jnp.where(test_en & (outcome == SAT), a, model)
+
+        done = done | give_up | is_done
+        steps = steps + (bt | is_push).astype(jnp.int32)
         return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-                result, model, assumed, done, steps + 1)
-
-    def body(st):
-        (_, _, _, cnt, _, _, _, _, _, result, _, _, _, _) = st
-        arm = jnp.where(
-            (cnt == 0) & (result == RUNNING),
-            0,
-            jnp.where(result == UNSAT, 1, jnp.where(cnt == 0, 2, 3)),
-        )
-        return lax.switch(arm, [arm_leaf, arm_backtrack, arm_done, arm_push], st)
+                result, model, assumed, done, steps)
 
     def cond(st):
         (_, _, _, _, _, _, _, _, _, _, _, _, done, steps) = st
-        return ~done & (steps <= budget)
+        return enabled & ~done & (steps <= budget)
 
     st = (
-        dq_c, dq_i, jnp.int32(0), na,
+        dq_c0, dq_i0, jnp.int32(0), na,
         jnp.zeros(GS, jnp.int32), jnp.zeros(GS, jnp.int32),
         jnp.zeros(GS, jnp.int32), jnp.zeros(GS, jnp.int32), jnp.int32(0),
         jnp.int32(RUNNING), jnp.zeros(V, jnp.int32), jnp.zeros(V, bool),
@@ -643,85 +642,84 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
                *, V: int, NCON: int, NV: int) -> SolveResult:
     """One problem end to end (host: HostEngine.solve; reference
     solve.go:53-119): baseline Test, guess search if undetermined,
-    extras-only minimization on SAT, deletion-based core on UNSAT."""
+    extras-only minimization on SAT, deletion-based core on UNSAT.
+
+    Every phase runs unconditionally but lane-gated: under ``vmap`` a
+    ``lax.cond`` would execute both branches for every lane anyway (select
+    semantics), so the phases instead take an ``enabled`` flag that makes
+    their loops trip zero times on lanes that don't need them — a SAT lane
+    pays nothing for core extraction, an UNSAT lane nothing for
+    minimization."""
     idxV = jnp.arange(V, dtype=jnp.int32)
     pv_mask = idxV < pt.n_vars
     steps0 = jnp.int32(1)
     outcome0, a0 = run_test(pt, jnp.zeros(V, bool), V, NCON)
 
-    def do_search(_):
-        return search(pt, budget, steps0, V, NCON, NV)
-
-    def skip_search(_):
-        # Baseline already decided: the anchors play the guess-set role for
-        # minimization (solve.go:77-83).
-        return outcome0, _anchor_mask(pt, V), a0, steps0
-
-    result, guessed, model, steps = lax.cond(
-        outcome0 == RUNNING, do_search, skip_search, None
+    # ---- guess search when the baseline Test is undetermined ----
+    need_search = outcome0 == RUNNING
+    s_result, s_guessed, s_model, steps = search(
+        pt, budget, steps0, V, NCON, NV, enabled=need_search
     )
+    result = jnp.where(need_search, s_result, outcome0)
+    # Baseline already decided: the anchors play the guess-set role for
+    # minimization (solve.go:77-83).
+    guessed = jnp.where(need_search, s_guessed, _anchor_mask(pt, V))
+    model = jnp.where(need_search, s_model, a0)
 
     # ---- SAT: extras-only cardinality minimization (solve.go:86-113) ----
-    def minimize(steps):
-        extras = (model == TRUE) & ~guessed & pv_mask
-        excluded = (model != TRUE) & ~guessed & pv_mask
-        init = _base_assignment(pt, V, NCON)
-        init = _apply_anchors(pt, init, V)
-        init = jnp.where(guessed, jnp.int32(TRUE), init)
-        init = jnp.where(excluded, jnp.int32(FALSE), init)
-        n_extras = extras.sum()
+    sat_en = result == SAT
+    extras = (model == TRUE) & ~guessed & pv_mask
+    excluded = (model != TRUE) & ~guessed & pv_mask
+    m_init = _base_assignment(pt, V, NCON)
+    m_init = _apply_anchors(pt, m_init, V)
+    m_init = jnp.where(guessed, jnp.int32(TRUE), m_init)
+    m_init = jnp.where(excluded, jnp.int32(FALSE), m_init)
+    n_extras = extras.sum()
 
-        def mcond(c):
-            w, found, _, steps = c
-            return ~found & (w <= n_extras) & (steps <= budget)
+    def mcond(c):
+        w, found, _, steps = c
+        return sat_en & ~found & (w <= n_extras) & (steps <= budget)
 
-        def mbody(c):
-            w, found, m2, steps = c
-            status, m, steps = dpll(pt, init, extras, w, budget, steps, NV)
-            found = status == SAT
-            m2 = jnp.where(found, m, m2)
-            return w + 1, found, m2, steps
+    def mbody(c):
+        w, found, m2, steps = c
+        status, m, steps = dpll(pt, m_init, extras, w, budget, steps, NV,
+                                enabled=sat_en)
+        found = status == SAT
+        m2 = jnp.where(found, m, m2)
+        return w + 1, found, m2, steps
 
-        _, found, m2, steps = lax.while_loop(
-            mcond, mbody, (jnp.int32(0), jnp.bool_(False), model, steps)
-        )
-        installed = (m2 == TRUE) & pv_mask & found
-        return installed, found, steps
-
-    def skip_minimize(steps):
-        return jnp.zeros(V, bool), jnp.bool_(False), steps
-
-    installed, min_found, steps = lax.cond(
-        result == SAT, minimize, skip_minimize, steps
+    _, min_found, m2, steps = lax.while_loop(
+        mcond, mbody, (jnp.int32(0), jnp.bool_(False), model, steps)
     )
+    installed = (m2 == TRUE) & pv_mask & min_found & sat_en
 
     # ---- UNSAT: deletion-based unsat-core minimization ----
     # Start from all applied constraints active and drop any whose removal
     # keeps the remainder unsatisfiable (host: _unsat_core; the analog of
     # gini's failed-assumption Why, lit_mapping.go:198-207).
-    def core_fn(steps):
-        active = jnp.arange(NCON, dtype=jnp.int32) < pt.n_cons
+    unsat_en = result == UNSAT
+    active0 = (jnp.arange(NCON, dtype=jnp.int32) < pt.n_cons) & unsat_en
 
-        def cbody(j, c):
-            active, steps = c
-            trial = active.at[j].set(False)
-            init = _base_assignment(pt, V, NCON, act_enabled=trial)
-            no_min = jnp.zeros(V, bool)
-            status, _, steps = dpll(pt, init, no_min, jnp.int32(0), budget, steps, NV)
-            drop = (j < pt.n_cons) & (status == UNSAT)
-            active = jnp.where(drop, trial, active)
-            return active, steps
+    def ccond(c):
+        j, _, steps = c
+        return unsat_en & (j < pt.n_cons) & (steps <= budget)
 
-        active, steps = lax.fori_loop(0, NCON, cbody, (active, steps))
-        return active, steps
+    def cbody(c):
+        j, active, steps = c
+        trial = active.at[j].set(False)
+        init = _base_assignment(pt, V, NCON, act_enabled=trial)
+        no_min = jnp.zeros(V, bool)
+        status, _, steps = dpll(pt, init, no_min, jnp.int32(0), budget,
+                                steps, NV, enabled=unsat_en)
+        active = jnp.where(status == UNSAT, trial, active)
+        return j + 1, active, steps
 
-    def skip_core(steps):
-        return jnp.zeros(NCON, bool), steps
-
-    core, steps = lax.cond(result == UNSAT, core_fn, skip_core, steps)
+    _, core, steps = lax.while_loop(
+        ccond, cbody, (jnp.int32(0), active0, steps)
+    )
 
     incomplete = (steps > budget) | (result == RUNNING) | (
-        (result == SAT) & ~min_found
+        sat_en & ~min_found
     )
     outcome = jnp.where(incomplete, jnp.int32(RUNNING), result)
     return SolveResult(outcome=outcome, installed=installed, core=core, steps=steps)

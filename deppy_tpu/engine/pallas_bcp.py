@@ -47,7 +47,7 @@ from jax.experimental.pallas import tpu as pltpu
 from . import core
 
 
-def _kernel(minw_ref, pos_ref, neg_ref, mem_ref, act_ref, cardn_ref,
+def _kernel(minw_ref, en_ref, pos_ref, neg_ref, mem_ref, act_ref, cardn_ref,
             min_ref, t0_ref, f0_ref, conf_ref, t_ref, f_ref):
     pos = pos_ref[:]
     neg = neg_ref[:]
@@ -67,21 +67,26 @@ def _kernel(minw_ref, pos_ref, neg_ref, mem_ref, act_ref, cardn_ref,
             pos, neg, mem, act, card_n2, min_bits, min_w, t, f
         )
 
-    state = (jnp.bool_(False), t0_ref[:], f0_ref[:], jnp.bool_(True))
+    # The lane-gating flag seeds `changed`: a disabled lane runs zero
+    # rounds (see core.bcp).
+    state = (jnp.bool_(False), t0_ref[:], f0_ref[:], en_ref[0, 0] != 0)
     conflict, t, f, _ = lax.while_loop(cond, body, state)
     conf_ref[0, 0] = conflict.astype(jnp.int32)
     t_ref[:] = t
     f_ref[:] = f
 
 
-def bcp_fixpoint(pos, neg, mem, act, card_n2, min_bits, min_w, t0, f0):
+def bcp_fixpoint(pos, neg, mem, act, card_n2, min_bits, min_w, t0, f0,
+                 enabled=True):
     """Run BCP to fixpoint on bitplanes.  Shapes as in
     :func:`deppy_tpu.engine.core.round_planes`; returns (conflict, t, f).
     Interprets on non-TPU backends so the same code path is testable on the
     CPU mesh used by the test suite."""
     Wv = pos.shape[1]
     minw2 = jnp.full((1, 1), min_w, jnp.int32)
+    en2 = jnp.full((1, 1), enabled, jnp.int32)
     vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec((1, 1), memory_space=pltpu.SMEM)
     conf, t, f = pl.pallas_call(
         _kernel,
         out_shape=(
@@ -90,7 +95,7 @@ def bcp_fixpoint(pos, neg, mem, act, card_n2, min_bits, min_w, t0, f0):
             jax.ShapeDtypeStruct((1, Wv), jnp.int32),
         ),
         in_specs=[
-            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+            smem, smem,
             vmem, vmem, vmem, vmem, vmem, vmem, vmem, vmem,
         ],
         out_specs=(
@@ -99,5 +104,5 @@ def bcp_fixpoint(pos, neg, mem, act, card_n2, min_bits, min_w, t0, f0):
             vmem,
         ),
         interpret=jax.default_backend() != "tpu",
-    )(minw2, pos, neg, mem, act, card_n2, min_bits, t0, f0)
+    )(minw2, en2, pos, neg, mem, act, card_n2, min_bits, t0, f0)
     return conf[0, 0] != 0, t, f

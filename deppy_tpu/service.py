@@ -38,9 +38,31 @@ class _V6HTTPServer(ThreadingHTTPServer):
     address_family = socket.AF_INET6
 
 
+class _DualStackHTTPServer(_V6HTTPServer):
+    """Wildcard '::' bind accepting both IPv6 and IPv4-mapped connections —
+    what the reference's Go ':8080' listeners do.  Keeps the shipped
+    Deployment's probes working on IPv6-only clusters."""
+
+    def server_bind(self):
+        try:
+            self.socket.setsockopt(
+                socket.IPPROTO_IPV6, socket.IPV6_V6ONLY, 0
+            )
+        except OSError:  # pragma: no cover - platform without the option
+            pass
+        super().server_bind()
+
+
 def _make_http_server(addr: Tuple[str, int], handler) -> ThreadingHTTPServer:
-    """Bind a threading HTTP server on an IPv4 or IPv6 address."""
-    host, _ = addr
+    """Bind a threading HTTP server: explicit IPv4/IPv6 hosts get their
+    family; an empty host (the ':8080' form) binds dual-stack, falling back
+    to IPv4 wildcard where IPv6 is unavailable."""
+    host, port = addr
+    if host == "":
+        try:
+            return _DualStackHTTPServer(("::", port), handler)
+        except OSError:
+            return ThreadingHTTPServer(("0.0.0.0", port), handler)
     if ":" in host:  # IPv6 literal (brackets already stripped by _parse_addr)
         return _V6HTTPServer(addr, handler)
     return ThreadingHTTPServer(addr, handler)
@@ -202,7 +224,9 @@ def _parse_addr(addr: str) -> Tuple[str, int]:
             f"invalid listen address {addr!r}: want ':PORT', 'HOST:PORT', "
             "or a bare port number"
         ) from None
-    return host or "0.0.0.0", port_n
+    # Empty host stays empty: _make_http_server turns it into a dual-stack
+    # wildcard bind (the Go ':8080' behavior).
+    return host, port_n
 
 
 def _api_handler(server: Server):
@@ -239,7 +263,13 @@ def _api_handler(server: Server):
                 server.metrics.observe_error()
                 self._send_json(400, {"error": f"invalid JSON body: {e}"})
                 return
-            status, resp = server.resolve_document(doc)
+            try:
+                status, resp = server.resolve_document(doc)
+            except Exception as e:  # solver/runtime failure → a real 500,
+                # visible to the caller and the error counter, instead of a
+                # dropped connection from the handler's default traceback.
+                server.metrics.observe_error()
+                status, resp = 500, {"error": f"internal error: {e}"}
             self._send_json(status, resp)
 
     return Handler

@@ -131,12 +131,44 @@ class TestMetrics:
 
 
 def test_parse_addr():
-    assert _parse_addr(":8080") == ("0.0.0.0", 8080)
+    # Empty host = dual-stack wildcard (resolved by _make_http_server).
+    assert _parse_addr(":8080") == ("", 8080)
     assert _parse_addr("127.0.0.1:0") == ("127.0.0.1", 0)
-    assert _parse_addr("9090") == ("0.0.0.0", 9090)
+    assert _parse_addr("9090") == ("", 9090)
     assert _parse_addr("[::1]:8080") == ("::1", 8080)
     with pytest.raises(ValueError, match="invalid listen address"):
         _parse_addr("localhost")
+    with pytest.raises(ValueError, match="bracket IPv6"):
+        _parse_addr("::1")
+
+
+def test_dual_stack_default_bind():
+    srv = Server(bind_address=":0", probe_address=":0", backend="host")
+    srv.start()
+    try:
+        status, body = request(srv.probe_port, "GET", "/healthz")
+        assert (status, body) == (200, b"ok")
+    finally:
+        srv.shutdown()
+
+
+def test_internal_error_returns_500():
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host")
+    srv.start()
+    try:
+        original = srv.resolve_document
+        srv.resolve_document = lambda doc: (_ for _ in ()).throw(
+            RuntimeError("boom"))
+        status, data = request(srv.api_port, "POST", "/v1/resolve",
+                               {"variables": []})
+        assert status == 500
+        assert "internal error" in json.loads(data)["error"]
+        srv.resolve_document = original
+        _, mdata = request(srv.api_port, "GET", "/metrics")
+        assert "deppy_request_errors_total 1" in mdata.decode()
+    finally:
+        srv.shutdown()
 
 
 def test_incomplete_counted_per_problem(tmp_path):
