@@ -333,44 +333,6 @@ def _bcp_gather(pt: ProblemTensors, assign: jax.Array,
     return conflict, assign
 
 
-def _bcp_planes(pt: ProblemTensors, assign: jax.Array,
-                min_mask: jax.Array, min_w: jax.Array, use_pallas: bool,
-                enabled: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    V = assign.shape[0]
-    Wv = pt.pos_bits.shape[1]
-    t = pack_mask(assign == TRUE, Wv)
-    f = pack_mask(assign == FALSE, Wv)
-    min_bits = pack_mask(min_mask, Wv)
-    card_n2 = pt.card_n[:, None]
-    if use_pallas:
-        from . import pallas_bcp
-
-        conflict, t, f = pallas_bcp.bcp_fixpoint(
-            pt.pos_bits, pt.neg_bits, pt.card_member_bits, pt.card_act_bits,
-            card_n2, min_bits, min_w, t, f, enabled,
-        )
-    else:
-        def cond(state):
-            conflict, _, _, changed = state
-            return ~conflict & changed
-
-        def body(state):
-            _, t, f, _ = state
-            return round_planes(
-                pt.pos_bits, pt.neg_bits, pt.card_member_bits,
-                pt.card_act_bits, card_n2, min_bits, min_w, t, f,
-            )
-
-        state = (jnp.bool_(False), t, f, enabled)
-        conflict, t, f, _ = lax.while_loop(cond, body, state)
-    tb = unpack_mask(t, V)
-    fb = unpack_mask(f, V)
-    new_assign = jnp.where(
-        tb, jnp.int32(TRUE), jnp.where(fb, jnp.int32(FALSE), jnp.int32(UNASSIGNED))
-    )
-    return conflict, new_assign
-
-
 def bcp(pt: ProblemTensors, assign: jax.Array,
         min_mask: jax.Array, min_w: jax.Array,
         enabled: jax.Array = jnp.bool_(True)) -> Tuple[jax.Array, jax.Array]:
@@ -388,33 +350,101 @@ def bcp(pt: ProblemTensors, assign: jax.Array,
     impl = _resolved_impl()
     if impl == "gather":
         return _bcp_gather(pt, assign, min_mask, min_w, enabled)
-    return _bcp_planes(pt, assign, min_mask, min_w,
-                       use_pallas=impl == "pallas", enabled=enabled)
+    V = assign.shape[0]
+    Wv = pt.pos_bits.shape[1]
+    t = pack_mask(assign == TRUE, Wv)
+    f = pack_mask(assign == FALSE, Wv)
+    conflict, t, f = planes_fixpoint(
+        pt, t, f, pack_mask(min_mask, Wv), min_w, enabled, V
+    )
+    return conflict, planes_to_assign(t, f, V)
+
+
+def planes_fixpoint(pt: ProblemTensors, t: jax.Array, f: jax.Array,
+                    min_bits: jax.Array, min_w: jax.Array,
+                    enabled: jax.Array, V: int
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fixpoint directly on packed (t, f) planes — the incremental engine
+    primitive: starting from a previous fixpoint plus newly set literals,
+    propagation converges in the few rounds the *new* implications need
+    (BCP is monotone and confluent, so the result equals a from-scratch
+    run).  Returns (conflict, t, f).  Dispatches on the selected impl; the
+    gather path unpacks to assignment form and back."""
+    impl = _resolved_impl()
+    card_n2 = pt.card_n[:, None]
+    # Incremental starts can assert a literal whose negation is already
+    # set (e.g. guessing a candidate that propagation forced false): that
+    # t∧f overlap IS the conflict, and it must be caught here — a clause
+    # containing the overlapped variable reads as satisfied to the round
+    # kernel, masking it.  From-scratch starts never overlap.
+    pre_conflict = enabled & ((t & f) != 0).any()
+    run = enabled & ~pre_conflict
+    if impl == "pallas":
+        from . import pallas_bcp
+
+        conflict, t, f = pallas_bcp.bcp_fixpoint(
+            pt.pos_bits, pt.neg_bits, pt.card_member_bits, pt.card_act_bits,
+            card_n2, min_bits, min_w, t, f, run,
+        )
+        return conflict | pre_conflict, t, f
+    if impl == "gather":
+        assign = planes_to_assign(t, f, V)
+        conflict, assign = _bcp_gather(
+            pt, assign, unpack_mask(min_bits, V), min_w, run
+        )
+        Wv = t.shape[1]
+        return (conflict | pre_conflict,
+                pack_mask(assign == TRUE, Wv), pack_mask(assign == FALSE, Wv))
+
+    def cond(state):
+        conflict, _, _, changed = state
+        return ~conflict & changed
+
+    def body(state):
+        _, t, f, _ = state
+        return round_planes(
+            pt.pos_bits, pt.neg_bits, pt.card_member_bits,
+            pt.card_act_bits, card_n2, min_bits, min_w, t, f,
+        )
+
+    conflict, t, f, _ = lax.while_loop(cond, body, (jnp.bool_(False), t, f, run))
+    return conflict | pre_conflict, t, f
+
+
+def planes_to_assign(t: jax.Array, f: jax.Array, V: int) -> jax.Array:
+    """(t, f) planes → int32 assignment vector."""
+    tb = unpack_mask(t, V)
+    fb = unpack_mask(f, V)
+    return jnp.where(
+        tb, jnp.int32(TRUE), jnp.where(fb, jnp.int32(FALSE), jnp.int32(UNASSIGNED))
+    )
+
+
+def set_plane_bit(plane: jax.Array, var: jax.Array, on: jax.Array) -> jax.Array:
+    """Set bit ``var`` in a packed [1, Wv] plane when ``on`` (no-op
+    otherwise).  ``var`` is a traced index."""
+    word = var // WORD
+    bit = jnp.int32(1) << (var % WORD)
+    cur = plane[0, word]
+    return plane.at[0, word].set(jnp.where(on, cur | bit, cur))
 
 
 # --------------------------------------------------------------------------
 # Test
 
 
-def run_test(pt: ProblemTensors, assumed: jax.Array, V: int, NCON: int,
-             enabled: jax.Array = jnp.bool_(True)
-             ) -> Tuple[jax.Array, jax.Array]:
-    """Propagation-only check of the current assumption set — the analog of
-    gini's ``Test`` (solve.go:79, search.go:76): anchors + activations +
-    guessed variables assumed, then BCP; SAT only when propagation alone
-    totalizes the problem-var region.  A disabled lane runs zero BCP rounds
-    and its outcome must be discarded by the caller."""
-    a = _base_assignment(pt, V, NCON)
-    a = _apply_anchors(pt, a, V)
-    a = jnp.where(assumed, jnp.int32(TRUE), a)
-    no_min = jnp.zeros(V, bool)
-    conflict, a = bcp(pt, a, no_min, jnp.int32(0), enabled=enabled)
-    idx = jnp.arange(V, dtype=jnp.int32)
-    all_assigned = ((idx >= pt.n_vars) | (a != UNASSIGNED)).all()
-    outcome = jnp.where(
-        conflict, jnp.int32(UNSAT), jnp.where(all_assigned, jnp.int32(SAT), jnp.int32(RUNNING))
+def test_outcome(conflict: jax.Array, t: jax.Array, f: jax.Array,
+                 pvb: jax.Array) -> jax.Array:
+    """Outcome of a propagated plane state — the analog of gini ``Test``'s
+    result (solve.go:79, search.go:76): UNSAT on conflict, SAT only when
+    propagation alone totalizes the problem-var region (``pvb`` = packed
+    problem-var mask), else RUNNING.  The single definition shared by the
+    baseline Test, the search's push Test, and dpll's totality check."""
+    all_assigned = ((pvb & ~(t | f)) == 0).all()
+    return jnp.where(
+        conflict, jnp.int32(UNSAT),
+        jnp.where(all_assigned, jnp.int32(SAT), jnp.int32(RUNNING)),
     )
-    return outcome, a
 
 
 # --------------------------------------------------------------------------
@@ -429,60 +459,109 @@ def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
     analog of gini ``Solve()`` (search.go:168, solve.go:107) and of
     HostEngine._dpll: false-first decisions on the lowest-index unassigned
     problem variable, chronological backtracking that flips the deepest
-    unflipped decision.  Each iteration rebuilds the assignment from
-    ``init`` plus the decision stack and re-propagates — fixed-shape state,
-    no snapshot stack.  Returns (status, model, steps).
+    unflipped decision.
+
+    Trail-style snapshots: ``snap[k]`` holds the packed-plane fixpoint
+    after ``k`` decisions, so each iteration propagates only the *new*
+    decision literal from the previous fixpoint (BCP is monotone and
+    confluent — the incremental fixpoint equals the from-scratch one), and
+    backtracking restores a snapshot instead of re-propagating the whole
+    stack.  The decision order, phases, and discovered model are identical
+    to the rebuild-from-scratch formulation.  Returns (status, model,
+    steps).
 
     A disabled lane runs zero iterations and returns status RUNNING; the
     caller must discard it (see :func:`bcp` for the lane-gating idiom)."""
     V = init.shape[0]
-    idxV = jnp.arange(V, dtype=jnp.int32)
+    Wv = pt.pos_bits.shape[1]
     lvl = jnp.arange(NV, dtype=jnp.int32)
+    pvb = pack_mask(jnp.arange(V, dtype=jnp.int32) < pt.n_vars, Wv)
+    min_bits = pack_mask(min_mask, Wv)
+
+    t0 = pack_mask(init == TRUE, Wv)
+    f0 = pack_mask(init == FALSE, Wv)
+    conflict0, t0, f0 = planes_fixpoint(pt, t0, f0, min_bits, min_w, enabled, V)
+    status0 = jnp.where(conflict0, jnp.int32(UNSAT), jnp.int32(RUNNING))
+    snap_t0 = jnp.zeros((NV + 1, Wv), jnp.int32).at[0].set(t0[0])
+    snap_f0 = jnp.zeros((NV + 1, Wv), jnp.int32).at[0].set(f0[0])
 
     def body(st):
-        dec_var, dec_phase, sp, status, model, steps = st
-        live = lvl < sp
-        tgt = jnp.where(live, dec_var, V)
-        a = init.at[tgt].set(jnp.where(live, dec_phase, 0), mode="drop")
-        conflict, a = bcp(pt, a, min_mask, min_w)
+        (dec_var, dec_phase, sp, flip, status, m_t, m_f,
+         snap_t, snap_f, steps) = st
+        t = snap_t[jnp.clip(sp, 0, NV)][None, :]
+        f = snap_f[jnp.clip(sp, 0, NV)][None, :]
 
-        pv_un = (idxV < pt.n_vars) & (a == UNASSIGNED)
-        first_un = jnp.min(jnp.where(pv_un, idxV, V))
-        done_sat = ~conflict & (first_un == V)
+        # SAT when the problem-var region is totalized at the current level
+        # (a pending flip always has its own variable unassigned, so this
+        # can only fire on the decide path).
+        un_bits = pvb & ~(t | f)
+        has_un = (un_bits != 0).any()
+        un = unpack_mask(un_bits, V)
+        first_un = jnp.argmax(un).astype(jnp.int32)
+        sat_now = ~flip & ~has_un
+        status = jnp.where(sat_now, jnp.int32(SAT), status)
+        m_t = jnp.where(sat_now, t, m_t)
+        m_f = jnp.where(sat_now, f, m_f)
 
-        # Deepest decision still on its first (false) phase.
-        cand = live & (dec_phase == FALSE)
+        do_step = status == RUNNING
+        # The decision applied this iteration: a pending flip re-tries the
+        # level's variable true, otherwise decide first-unassigned false.
+        var = jnp.where(flip, dec_var[jnp.clip(sp, 0, NV - 1)], first_un)
+        neg_phase = ~flip  # fresh decisions are false-first
+        dv_idx = jnp.where(do_step & ~flip, jnp.clip(sp, 0, NV - 1), NV)
+        dec_var = dec_var.at[dv_idx].set(var, mode="drop")
+        dec_phase = dec_phase.at[dv_idx].set(FALSE, mode="drop")
+        # A flip consumes the level's second phase.
+        fl_idx = jnp.where(do_step & flip, jnp.clip(sp, 0, NV - 1), NV)
+        dec_phase = dec_phase.at[fl_idx].set(TRUE, mode="drop")
+
+        t2 = set_plane_bit(t, var, do_step & ~neg_phase)
+        f2 = set_plane_bit(f, var, do_step & neg_phase)
+        conflict, t3, f3 = planes_fixpoint(
+            pt, t2, f2, min_bits, min_w, do_step, V
+        )
+
+        ok = do_step & ~conflict
+        sidx = jnp.where(ok, jnp.clip(sp + 1, 0, NV), NV + 1)
+        snap_t = snap_t.at[sidx].set(t3[0], mode="drop")
+        snap_f = snap_f.at[sidx].set(f3[0], mode="drop")
+
+        # SAT the moment a propagation totalizes the problem vars — in the
+        # same iteration, so a solve on the last in-budget step still
+        # reports its model.
+        tot = ok & (((pvb & ~(t3 | f3)) == 0).all())
+        status = jnp.where(tot, jnp.int32(SAT), status)
+        m_t = jnp.where(tot, t3, m_t)
+        m_f = jnp.where(tot, f3, m_f)
+
+        # Chronological backtrack: deepest level still on its false phase.
+        cand = (lvl <= sp) & (dec_phase == FALSE)
         l = jnp.max(jnp.where(cand, lvl, -1))
         no_bt = l < 0
-
-        status = jnp.where(
-            conflict,
-            jnp.where(no_bt, jnp.int32(UNSAT), status),
-            jnp.where(done_sat, jnp.int32(SAT), status),
-        )
-        model = jnp.where(done_sat, a, model)
-
-        do_bt = conflict & ~no_bt
-        do_push = ~conflict & ~done_sat
-        dec_phase = dec_phase.at[jnp.where(do_bt, l, NV)].set(TRUE, mode="drop")
-        dec_var = dec_var.at[jnp.where(do_push, sp, NV)].set(first_un, mode="drop")
-        dec_phase = dec_phase.at[jnp.where(do_push, sp, NV)].set(FALSE, mode="drop")
-        sp = jnp.where(do_bt, l + 1, jnp.where(do_push, sp + 1, sp))
-        return dec_var, dec_phase, sp, status, model, steps + 1
+        bt = do_step & conflict & ~no_bt
+        status = jnp.where(do_step & conflict & no_bt, jnp.int32(UNSAT), status)
+        sp = jnp.where(ok, sp + 1, jnp.where(bt, l, sp))
+        flip = jnp.where(ok, jnp.bool_(False), jnp.where(bt, jnp.bool_(True), flip))
+        steps = steps + do_step.astype(jnp.int32)
+        return (dec_var, dec_phase, sp, flip, status, m_t, m_f,
+                snap_t, snap_f, steps)
 
     def cond(st):
-        _, _, _, status, _, steps = st
+        _, _, _, _, status, _, _, _, _, steps = st
         return enabled & (status == RUNNING) & (steps <= budget)
 
     st = (
         jnp.zeros(NV, jnp.int32),
         jnp.zeros(NV, jnp.int32),
         jnp.int32(0),
-        jnp.int32(RUNNING),
-        init,
+        jnp.bool_(False),
+        status0,
+        t0, f0,
+        snap_t0, snap_f0,
         steps,
     )
-    _, _, _, status, model, steps = lax.while_loop(cond, body, st)
+    (_, _, _, _, status, m_t, m_f, _, _, steps) = lax.while_loop(cond, body, st)
+    model = planes_to_assign(m_t, m_f, V)
     return status, model, steps
 
 
@@ -490,7 +569,8 @@ def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
 # preference-ordered guess search
 
 
-def search(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
+def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
+           outcome0: jax.Array, budget: jax.Array, steps: jax.Array,
            V: int, NCON: int, NV: int,
            enabled: jax.Array = jnp.bool_(True)
            ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
@@ -508,29 +588,46 @@ def search(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
       2. deque empty, outcome sat      → done              (:182-184)
       3. otherwise                     → push next guess   (:187, :34-77)
 
-    The arms are *not* dispatched through ``lax.switch``: under ``vmap``
-    switch lowers to select, which would execute a full DPLL solve plus two
-    BCP fixpoints on every iteration of every lane.  Instead the body
-    computes every arm's (cheap) bookkeeping with masked selects and runs
-    exactly one lane-gated DPLL and one lane-gated propagation fixpoint per
-    iteration — the expensive ops cost nothing on lanes whose arm doesn't
-    need them.
+    Two engine-level optimizations over a literal translation, both
+    outcome-preserving:
+
+    * **No branch dispatch** — under ``vmap``, ``lax.switch`` lowers to
+      select and would execute a full DPLL plus propagation on every
+      iteration of every lane; instead all four arms' bookkeeping runs as
+      masked selects with exactly one lane-gated DPLL and at most one
+      lane-gated propagation fixpoint per iteration.
+    * **Guess-trail snapshots** — the packed-plane fixpoint and Test
+      outcome after each guess are stacked; a push propagates only its new
+      literal from the previous fixpoint (incremental BCP — monotone, so
+      identical to from-scratch), and a pop is a pure snapshot restore with
+      **zero** propagation, where the reference re-runs ``Test``
+      (search.go:84) and the naive translation re-propagated everything.
+
+    ``t0``/``f0``/``outcome0`` are the baseline fixpoint planes and Test
+    outcome under anchors + activations alone (solve.go:74-79).
 
     Returns (result, guessed_mask, model, steps)."""
     NC, Kc = pt.choice_cand.shape
     DQ = NC + 1
     GS = NC + 1
+    Wv = pt.pos_bits.shape[1]
     dq_pos = jnp.arange(DQ, dtype=jnp.int32)
+    pvb = pack_mask(jnp.arange(V, dtype=jnp.int32) < pt.n_vars, Wv)
+    no_min_bits = jnp.zeros((1, Wv), jnp.int32)
 
     na = (pt.anchors >= 0).sum().astype(jnp.int32)
     # Anchor choice rows are rows 0..na-1 of the choice table, seeded in
     # input order (search.go:159-161).
     dq_c0 = jnp.where(dq_pos < na, dq_pos, 0)
     dq_i0 = jnp.zeros(DQ, jnp.int32)
+    # Guess-trail snapshots: level k = fixpoint + outcome after k guesses.
+    snap_t0 = jnp.zeros((GS + 1, Wv), jnp.int32).at[0].set(t0[0])
+    snap_f0 = jnp.zeros((GS + 1, Wv), jnp.int32).at[0].set(f0[0])
+    out_st0 = jnp.zeros(GS + 1, jnp.int32).at[0].set(outcome0)
 
     def body(st):
         (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-         result, model, assumed, done, steps) = st
+         snap_t, snap_f, out_st, result, m_t, m_f, assumed, done, steps) = st
 
         # Arm selection (mutually exclusive; reference precedence order).
         is_leaf = (cnt == 0) & (result == RUNNING)
@@ -538,16 +635,21 @@ def search(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
         is_done = ~is_leaf & ~is_bt & (cnt == 0)
         is_push = ~is_leaf & ~is_bt & ~is_done
 
+        cur_t = snap_t[jnp.clip(gsp, 0, GS)][None, :]
+        cur_f = snap_f[jnp.clip(gsp, 0, GS)][None, :]
+
         # --- arm 0: leaf DPLL (search.go:167-169), lane-gated -----------
-        init = _base_assignment(pt, V, NCON)
-        init = _apply_anchors(pt, init, V)
-        init = jnp.where(assumed, jnp.int32(TRUE), init)
+        # Starts from the current guess-level fixpoint (equivalent to the
+        # assumption set: same fixpoint, so same search).
+        init = planes_to_assign(cur_t, cur_f, V)
         no_min = jnp.zeros(V, bool)
         leaf_status, leaf_model, steps = dpll(
             pt, init, no_min, jnp.int32(0), budget, steps, NV, enabled=is_leaf
         )
         result = jnp.where(is_leaf, leaf_status, result)
-        model = jnp.where(is_leaf & (leaf_status == SAT), leaf_model, model)
+        leaf_sat = is_leaf & (leaf_status == SAT)
+        m_t = jnp.where(leaf_sat, pack_mask(leaf_model == TRUE, Wv), m_t)
+        m_f = jnp.where(leaf_sat, pack_mask(leaf_model == FALSE, Wv), m_f)
         # Budget exhaustion leaves status RUNNING; the outer cond exits.
 
         # --- arm 1: backtrack bookkeeping (PopGuess, search.go:79-98) ---
@@ -596,41 +698,70 @@ def search(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
         g_i = g_i.at[g_idx].set(idx, mode="drop")
         g_v = g_v.at[g_idx].set(var, mode="drop")
         g_ch = g_ch.at[g_idx].set(nch, mode="drop")
-        gsp = jnp.where(bt, gsp2, jnp.where(is_push, gsp + 1, gsp))
 
         assumed = assumed.at[jnp.where(bt & (gv >= 0), jnp.clip(gv, 0), V)
                              ].set(False, mode="drop")
         assumed = assumed.at[jnp.where(is_push & (var >= 0), jnp.clip(var, 0), V)
                              ].set(True, mode="drop")
 
-        # One lane-gated propagation test per iteration: a backtrack that
-        # un-assumed a real variable, or a push that assumed one.  Popping
-        # or pushing a null guess leaves the prior outcome standing
-        # (search.go:55-60; a standing UNSAT keeps the pop loop going).
-        test_en = (bt & (gv >= 0)) | (is_push & (var >= 0))
-        outcome, a = run_test(pt, assumed, V, NCON, enabled=test_en)
-        result = jnp.where(test_en, outcome, result)
-        model = jnp.where(test_en & (outcome == SAT), a, model)
+        # Push with a real variable: propagate just the new literal from
+        # the current fixpoint (lane-gated).  A null push copies the level.
+        push_test = is_push & (var >= 0)
+        t2 = set_plane_bit(cur_t, jnp.clip(var, 0), push_test)
+        conflict, t3, f3 = planes_fixpoint(
+            pt, t2, cur_f, no_min_bits, jnp.int32(0), push_test, V
+        )
+        push_out = test_outcome(conflict, t3, f3, pvb)
+        sidx = jnp.where(is_push, jnp.clip(gsp + 1, 0, GS), GS + 1)
+        snap_t = snap_t.at[sidx].set(
+            jnp.where(push_test, t3[0], cur_t[0]), mode="drop")
+        snap_f = snap_f.at[sidx].set(
+            jnp.where(push_test, f3[0], cur_f[0]), mode="drop")
+        out_st = out_st.at[sidx].set(
+            jnp.where(push_test, push_out, out_st[jnp.clip(gsp, 0, GS)]),
+            mode="drop")
+        gsp = jnp.where(bt, gsp2, jnp.where(is_push, gsp + 1, gsp))
+
+        # Pop of a real guess re-Tests (search.go:84) — with snapshots the
+        # outcome was already recorded at the restored level: zero
+        # propagation.  Popping or pushing a null guess leaves the prior
+        # outcome standing (search.go:55-60; a standing UNSAT keeps the pop
+        # loop going).
+        pop_restore = bt & (gv >= 0)
+        pop_out = out_st[jnp.clip(gsp2, 0, GS)]
+        result = jnp.where(pop_restore, pop_out,
+                           jnp.where(push_test, push_out, result))
+        pop_sat = pop_restore & (pop_out == SAT)
+        m_t = jnp.where(pop_sat, snap_t[jnp.clip(gsp2, 0, GS)][None, :], m_t)
+        m_f = jnp.where(pop_sat, snap_f[jnp.clip(gsp2, 0, GS)][None, :], m_f)
+        push_sat = push_test & (push_out == SAT)
+        m_t = jnp.where(push_sat, t3, m_t)
+        m_f = jnp.where(push_sat, f3, m_f)
 
         done = done | give_up | is_done
         steps = steps + (bt | is_push).astype(jnp.int32)
         return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-                result, model, assumed, done, steps)
+                snap_t, snap_f, out_st, result, m_t, m_f, assumed, done, steps)
 
     def cond(st):
-        (_, _, _, _, _, _, _, _, _, _, _, _, done, steps) = st
+        done = st[-2]
+        steps = st[-1]
         return enabled & ~done & (steps <= budget)
 
     st = (
         dq_c0, dq_i0, jnp.int32(0), na,
         jnp.zeros(GS, jnp.int32), jnp.zeros(GS, jnp.int32),
         jnp.zeros(GS, jnp.int32), jnp.zeros(GS, jnp.int32), jnp.int32(0),
-        jnp.int32(RUNNING), jnp.zeros(V, jnp.int32), jnp.zeros(V, bool),
+        snap_t0, snap_f0, out_st0,
+        jnp.int32(RUNNING), jnp.zeros((1, Wv), jnp.int32),
+        jnp.zeros((1, Wv), jnp.int32), jnp.zeros(V, bool),
         jnp.bool_(False), steps,
     )
     st = lax.while_loop(cond, body, st)
-    (_, _, _, _, _, _, _, _, _, result, model, assumed, done, steps) = st
+    (_, _, _, _, _, _, _, _, _, _, _, _,
+     result, m_t, m_f, assumed, done, steps) = st
     result = jnp.where(done, result, jnp.int32(RUNNING))
+    model = planes_to_assign(m_t, m_f, V)
     return result, assumed, model, steps
 
 
@@ -653,12 +784,26 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     idxV = jnp.arange(V, dtype=jnp.int32)
     pv_mask = idxV < pt.n_vars
     steps0 = jnp.int32(1)
-    outcome0, a0 = run_test(pt, jnp.zeros(V, bool), V, NCON)
+    Wv = pt.pos_bits.shape[1]
+    pvb = pack_mask(pv_mask, Wv)
+
+    # Baseline Test under anchors + activations (solve.go:74-79), computed
+    # as planes so the search can snapshot from it.
+    base = _base_assignment(pt, V, NCON)
+    base = _apply_anchors(pt, base, V)
+    t0 = pack_mask(base == TRUE, Wv)
+    f0 = pack_mask(base == FALSE, Wv)
+    conflict0, t0, f0 = planes_fixpoint(
+        pt, t0, f0, jnp.zeros((1, Wv), jnp.int32), jnp.int32(0),
+        jnp.bool_(True), V,
+    )
+    outcome0 = test_outcome(conflict0, t0, f0, pvb)
+    a0 = planes_to_assign(t0, f0, V)
 
     # ---- guess search when the baseline Test is undetermined ----
     need_search = outcome0 == RUNNING
     s_result, s_guessed, s_model, steps = search(
-        pt, budget, steps0, V, NCON, NV, enabled=need_search
+        pt, t0, f0, outcome0, budget, steps0, V, NCON, NV, enabled=need_search
     )
     result = jnp.where(need_search, s_result, outcome0)
     # Baseline already decided: the anchors play the guess-set role for
@@ -667,6 +812,9 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     model = jnp.where(need_search, s_model, a0)
 
     # ---- SAT: extras-only cardinality minimization (solve.go:86-113) ----
+    # The reference probes w = 0, 1, 2, … and stops at the first SAT
+    # (solve.go:105-110).  Satisfiability is monotone in w, so binary
+    # search over [0, n_extras] finds the same minimal w in O(log) solves.
     sat_en = result == SAT
     extras = (model == TRUE) & ~guessed & pv_mask
     excluded = (model != TRUE) & ~guessed & pv_mask
@@ -677,20 +825,42 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     n_extras = extras.sum()
 
     def mcond(c):
-        w, found, _, steps = c
-        return sat_en & ~found & (w <= n_extras) & (steps <= budget)
+        lo, hi, _, _, _, steps = c
+        return sat_en & (lo < hi) & (steps <= budget)
 
     def mbody(c):
-        w, found, m2, steps = c
+        lo, hi, best_w, m2, found, steps = c
+        w = (lo + hi) // 2
         status, m, steps = dpll(pt, m_init, extras, w, budget, steps, NV,
                                 enabled=sat_en)
-        found = status == SAT
-        m2 = jnp.where(found, m, m2)
-        return w + 1, found, m2, steps
+        sat_w = status == SAT
+        # SAT at w: the minimum is ≤ w — keep this probe's model and shrink
+        # hi.  UNSAT at w: the minimum is > w.  Budget exhaustion (RUNNING)
+        # changes nothing; the steps guard exits.
+        best_w = jnp.where(sat_w, w, best_w)
+        m2 = jnp.where(sat_w, m, m2)
+        found = found | sat_w
+        lo = jnp.where(sat_w, lo, jnp.where(status == UNSAT, w + 1, hi))
+        hi = jnp.where(sat_w, w, hi)
+        return lo, hi, best_w, m2, found, steps
 
-    _, min_found, m2, steps = lax.while_loop(
-        mcond, mbody, (jnp.int32(0), jnp.bool_(False), model, steps)
+    # Invariant: UNSAT strictly below lo, SAT at hi (the search/baseline
+    # model witnesses w = n_extras).  At exit lo == hi == minimal w.
+    _, m_hi, best_w, m2, m_found, steps = lax.while_loop(
+        mcond, mbody,
+        (jnp.int32(0), n_extras, jnp.int32(-1), model, jnp.bool_(False),
+         steps),
     )
+    # The reported model must come from a probe at the minimal w itself —
+    # the reference returns the w-bounded dpll model, which can differ from
+    # the search witness even at equal cardinality (solve.go:108).  Probe
+    # once more if the last SAT probe wasn't at the final bound (also
+    # covers n_extras == 0, where the loop never runs).
+    need_final = sat_en & (best_w != m_hi)
+    f_status, f_m, steps = dpll(pt, m_init, extras, m_hi, budget, steps, NV,
+                                enabled=need_final)
+    m2 = jnp.where(need_final & (f_status == SAT), f_m, m2)
+    min_found = jnp.where(need_final, f_status == SAT, m_found)
     installed = (m2 == TRUE) & pv_mask & min_found & sat_en
 
     # ---- UNSAT: deletion-based unsat-core minimization ----
