@@ -1,13 +1,153 @@
-"""Driver benchmark entry point.
+"""Driver benchmark entry point — hardened orchestrator.
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Contract: print exactly ONE JSON line on stdout
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+and exit 0, no matter what the accelerator backend does.
 
-Logic lives in :mod:`deppy_tpu.benchmarks.headline` (also reachable as
-``deppy bench``); this wrapper keeps the repo-root contract stable.
+Round-1 failure mode (BENCH_r01.json rc=1, parsed:null): the TPU PJRT
+plugin either hangs or raises during init, and the old bench.py called
+``jax.default_backend()`` in-process with no guard, aborting before the
+JSON line.  This version:
+
+1. probes backend availability in a *subprocess* with a hard timeout
+   (a hanging PJRT init cannot eat the run),
+2. runs the workload (``deppy_tpu.benchmarks.headline``) in a subprocess
+   with a watchdog, falling back to a forced-CPU platform when the
+   accelerator is unavailable,
+3. always prints a JSON line and exits 0 — on total failure the line
+   carries ``value: 0`` and an ``error`` field instead of crashing.
 """
 
-from deppy_tpu.benchmarks import headline
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+PROBE_TIMEOUT_S = int(os.environ.get("DEPPY_BENCH_PROBE_TIMEOUT", "90"))
+RUN_TIMEOUT_S = int(os.environ.get("DEPPY_BENCH_RUN_TIMEOUT", "1500"))
+
+_PROBE_SRC = "import jax; d = jax.devices(); print(jax.default_backend())"
+
+
+def _cpu_env() -> dict:
+    """Environment forcing the single-device virtual-CPU platform."""
+    from deppy_tpu.utils.platform_env import force_cpu_env
+
+    return force_cpu_env(os.environ, n_devices=1)
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _probe_accelerator() -> str | None:
+    """Return the backend name if a non-CPU backend initializes within the
+    timeout, else None.  Runs in a subprocess so a hang cannot propagate."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT_S,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"backend probe timed out after {PROBE_TIMEOUT_S}s")
+        return None
+    if out.returncode != 0:
+        tail = (out.stderr or "").strip().splitlines()[-1:]
+        _log(f"backend probe failed rc={out.returncode}: {tail}")
+        return None
+    backend = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    _log(f"backend probe ok: {backend}")
+    return backend or None
+
+
+def _run_workload(platform: str | None, timeout_s: int) -> dict | None:
+    """Run the headline benchmark in a subprocess; return its parsed JSON
+    record or None.  ``platform=None`` means use the default backend."""
+    cmd = [sys.executable, "-m", "deppy_tpu.benchmarks.headline"]
+    if "DEPPY_BENCH_N" in os.environ:
+        cmd += ["--n-problems", os.environ["DEPPY_BENCH_N"]]
+    if "DEPPY_BENCH_HOST_SAMPLE" in os.environ:
+        cmd += ["--host-sample", os.environ["DEPPY_BENCH_HOST_SAMPLE"]]
+    env = dict(os.environ)
+    if platform == "cpu":
+        env = _cpu_env()
+        cmd += ["--platform", "cpu"]
+    try:
+        out = subprocess.run(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=sys.stderr,
+            text=True,
+            timeout=timeout_s,
+            cwd=REPO,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"workload timed out after {timeout_s}s (platform={platform})")
+        return None
+    if out.returncode != 0:
+        _log(f"workload failed rc={out.returncode} (platform={platform})")
+        return None
+    for line in reversed((out.stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            return rec
+    _log(f"workload produced no JSON record (platform={platform})")
+    return None
+
+
+def main() -> int:
+    backend = _probe_accelerator()
+    rec = None
+    used = None
+    if backend and backend != "cpu":
+        rec = _run_workload(None, RUN_TIMEOUT_S)
+        used = backend
+    if rec is None:
+        _log("falling back to forced-CPU platform")
+        rec = _run_workload("cpu", RUN_TIMEOUT_S)
+        used = "cpu"
+    if rec is None:
+        rec = {
+            "metric": "catalog resolutions/sec (batched device vs serial host)",
+            "value": 0.0,
+            "unit": "problems/s",
+            "vs_baseline": 0.0,
+            "error": "no backend produced a benchmark record",
+        }
+        used = "none"
+    rec.setdefault("backend", used)
+    print(json.dumps(rec), flush=True)
+    return 0
+
 
 if __name__ == "__main__":
-    headline.run()
+    try:
+        rc = main()
+    except Exception as exc:  # the JSON line must survive any failure
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        "catalog resolutions/sec (batched device vs serial host)"
+                    ),
+                    "value": 0.0,
+                    "unit": "problems/s",
+                    "vs_baseline": 0.0,
+                    "backend": "none",
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+            ),
+            flush=True,
+        )
+        rc = 0
+    sys.exit(rc)

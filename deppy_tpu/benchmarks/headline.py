@@ -19,7 +19,8 @@ import json
 from .harness import bench_problems, log
 
 
-def run(n_problems: int = 512, length: int = 48, host_sample: int = 24) -> dict:
+def run(n_problems: int = 512, length: int = 48, host_sample: int = 24,
+        platform: str | None = None) -> dict:
     import jax
 
     from ..models import random_instance
@@ -28,7 +29,10 @@ def run(n_problems: int = 512, length: int = 48, host_sample: int = 24) -> dict:
     if n_problems <= 0:
         raise ValueError("n_problems must be positive")
 
-    log(f"jax backend: {jax.default_backend()} devices={jax.devices()}")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    backend = jax.default_backend()
+    log(f"jax backend: {backend} devices={jax.devices()}")
     problems = [
         encode(random_instance(length=length, seed=s)) for s in range(n_problems)
     ]
@@ -39,6 +43,25 @@ def run(n_problems: int = 512, length: int = 48, host_sample: int = 24) -> dict:
         "value": round(m["device_rate"], 2),
         "unit": "problems/s",
         "vs_baseline": round(m["device_rate"] * m["host_s_per_problem"], 3),
+        "backend": backend,
     }
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
     return result
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) before running")
+    ap.add_argument("--n-problems", type=int, default=512)
+    ap.add_argument("--length", type=int, default=48)
+    ap.add_argument("--host-sample", type=int, default=24)
+    a = ap.parse_args()
+    run(n_problems=a.n_problems, length=a.length, host_sample=a.host_sample,
+        platform=a.platform)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,6 @@
-"""Shared utilities: solution verification, timing helpers."""
+"""Shared utilities: solution verification, platform provisioning."""
 
+from .platform_env import force_cpu_env
 from .verify import check_solution
 
-__all__ = ["check_solution"]
+__all__ = ["check_solution", "force_cpu_env"]

@@ -1,0 +1,32 @@
+"""Forced-platform environment provisioning for driver entry points.
+
+One strip-and-replace recipe shared by ``bench.py`` and
+``__graft_entry__.dryrun_multichip`` (and usable by tests): on this
+machine a sitecustomize hook registers a TPU PJRT plugin whose init can
+hang, and ``JAX_PLATFORMS=cpu`` in the environment alone is not honored
+by it — subprocesses must BOTH carry this env and call
+``jax.config.update("jax_platforms", "cpu")`` before the first backend
+query (the ``tests/conftest.py`` recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+
+def force_cpu_env(environ: Mapping[str, str], n_devices: int = 1) -> dict:
+    """Copy ``environ`` with the virtual-CPU platform forced: sets
+    ``JAX_PLATFORMS=cpu`` and replaces (never merely keeps) any existing
+    ``--xla_force_host_platform_device_count`` flag with ``n_devices``."""
+    if n_devices <= 0:
+        raise ValueError("n_devices must be positive")
+    env = dict(environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env

@@ -1,0 +1,59 @@
+"""Driver-contract tests: bench.py and __graft_entry__.dryrun_multichip.
+
+Round 1 lost both driver artifacts to backend-init failures (BENCH_r01
+rc=1, MULTICHIP_r01 rc=124).  These tests pin the hardened behavior: both
+entry points must succeed even when the accelerator backend is
+unavailable or hangs, because they self-provision a forced-CPU platform
+in subprocesses with watchdog timeouts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_json_and_exits_zero_without_accelerator():
+    """bench.py must print one parseable JSON record and exit 0 even when
+    the backend probe fails instantly (simulated via a 1s probe timeout
+    on a machine whose TPU tunnel hangs)."""
+    env = dict(os.environ)
+    env["DEPPY_BENCH_PROBE_TIMEOUT"] = "1"
+    env["DEPPY_BENCH_N"] = "8"
+    env["DEPPY_BENCH_HOST_SAMPLE"] = "2"
+    # The test process env forces cpu already (conftest mutates XLA_FLAGS /
+    # JAX_PLATFORMS); clear both so the orchestrator's own fallback logic
+    # is what provisions the platform.
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines}"
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "backend"):
+        assert key in rec, f"missing {key}: {rec}"
+    assert rec["value"] > 0, rec
+    assert rec["backend"] == "cpu"
+
+
+def test_dryrun_multichip_self_provisions_devices():
+    """dryrun_multichip(n) must succeed regardless of the parent process's
+    jax platform state — it forces an n-device virtual CPU platform in a
+    fresh subprocess (the MULTICHIP_r01 rc=124 fix)."""
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__ as graft
+
+        graft.dryrun_multichip(4)
+    finally:
+        sys.path.remove(REPO)
