@@ -24,6 +24,14 @@ verify: ## Sanity: everything compiles and collects (reference `make verify` ana
 	$(PYTHON) -m compileall -q deppy_tpu tests bench.py __graft_entry__.py
 	$(PYTHON) -m pytest tests/ -q --collect-only >/dev/null
 
+.PHONY: e2e
+e2e: ## End-to-end: boot the service, exercise probes/metrics/resolve (reference Makefile:77-78 analog).
+	bash scripts/e2e.sh
+
+.PHONY: e2e-docker
+e2e-docker: docker-build ## e2e against the built container image.
+	DEPPY_E2E_MODE=docker IMG=$(IMG) bash scripts/e2e.sh
+
 ##@ Benchmarks
 
 .PHONY: bench

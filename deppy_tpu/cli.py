@@ -69,21 +69,71 @@ def _build_parser() -> argparse.ArgumentParser:
     p_serve = sub.add_parser(
         "serve", help="run the batch-resolution service"
     )
+    # Serve flags default to None (sentinel) so precedence layers cleanly:
+    # built-in defaults < --config file values < explicitly passed flags.
     p_serve.add_argument(
-        "--bind-address", default=":8080",
+        "--bind-address", default=None,
         help="API + metrics listen address (reference main.go:48-49 "
         "metrics-bind-address; default :8080)",
     )
     p_serve.add_argument(
-        "--health-probe-bind-address", default=":8081",
+        "--health-probe-bind-address", default=None,
         help="healthz/readyz listen address (reference main.go:50; "
         "default :8081)",
     )
     p_serve.add_argument(
-        "--backend", choices=["auto", "host", "tpu"], default="auto"
+        "--backend", choices=["auto", "host", "tpu"], default=None
     )
     p_serve.add_argument("--max-steps", type=int, default=None)
+    p_serve.add_argument(
+        "--config", default=None, metavar="FILE",
+        help="ResolverConfig file (the analog of the reference's "
+        "controller_manager_config.yaml, config/manager/"
+        "controller_manager_config.yaml:1-11); explicitly passed flags "
+        "override file values",
+    )
     return parser
+
+
+# ResolverConfig file keys → serve() kwargs (config/manager/
+# resolver_config.yaml).  Parsed as YAML when available, JSON otherwise
+# (the shipped config is valid YAML; JSON configs work without pyyaml).
+_CONFIG_KEYS = {
+    "bindAddress": ("bind_address", str),
+    "healthProbeBindAddress": ("probe_address", str),
+    "backend": ("backend", str),
+    "maxSteps": ("max_steps", int),
+}
+
+
+def _load_serve_config(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        import yaml
+
+        try:
+            doc = yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            raise problem_io.ProblemFormatError(
+                f"config file {path}: invalid YAML: {e}"
+            ) from e
+    except ImportError:
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise problem_io.ProblemFormatError(
+                f"config file {path}: invalid JSON: {e}"
+            ) from e
+    if not isinstance(doc, dict):
+        raise problem_io.ProblemFormatError(
+            f"config file {path}: expected a mapping, got {type(doc).__name__}"
+        )
+    out = {}
+    for key, (kwarg, cast) in _CONFIG_KEYS.items():
+        if key in doc and doc[key] is not None:
+            out[kwarg] = cast(doc[key])
+    return out
 
 
 def _cmd_resolve(args) -> int:
@@ -148,14 +198,31 @@ def _cmd_bench(args) -> int:
 def _cmd_serve(args) -> int:
     from .service import serve
 
+    # Precedence: built-in defaults < --config file < explicit flags
+    # (the reference's flag-vs-ControllerManagerConfig behavior).  Flags
+    # default to None, so a non-None parsed value IS an explicit flag.
+    kwargs = {
+        "bind_address": ":8080",
+        "probe_address": ":8081",
+        "backend": "auto",
+        "max_steps": None,
+    }
     try:
-        serve(
-            bind_address=args.bind_address,
-            probe_address=args.health_probe_bind_address,
-            backend=args.backend,
-            max_steps=args.max_steps,
-        )
-    except (ValueError, OSError) as e:
+        if args.config:
+            kwargs.update(_load_serve_config(args.config))
+        for key, val in (
+            ("bind_address", args.bind_address),
+            ("probe_address", args.health_probe_bind_address),
+            ("backend", args.backend),
+            ("max_steps", args.max_steps),
+        ):
+            if val is not None:
+                kwargs[key] = val
+        serve(**kwargs)
+    except FileNotFoundError:
+        print(f"error: no such file: {args.config}", file=sys.stderr)
+        return 2
+    except (ValueError, OSError, problem_io.ProblemFormatError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     return 0

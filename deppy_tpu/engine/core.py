@@ -5,25 +5,30 @@ algorithm the host reference engine (:mod:`deppy_tpu.sat.host`) specifies —
 which in turn mirrors /root/reference/pkg/sat/solve.go:53-119 and
 search.go:34-203:
 
-  * :func:`bcp` — boolean-constraint propagation to fixpoint over the padded
-    clause matrix plus native cardinality rows.  One round evaluates every
-    clause simultaneously (a masked gather + reduce, MXU/VPU-friendly) —
-    the dense analog of gini's sequential watched-literal propagation.
+  * :func:`bcp` / :func:`planes_fixpoint` — boolean-constraint propagation
+    to fixpoint.  Clauses and assignments live as packed int32 bitplanes;
+    one round evaluates every clause and cardinality row simultaneously
+    with bitwise algebra (the TPU-native formulation of watched-literal
+    propagation; a [C, K] gather variant remains selectable).
   * :func:`dpll` — complete search under assumptions (the analog of gini
     ``Solve()``): chronological DPLL on a fixed-size decision stack,
-    deciding the lowest-index unassigned variable false-first.  Instead of
-    snapshotting assignments per level, each iteration re-propagates from
-    the fixed assumptions plus the decision stack — O(stack) extra BCP work
-    for O(V) instead of O(V²) memory, the right trade on HBM.
+    deciding the lowest-index unassigned variable false-first.  A trail of
+    per-level plane snapshots makes each iteration propagate only its new
+    decision literal from the previous fixpoint, and backtracking a pure
+    snapshot restore.
   * :func:`search` — the preference-ordered guess search (search.go:34-203):
     the choice deque and guess stack become fixed-capacity circular-buffer /
-    stack tensors; each loop iteration dispatches one of the four reference
-    loop arms through ``lax.switch``.
+    stack tensors.  The four reference loop arms run as lane-gated masked
+    selects (not ``lax.switch``, which lowers to select under ``vmap`` and
+    would execute every arm for every lane), with guess-trail snapshots so
+    pops re-Test for free.
   * :func:`solve_full` — the whole pipeline including extras-only
     cardinality minimization (solve.go:86-113) and deletion-based
     unsat-core minimization (the engine-agnostic analog of gini ``Why``,
-    lit_mapping.go:198-207), each gated behind ``lax.cond`` so only the
-    relevant phase runs.
+    lit_mapping.go:198-207).  Phases are lane-gated: each takes an
+    ``enabled`` flag that makes its ``while_loop`` trip zero times on lanes
+    that don't need it, because under ``vmap`` a ``lax.cond`` would run
+    both branches for every lane anyway.
 
 Everything here is shape-static and batchable with ``jax.vmap``; no Python
 control flow depends on traced values.  The batch axis and device-mesh
@@ -91,6 +96,12 @@ class SolveResult(NamedTuple):
     installed: jax.Array   # bool[V] (problem-var region)
     core: jax.Array        # bool[NCON] active applied constraints (UNSAT only)
     steps: jax.Array       # i32 step counter (tests + DPLL iterations)
+    # Backtrack trace (tracer.go:13-15): row i = the guess-variable stack
+    # (-1 padded) at the i-th search backtrack.  Shape [T, GS]; T is the
+    # static trace capacity (0 = tracing off).  ``trace_n`` counts ALL
+    # backtracks, so trace_n > T means the buffer truncated.
+    trace_stack: jax.Array  # i32[T, GS]
+    trace_n: jax.Array      # i32
 
 
 # --------------------------------------------------------------------------
@@ -571,9 +582,9 @@ def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
 
 def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
            outcome0: jax.Array, budget: jax.Array, steps: jax.Array,
-           V: int, NCON: int, NV: int,
+           V: int, NCON: int, NV: int, T: int = 0,
            enabled: jax.Array = jnp.bool_(True)
-           ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+           ) -> Tuple[jax.Array, ...]:
     """The reference guess search (search.go:158-203; host: _search).
 
     Fixed-shape translation: the choice deque is a circular buffer of
@@ -606,7 +617,13 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
     ``t0``/``f0``/``outcome0`` are the baseline fixpoint planes and Test
     outcome under anchors + activations alone (solve.go:74-79).
 
-    Returns (result, guessed_mask, model, steps)."""
+    ``T`` is the static trace capacity: when positive, every backtrack
+    entry (the moment the reference calls ``Tracer.Trace``,
+    search.go:172-173) appends the current guess-variable stack to a
+    [T, GS] buffer; events past T are counted but not stored.  ``T = 0``
+    keeps tracing fully out of the compiled program.
+
+    Returns (result, guessed_mask, model, steps, trace_stack, trace_n)."""
     NC, Kc = pt.choice_cand.shape
     DQ = NC + 1
     GS = NC + 1
@@ -627,13 +644,24 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
 
     def body(st):
         (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-         snap_t, snap_f, out_st, result, m_t, m_f, assumed, done, steps) = st
+         snap_t, snap_f, out_st, result, m_t, m_f, assumed, done, steps,
+         tr_stack, tr_n) = st
 
         # Arm selection (mutually exclusive; reference precedence order).
         is_leaf = (cnt == 0) & (result == RUNNING)
         is_bt = ~is_leaf & (result == UNSAT)
         is_done = ~is_leaf & ~is_bt & (cnt == 0)
         is_push = ~is_leaf & ~is_bt & ~is_done
+
+        # Trace: the reference fires Tracer.Trace at every backtrack entry
+        # (search.go:172-173) with the pre-pop guess stack.
+        if T > 0:
+            row = jnp.where(
+                jnp.arange(GS, dtype=jnp.int32) < gsp, g_v, jnp.int32(-1)
+            )
+            tidx = jnp.where(is_bt & (tr_n < T), jnp.clip(tr_n, 0, T - 1), T)
+            tr_stack = tr_stack.at[tidx].set(row, mode="drop")
+        tr_n = tr_n + is_bt.astype(jnp.int32)
 
         cur_t = snap_t[jnp.clip(gsp, 0, GS)][None, :]
         cur_f = snap_f[jnp.clip(gsp, 0, GS)][None, :]
@@ -741,11 +769,12 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
         done = done | give_up | is_done
         steps = steps + (bt | is_push).astype(jnp.int32)
         return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-                snap_t, snap_f, out_st, result, m_t, m_f, assumed, done, steps)
+                snap_t, snap_f, out_st, result, m_t, m_f, assumed, done, steps,
+                tr_stack, tr_n)
 
     def cond(st):
-        done = st[-2]
-        steps = st[-1]
+        done = st[-4]
+        steps = st[-3]
         return enabled & ~done & (steps <= budget)
 
     st = (
@@ -756,13 +785,14 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
         jnp.int32(RUNNING), jnp.zeros((1, Wv), jnp.int32),
         jnp.zeros((1, Wv), jnp.int32), jnp.zeros(V, bool),
         jnp.bool_(False), steps,
+        jnp.full((T, GS), -1, jnp.int32), jnp.int32(0),
     )
     st = lax.while_loop(cond, body, st)
     (_, _, _, _, _, _, _, _, _, _, _, _,
-     result, m_t, m_f, assumed, done, steps) = st
+     result, m_t, m_f, assumed, done, steps, tr_stack, tr_n) = st
     result = jnp.where(done, result, jnp.int32(RUNNING))
     model = planes_to_assign(m_t, m_f, V)
-    return result, assumed, model, steps
+    return result, assumed, model, steps, tr_stack, tr_n
 
 
 # --------------------------------------------------------------------------
@@ -770,7 +800,7 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
 
 
 def solve_full(pt: ProblemTensors, budget: jax.Array,
-               *, V: int, NCON: int, NV: int) -> SolveResult:
+               *, V: int, NCON: int, NV: int, T: int = 0) -> SolveResult:
     """One problem end to end (host: HostEngine.solve; reference
     solve.go:53-119): baseline Test, guess search if undetermined,
     extras-only minimization on SAT, deletion-based core on UNSAT.
@@ -802,8 +832,9 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
 
     # ---- guess search when the baseline Test is undetermined ----
     need_search = outcome0 == RUNNING
-    s_result, s_guessed, s_model, steps = search(
-        pt, t0, f0, outcome0, budget, steps0, V, NCON, NV, enabled=need_search
+    s_result, s_guessed, s_model, steps, tr_stack, tr_n = search(
+        pt, t0, f0, outcome0, budget, steps0, V, NCON, NV, T,
+        enabled=need_search,
     )
     result = jnp.where(need_search, s_result, outcome0)
     # Baseline already decided: the anchors play the guess-set role for
@@ -815,6 +846,11 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     # The reference probes w = 0, 1, 2, … and stops at the first SAT
     # (solve.go:105-110).  Satisfiability is monotone in w, so binary
     # search over [0, n_extras] finds the same minimal w in O(log) solves.
+    # Caveat: the probe sequence (and so the steps consumed) differs from
+    # the host engine's linear scan — under a tight ``max_steps`` budget
+    # the two backends can disagree on complete-vs-incomplete for the same
+    # problem.  Outcome parity is only guaranteed with sufficient budget
+    # (pinned by tests/test_differential.py::test_minimization_budget_parity).
     sat_en = result == SAT
     extras = (model == TRUE) & ~guessed & pv_mask
     excluded = (model != TRUE) & ~guessed & pv_mask
@@ -892,13 +928,15 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
         sat_en & ~min_found
     )
     outcome = jnp.where(incomplete, jnp.int32(RUNNING), result)
-    return SolveResult(outcome=outcome, installed=installed, core=core, steps=steps)
+    return SolveResult(outcome=outcome, installed=installed, core=core,
+                       steps=steps, trace_stack=tr_stack, trace_n=tr_n)
 
 
 @functools.lru_cache(maxsize=128)
-def batched_solve(V: int, NCON: int, NV: int):
+def batched_solve(V: int, NCON: int, NV: int, T: int = 0):
     """Jitted, vmapped solve for one padded shape signature.  Cached so each
     shape bucket compiles exactly once per process (the driver buckets
-    padded dims to powers of two to bound the number of entries)."""
-    fn = functools.partial(solve_full, V=V, NCON=NCON, NV=NV)
+    padded dims to powers of two to bound the number of entries).  ``T`` is
+    the static trace capacity (0 = tracing compiled out)."""
+    fn = functools.partial(solve_full, V=V, NCON=NCON, NV=NV, T=T)
     return jax.jit(jax.vmap(fn, in_axes=(0, None)))

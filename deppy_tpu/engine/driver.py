@@ -148,11 +148,14 @@ def solve_problems(
     problems: Sequence[Problem],
     max_steps: Optional[int] = None,
     mesh=None,
+    trace_cap: int = 0,
 ) -> List[core.SolveResult]:
     """Solve lowered problems as one device batch; per-problem results with
     host numpy arrays.  With ``mesh`` (a 1-D ``jax.sharding.Mesh`` from
     :mod:`deppy_tpu.parallel`), the batch axis is sharded over the mesh's
-    devices and XLA partitions the solve — the fleet-scale path."""
+    devices and XLA partitions the solve — the fleet-scale path.
+    ``trace_cap`` > 0 compiles in backtrack tracing with that buffer depth
+    (see :class:`core.SolveResult`)."""
     for p in problems:
         if p.errors:
             raise InternalSolverError(p.errors)
@@ -166,14 +169,17 @@ def solve_problems(
         pts = shard_batch(mesh, pts)
     budget = np.int32(min(max_steps if max_steps is not None else DEFAULT_MAX_STEPS,
                           np.iinfo(np.int32).max - 1))
-    fn = core.batched_solve(d.V, d.NCON, d.NV)
+    fn = core.batched_solve(d.V, d.NCON, d.NV, trace_cap)
     res = fn(pts, budget)
     outcome = np.asarray(res.outcome)
     installed = np.asarray(res.installed)
     cores = np.asarray(res.core)
     steps = np.asarray(res.steps)
+    trace_stack = np.asarray(res.trace_stack)
+    trace_n = np.asarray(res.trace_n)
     return [
-        core.SolveResult(outcome[i], installed[i], cores[i], steps[i])
+        core.SolveResult(outcome[i], installed[i], cores[i], steps[i],
+                         trace_stack[i], trace_n[i])
         for i in range(n)
     ]
 
@@ -186,18 +192,76 @@ def _decode_core(p: Problem, active: np.ndarray) -> NotSatisfiable:
     return NotSatisfiable([p.applied[j] for j in range(p.n_cons) if active[j]])
 
 
+# Trace-buffer depth compiled in when a tracer is attached.  Deep enough
+# for any realistic catalog search; pass ``trace_cap`` to
+# :func:`solve_one` (or ``Solver(trace_cap=...)``) for pathological cases.
+# Truncation warns and is visible as stats["backtracks"] > trace calls.
+DEFAULT_TRACE_CAP = 256
+
+
+def _replay_trace(problem: Problem, res: core.SolveResult, tracer) -> None:
+    """Decode the device trace buffer into host ``Tracer.trace`` calls.
+
+    Each recorded row is the guess-variable stack at one backtrack.  The
+    conflict set is reconstructed by replaying one host-engine Test under
+    those assumptions (the host engine is the semantic spec; BCP is
+    confluent, so the replayed fixpoint — and its conflict attribution —
+    matches the device's).  A backtrack caused by an exhausted leaf DPLL
+    rather than a propagation conflict replays without conflict and
+    reports an empty conflict list, where the host engine surfaces its
+    DPLL's final internal conflict — the assumption stacks agree exactly,
+    the conflict annotation is best-effort (reference gini would compute a
+    failed-assumption core here, lit_mapping.go:198-207)."""
+    from ..sat.host import UNSAT as HOST_UNSAT
+    from ..sat.host import HostEngine, _Position
+
+    total = int(res.trace_n)
+    rows = min(total, res.trace_stack.shape[0])
+    if rows == 0:
+        return
+    if total > rows:
+        import warnings
+
+        warnings.warn(
+            f"search backtracked {total} times but the trace buffer holds "
+            f"{rows}; trailing events are dropped — raise trace_cap "
+            f"(solve_one) to capture them",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+    eng = HostEngine(problem)
+    for i in range(rows):
+        gv = [int(v) for v in res.trace_stack[i] if v >= 0]
+        outcome, _ = eng._test(guessed=tuple(gv))
+        conflicts = list(eng.last_conflicts) if outcome == HOST_UNSAT else []
+        tracer.trace(
+            _Position([problem.variables[v] for v in gv], conflicts)
+        )
+
+
 def solve_one(
     problem: Problem,
     max_steps: Optional[int] = None,
     stats: Optional[dict] = None,
+    tracer=None,
+    trace_cap: Optional[int] = None,
 ) -> List[Variable]:
     """Single-problem entry used by :class:`deppy_tpu.sat.solver.Solver`
     (batch of one).  Same error contract as the host engine.  A ``stats``
     dict, when given, receives ``{"steps": N}`` — the engine iteration count
-    (SURVEY.md §5 observability)."""
-    (res,) = solve_problems([problem], max_steps=max_steps)
+    (SURVEY.md §5 observability).  A ``tracer`` receives one ``trace`` call
+    per search backtrack, like the host engine (reference tracer.go:13-15);
+    ``trace_cap`` sizes the device-side event buffer (default
+    ``DEFAULT_TRACE_CAP``; a warning fires if the search overflows it)."""
+    if trace_cap is None:
+        trace_cap = DEFAULT_TRACE_CAP if tracer is not None else 0
+    (res,) = solve_problems([problem], max_steps=max_steps,
+                            trace_cap=trace_cap)
     if stats is not None:
         stats["steps"] = int(res.steps)
+        stats["backtracks"] = int(res.trace_n)
+    if tracer is not None:
+        _replay_trace(problem, res, tracer)
     if res.outcome == core.SAT:
         return _decode_installed(problem, res.installed)
     if res.outcome == core.UNSAT:

@@ -44,11 +44,15 @@ class Solver:
         tracer: Optional[Tracer] = None,
         backend: str = "auto",
         max_steps: Optional[int] = None,
+        trace_cap: Optional[int] = None,
     ):
         self.problem: Problem = encode(variables)
         self.tracer = tracer
         self.backend = backend
         self.max_steps = max_steps
+        # Device-side trace buffer depth for the tensor backend (None =
+        # driver default); the host engine traces unbuffered.
+        self.trace_cap = trace_cap
         # Engine iterations consumed by the last solve (SURVEY.md §5).
         self.steps: int = 0
 
@@ -67,7 +71,9 @@ class Solver:
 
         stats: dict = {}
         try:
-            return solve_one(self.problem, max_steps=self.max_steps, stats=stats)
+            return solve_one(self.problem, max_steps=self.max_steps,
+                             stats=stats, tracer=self.tracer,
+                             trace_cap=self.trace_cap)
         finally:
             self.steps = stats.get("steps", 0)
 

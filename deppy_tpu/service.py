@@ -129,9 +129,11 @@ class Server:
         probe_address: str = ":8081",
         backend: str = "auto",
         max_steps: Optional[int] = None,
+        max_body_bytes: int = 8 * 1024 * 1024,
     ):
         self.backend = backend
         self.max_steps = max_steps
+        self.max_body_bytes = max_body_bytes
         self.metrics = Metrics()
         self.ready = threading.Event()
         self._api = _make_http_server(
@@ -258,6 +260,24 @@ def _api_handler(server: Server):
                 return
             try:
                 length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                server.metrics.observe_error()
+                self._send_json(400, {"error": "invalid Content-Length"})
+                return
+            if length < 0:
+                server.metrics.observe_error()
+                self._send_json(400, {"error": "invalid Content-Length"})
+                return
+            if length > server.max_body_bytes:
+                # A client-controlled Content-Length must not be able to
+                # buffer unbounded memory on the service.
+                server.metrics.observe_error()
+                self._send_json(
+                    413,
+                    {"error": f"body exceeds {server.max_body_bytes} bytes"},
+                )
+                return
+            try:
                 doc = json.loads(self.rfile.read(length) or b"null")
             except (ValueError, json.JSONDecodeError) as e:
                 server.metrics.observe_error()
@@ -303,16 +323,33 @@ def serve(
     max_steps: Optional[int] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
-    mgr.Start, main.go:85)."""
+    mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
+    stops the shipped Deployment's pods) as well as Ctrl-C: readiness is
+    cleared and both listeners drain via ``shutdown()`` instead of dying
+    mid-request."""
+    import signal
+
     srv = Server(bind_address, probe_address, backend, max_steps)
     srv.start()
+    stop = threading.Event()
+
+    def _on_sigterm(signum, frame):
+        srv.ready.clear()  # flip /readyz before draining
+        stop.set()
+
+    # Handler goes in before the startup banner: the banner is the "ready
+    # to be signaled" cue for process supervisors (and the e2e test).
+    prev = signal.signal(signal.SIGTERM, _on_sigterm)
     print(
         f"deppy service listening on :{srv.api_port} "
         f"(probes on :{srv.probe_port})",
         flush=True,
     )
     try:
-        while True:
-            time.sleep(3600)
+        while not stop.is_set():
+            stop.wait(3600)
     except KeyboardInterrupt:
+        pass
+    finally:
+        signal.signal(signal.SIGTERM, prev)
         srv.shutdown()

@@ -194,3 +194,56 @@ class TestResolveCommand:
         rc = main(["resolve", path, "--backend", "host", "--max-steps", "1"])
         assert rc == 3
         assert "resolution incomplete" in capsys.readouterr().out
+
+
+class TestServeConfig:
+    """ResolverConfig file loading (the controller_manager_config.yaml
+    analog, config/manager/resolver_config.yaml)."""
+
+    def test_load_serve_config_parses_keys(self, tmp_path):
+        from deppy_tpu.cli import _load_serve_config
+
+        path = tmp_path / "cfg.yaml"
+        path.write_text(
+            "apiVersion: deppy-tpu.io/v1alpha1\n"
+            "kind: ResolverConfig\n"
+            'bindAddress: ":9090"\n'
+            'healthProbeBindAddress: ":9091"\n'
+            "backend: host\n"
+            "maxSteps: 123\n"
+        )
+        assert _load_serve_config(str(path)) == {
+            "bind_address": ":9090",
+            "probe_address": ":9091",
+            "backend": "host",
+            "max_steps": 123,
+        }
+
+    def test_load_serve_config_json_fallback_shape(self, tmp_path):
+        from deppy_tpu.cli import _load_serve_config
+
+        path = tmp_path / "cfg.json"
+        path.write_text('{"bindAddress": ":7070", "backend": "host"}')
+        assert _load_serve_config(str(path)) == {
+            "bind_address": ":7070",
+            "backend": "host",
+        }
+
+    def test_shipped_config_parses(self):
+        import pathlib
+
+        from deppy_tpu.cli import _load_serve_config
+
+        shipped = (
+            pathlib.Path(__file__).resolve().parent.parent
+            / "config" / "manager" / "resolver_config.yaml"
+        )
+        cfg = _load_serve_config(str(shipped))
+        assert cfg["bind_address"] == ":8080"
+        assert cfg["probe_address"] == ":8081"
+        assert cfg["backend"] == "auto"
+
+    def test_missing_config_is_usage_error(self, capsys):
+        rc = main(["serve", "--config", "/nonexistent/cfg.yaml"])
+        assert rc == 2
+        assert "no such file" in capsys.readouterr().err

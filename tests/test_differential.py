@@ -65,6 +65,40 @@ def test_batched_device_matches_host():
         assert h == d, f"problem {i}: host {h} != device {d}"
 
 
+def test_minimization_budget_parity():
+    """Budget-parity contract (engine/core.py minimization caveat): with
+    ample budget both backends complete with identical results; under a
+    tight budget a backend may report Incomplete where the other completes
+    (the tensor engine's binary-search minimization consumes a different
+    probe sequence than the host's linear scan) — but a *completed* answer
+    must always equal the full-budget one.  Wrong answers are never an
+    acceptable budget outcome."""
+    variables = random_instance(length=24, seed=5)
+
+    def run(backend, max_steps):
+        try:
+            inst = sat.Solver(
+                variables, backend=backend, max_steps=max_steps
+            ).solve()
+            return ("sat", sorted(v.identifier for v in inst))
+        except sat.NotSatisfiable as e:
+            return ("unsat", sorted(str(ac) for ac in e.constraints))
+        except sat.Incomplete:
+            return ("incomplete", None)
+
+    full = run("host", None)
+    assert full[0] != "incomplete"
+    assert run("tpu", None) == full
+
+    for budget in (1, 3, 10, 30, 100, 1000):
+        for backend in ("host", "tpu"):
+            got = run(backend, budget)
+            assert got == full or got[0] == "incomplete", (
+                f"{backend} at budget {budget}: {got} is neither the "
+                f"full-budget answer {full} nor incomplete"
+            )
+
+
 @pytest.mark.parametrize("seed", [3, 7])
 def test_single_device_solve_matches_host(seed: int):
     """Batch-of-one path through sat.Solver (distinct from BatchResolver)."""

@@ -103,6 +103,35 @@ class TestResolveAPI:
         status, _ = request(server.api_port, "GET", "/other")
         assert status == 404
 
+    def test_oversized_body_rejected_413(self):
+        srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                     backend="host", max_body_bytes=64)
+        srv.start()
+        try:
+            body = json.dumps({"variables": [{"id": "x" * 200}]})
+            conn = HTTPConnection("127.0.0.1", srv.api_port, timeout=10)
+            conn.request("POST", "/v1/resolve", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 413
+            assert b"exceeds" in resp.read()
+            conn.close()
+            _, mdata = request(srv.api_port, "GET", "/metrics")
+            assert "deppy_request_errors_total 1" in mdata.decode()
+        finally:
+            srv.shutdown()
+
+    def test_negative_content_length_rejected_400(self, server):
+        conn = HTTPConnection("127.0.0.1", server.api_port, timeout=10)
+        conn.putrequest("POST", "/v1/resolve")
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader("Content-Length", "-5")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert b"Content-Length" in resp.read()
+        conn.close()
+
 
 class TestMetrics:
     def test_counters_advance(self, server):
@@ -236,6 +265,40 @@ def test_ipv6_bind():
         conn.close()
     finally:
         srv.shutdown()
+
+
+def test_serve_exits_cleanly_on_sigterm():
+    # Kubernetes stops the shipped Deployment's pods with SIGTERM; serve()
+    # must drain and exit 0, not die on an unhandled signal (exit 143).
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "from deppy_tpu.service import serve; "
+         "serve(bind_address='127.0.0.1:0', probe_address='127.0.0.1:0', "
+         "backend='host')"],
+        cwd=repo,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        # Wait for the startup banner so listeners exist before signaling.
+        line = proc.stdout.readline()
+        assert "deppy service listening" in line, line
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        assert rc == 0, f"rc={rc}: {proc.stdout.read()}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
 
 
 def test_engine_steps_metric_advances(server):

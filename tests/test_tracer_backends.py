@@ -1,0 +1,125 @@
+"""Tracer parity across backends (VERDICT round-1 item 4).
+
+The reference fires ``Tracer.Trace`` at every search backtrack
+(/root/reference/pkg/sat/tracer.go:13-15, search.go:172-173).  The host
+engine always honored this; these tests pin that the tensor backend does
+too: same number of backtrack events, same assumption stacks, and a
+usable LoggingTracer transcript.
+"""
+
+from __future__ import annotations
+
+import io
+
+import pytest
+
+from deppy_tpu import sat
+from deppy_tpu.models import random_instance
+
+pytest.importorskip("jax")
+
+
+def _doomed(b: str) -> list:
+    """Variables making ``b`` unsatisfiable only one guess deeper than unit
+    propagation can see: b needs one of {x, y} and one of {w, z}, but every
+    cross pair conflicts.  Any candidate guess conflicts on propagation, so
+    the search backtracks rather than resolving it at Test time."""
+    return [
+        sat.variable(b, sat.dependency("x", "y"), sat.dependency("w", "z")),
+        sat.variable("x", sat.conflict("w"), sat.conflict("z")),
+        sat.variable("y", sat.conflict("w"), sat.conflict("z")),
+        sat.variable("w"),
+        sat.variable("z"),
+    ]
+
+
+def _backtracking_instance():
+    """The preferred candidate b is doomed one level deep; the search must
+    backtrack out of b's subtree and fall back to c."""
+    return [
+        sat.variable("a", sat.mandatory(), sat.dependency("b", "c")),
+        sat.variable("c"),
+    ] + _doomed("b")
+
+
+def _unsat_instance():
+    """The only dependency candidate is doomed: the search exhausts every
+    guess and gives up, producing multiple backtrack events before
+    NotSatisfiable."""
+    return [
+        sat.variable("a", sat.mandatory(), sat.dependency("b")),
+    ] + _doomed("b")
+
+
+class _RecordingTracer:
+    def __init__(self) -> None:
+        self.positions: list = []
+
+    def trace(self, position) -> None:
+        self.positions.append(
+            (
+                [v.identifier for v in position.variables()],
+                [str(c) for c in position.conflicts()],
+            )
+        )
+
+
+def _run(variables, backend, tracer):
+    try:
+        sat.Solver(variables, tracer=tracer, backend=backend).solve()
+        return "sat"
+    except sat.NotSatisfiable:
+        return "unsat"
+
+
+@pytest.mark.parametrize(
+    "make", [_backtracking_instance, _unsat_instance],
+    ids=["backtrack-sat", "exhaust-unsat"],
+)
+def test_assumption_stacks_match_host(make):
+    host_t, dev_t = _RecordingTracer(), _RecordingTracer()
+    assert _run(make(), "host", host_t) == _run(make(), "tpu", dev_t)
+    assert host_t.positions, "instance did not backtrack — test is vacuous"
+    assert [p[0] for p in dev_t.positions] == [p[0] for p in host_t.positions]
+    # Conflict annotation: exact parity whenever the backtrack came from a
+    # propagation conflict (the replay reproduces it); the leaf-DPLL case
+    # is documented best-effort (driver._replay_trace).
+    for (h_vars, h_conf), (d_vars, d_conf) in zip(
+        host_t.positions, dev_t.positions
+    ):
+        if d_conf:
+            assert d_conf == h_conf
+
+
+def test_stats_tracer_counts_backtracks_on_tensor_backend():
+    host_t, dev_t = sat.StatsTracer(), sat.StatsTracer()
+    _run(_unsat_instance(), "host", host_t)
+    _run(_unsat_instance(), "tpu", dev_t)
+    assert dev_t.backtracks > 0
+    assert dev_t.backtracks == host_t.backtracks
+
+
+def test_logging_tracer_produces_transcript_on_tensor_backend():
+    out = io.StringIO()
+    _run(_backtracking_instance(), "tpu", sat.LoggingTracer(out))
+    text = out.getvalue()
+    assert "---\nAssumptions:\n" in text
+    assert "- b\n" in text
+    assert "Conflicts:\n" in text
+
+
+def test_trace_counts_match_on_fuzz_instances():
+    """Backtrack-count parity over the benchmark distribution: the two
+    engines implement the same search, so the trace stream has the same
+    length on every instance."""
+    mismatches = []
+    for seed in range(8):
+        variables = random_instance(length=24, seed=seed, p_conflict=0.3)
+        host_t, dev_t = sat.StatsTracer(), sat.StatsTracer()
+        h = _run(variables, "host", host_t)
+        d = _run(variables, "tpu", dev_t)
+        if (h, host_t.backtracks) != (d, dev_t.backtracks):
+            mismatches.append(
+                (seed, h, host_t.backtracks, d, dev_t.backtracks)
+            )
+    assert not mismatches, mismatches
