@@ -462,15 +462,16 @@ def test_outcome(conflict: jax.Array, t: jax.Array, f: jax.Array,
 # DPLL
 
 
-def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
-         min_w: jax.Array, budget: jax.Array, steps: jax.Array, NV: int,
+def dpll(pt: ProblemTensors, t_init: jax.Array, f_init: jax.Array,
+         min_bits: jax.Array, min_w: jax.Array, budget: jax.Array,
+         steps: jax.Array, NV: int, V: int,
          enabled: jax.Array = jnp.bool_(True)
-         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Complete search under the fixed partial assignment ``init`` — the
-    analog of gini ``Solve()`` (search.go:168, solve.go:107) and of
-    HostEngine._dpll: false-first decisions on the lowest-index unassigned
-    problem variable, chronological backtracking that flips the deepest
-    unflipped decision.
+         ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Complete search under the fixed partial assignment given as packed
+    ``(t_init, f_init)`` planes — the analog of gini ``Solve()``
+    (search.go:168, solve.go:107) and of HostEngine._dpll: false-first
+    decisions on the lowest-index unassigned problem variable,
+    chronological backtracking that flips the deepest unflipped decision.
 
     Trail-style snapshots: ``snap[k]`` holds the packed-plane fixpoint
     after ``k`` decisions, so each iteration propagates only the *new*
@@ -478,20 +479,19 @@ def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
     confluent — the incremental fixpoint equals the from-scratch one), and
     backtracking restores a snapshot instead of re-propagating the whole
     stack.  The decision order, phases, and discovered model are identical
-    to the rebuild-from-scratch formulation.  Returns (status, model,
-    steps).
+    to the rebuild-from-scratch formulation.  All inputs and the returned
+    model stay in packed plane form — no [V]-length unpack anywhere on the
+    iteration path.  Returns (status, model_t, model_f, steps).
 
     A disabled lane runs zero iterations and returns status RUNNING; the
     caller must discard it (see :func:`bcp` for the lane-gating idiom)."""
-    V = init.shape[0]
     Wv = pt.pos_bits.shape[1]
     lvl = jnp.arange(NV, dtype=jnp.int32)
     pvb = pack_mask(jnp.arange(V, dtype=jnp.int32) < pt.n_vars, Wv)
-    min_bits = pack_mask(min_mask, Wv)
 
-    t0 = pack_mask(init == TRUE, Wv)
-    f0 = pack_mask(init == FALSE, Wv)
-    conflict0, t0, f0 = planes_fixpoint(pt, t0, f0, min_bits, min_w, enabled, V)
+    conflict0, t0, f0 = planes_fixpoint(
+        pt, t_init, f_init, min_bits, min_w, enabled, V
+    )
     status0 = jnp.where(conflict0, jnp.int32(UNSAT), jnp.int32(RUNNING))
     snap_t0 = jnp.zeros((NV + 1, Wv), jnp.int32).at[0].set(t0[0])
     snap_f0 = jnp.zeros((NV + 1, Wv), jnp.int32).at[0].set(f0[0])
@@ -504,11 +504,15 @@ def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
 
         # SAT when the problem-var region is totalized at the current level
         # (a pending flip always has its own variable unassigned, so this
-        # can only fire on the decide path).
-        un_bits = pvb & ~(t | f)
-        has_un = (un_bits != 0).any()
-        un = unpack_mask(un_bits, V)
-        first_un = jnp.argmax(un).astype(jnp.int32)
+        # can only fire on the decide path).  First-unassigned comes from
+        # packed bit algebra: lowest set bit of the first nonzero word.
+        un_words = (pvb & ~(t | f))[0]
+        nz = un_words != 0
+        has_un = nz.any()
+        wi = jnp.argmax(nz).astype(jnp.int32)
+        word = un_words[wi]
+        lsb = word & -word
+        first_un = wi * WORD + popcount32(lsb - 1)
         sat_now = ~flip & ~has_un
         status = jnp.where(sat_now, jnp.int32(SAT), status)
         m_t = jnp.where(sat_now, t, m_t)
@@ -572,8 +576,7 @@ def dpll(pt: ProblemTensors, init: jax.Array, min_mask: jax.Array,
         steps,
     )
     (_, _, _, _, status, m_t, m_f, _, _, steps) = lax.while_loop(cond, body, st)
-    model = planes_to_assign(m_t, m_f, V)
-    return status, model, steps
+    return status, m_t, m_f, steps
 
 
 # --------------------------------------------------------------------------
