@@ -671,16 +671,16 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
 
         # --- arm 0: leaf DPLL (search.go:167-169), lane-gated -----------
         # Starts from the current guess-level fixpoint (equivalent to the
-        # assumption set: same fixpoint, so same search).
-        init = planes_to_assign(cur_t, cur_f, V)
-        no_min = jnp.zeros(V, bool)
-        leaf_status, leaf_model, steps = dpll(
-            pt, init, no_min, jnp.int32(0), budget, steps, NV, enabled=is_leaf
+        # assumption set: same fixpoint, so same search).  Planes pass
+        # straight through — no assignment-form round trip.
+        leaf_status, leaf_t, leaf_f, steps = dpll(
+            pt, cur_t, cur_f, no_min_bits, jnp.int32(0), budget, steps,
+            NV, V, enabled=is_leaf,
         )
         result = jnp.where(is_leaf, leaf_status, result)
         leaf_sat = is_leaf & (leaf_status == SAT)
-        m_t = jnp.where(leaf_sat, pack_mask(leaf_model == TRUE, Wv), m_t)
-        m_f = jnp.where(leaf_sat, pack_mask(leaf_model == FALSE, Wv), m_f)
+        m_t = jnp.where(leaf_sat, leaf_t, m_t)
+        m_f = jnp.where(leaf_sat, leaf_f, m_f)
         # Budget exhaustion leaves status RUNNING; the outer cond exits.
 
         # --- arm 1: backtrack bookkeeping (PopGuess, search.go:79-98) ---
@@ -819,6 +819,7 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     steps0 = jnp.int32(1)
     Wv = pt.pos_bits.shape[1]
     pvb = pack_mask(pv_mask, Wv)
+    no_min_bits = jnp.zeros((1, Wv), jnp.int32)
 
     # Baseline Test under anchors + activations (solve.go:74-79), computed
     # as planes so the search can snapshot from it.
@@ -827,8 +828,7 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     t0 = pack_mask(base == TRUE, Wv)
     f0 = pack_mask(base == FALSE, Wv)
     conflict0, t0, f0 = planes_fixpoint(
-        pt, t0, f0, jnp.zeros((1, Wv), jnp.int32), jnp.int32(0),
-        jnp.bool_(True), V,
+        pt, t0, f0, no_min_bits, jnp.int32(0), jnp.bool_(True), V,
     )
     outcome0 = test_outcome(conflict0, t0, f0, pvb)
     a0 = planes_to_assign(t0, f0, V)
@@ -862,33 +862,40 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     m_init = jnp.where(guessed, jnp.int32(TRUE), m_init)
     m_init = jnp.where(excluded, jnp.int32(FALSE), m_init)
     n_extras = extras.sum()
+    # Pack the probe's fixed partial assignment and the extras set once —
+    # every minimization probe starts from the same planes.
+    m_init_t = pack_mask(m_init == TRUE, Wv)
+    m_init_f = pack_mask(m_init == FALSE, Wv)
+    extras_bits = pack_mask(extras, Wv)
 
     def mcond(c):
         lo, hi, _, _, _, steps = c
         return sat_en & (lo < hi) & (steps <= budget)
 
     def mbody(c):
-        lo, hi, best_w, m2, found, steps = c
+        lo, hi, best_w, m2_t, found, steps = c
         w = (lo + hi) // 2
-        status, m, steps = dpll(pt, m_init, extras, w, budget, steps, NV,
-                                enabled=sat_en)
+        status, mt, _, steps = dpll(
+            pt, m_init_t, m_init_f, extras_bits, w, budget, steps, NV, V,
+            enabled=sat_en,
+        )
         sat_w = status == SAT
         # SAT at w: the minimum is ≤ w — keep this probe's model and shrink
         # hi.  UNSAT at w: the minimum is > w.  Budget exhaustion (RUNNING)
         # changes nothing; the steps guard exits.
         best_w = jnp.where(sat_w, w, best_w)
-        m2 = jnp.where(sat_w, m, m2)
+        m2_t = jnp.where(sat_w, mt, m2_t)
         found = found | sat_w
         lo = jnp.where(sat_w, lo, jnp.where(status == UNSAT, w + 1, hi))
         hi = jnp.where(sat_w, w, hi)
-        return lo, hi, best_w, m2, found, steps
+        return lo, hi, best_w, m2_t, found, steps
 
     # Invariant: UNSAT strictly below lo, SAT at hi (the search/baseline
     # model witnesses w = n_extras).  At exit lo == hi == minimal w.
-    _, m_hi, best_w, m2, m_found, steps = lax.while_loop(
+    _, m_hi, best_w, m2_t, m_found, steps = lax.while_loop(
         mcond, mbody,
-        (jnp.int32(0), n_extras, jnp.int32(-1), model, jnp.bool_(False),
-         steps),
+        (jnp.int32(0), n_extras, jnp.int32(-1), pack_mask(model == TRUE, Wv),
+         jnp.bool_(False), steps),
     )
     # The reported model must come from a probe at the minimal w itself —
     # the reference returns the w-bounded dpll model, which can differ from
@@ -896,11 +903,13 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     # once more if the last SAT probe wasn't at the final bound (also
     # covers n_extras == 0, where the loop never runs).
     need_final = sat_en & (best_w != m_hi)
-    f_status, f_m, steps = dpll(pt, m_init, extras, m_hi, budget, steps, NV,
-                                enabled=need_final)
-    m2 = jnp.where(need_final & (f_status == SAT), f_m, m2)
+    f_status, f_t, _, steps = dpll(
+        pt, m_init_t, m_init_f, extras_bits, m_hi, budget, steps, NV, V,
+        enabled=need_final,
+    )
+    m2_t = jnp.where(need_final & (f_status == SAT), f_t, m2_t)
     min_found = jnp.where(need_final, f_status == SAT, m_found)
-    installed = (m2 == TRUE) & pv_mask & min_found & sat_en
+    installed = unpack_mask(m2_t, V) & pv_mask & min_found & sat_en
 
     # ---- UNSAT: deletion-based unsat-core minimization ----
     # Start from all applied constraints active and drop any whose removal
@@ -917,9 +926,11 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
         j, active, steps = c
         trial = active.at[j].set(False)
         init = _base_assignment(pt, V, NCON, act_enabled=trial)
-        no_min = jnp.zeros(V, bool)
-        status, _, steps = dpll(pt, init, no_min, jnp.int32(0), budget,
-                                steps, NV, enabled=unsat_en)
+        status, _, _, steps = dpll(
+            pt, pack_mask(init == TRUE, Wv), pack_mask(init == FALSE, Wv),
+            no_min_bits, jnp.int32(0), budget, steps, NV, V,
+            enabled=unsat_en,
+        )
         active = jnp.where(status == UNSAT, trial, active)
         return j + 1, active, steps
 
