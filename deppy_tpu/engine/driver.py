@@ -199,22 +199,44 @@ def _decode_core(p: Problem, active: np.ndarray) -> NotSatisfiable:
 DEFAULT_TRACE_CAP = 256
 
 
+class _LazyReplayPosition:
+    """``SearchPosition`` whose conflict set is reconstructed on demand.
+
+    The assumption stack comes straight off the device trace buffer; the
+    conflict list requires a host-engine replay, so it is computed only
+    when a tracer actually calls ``conflicts()``.  Stats-only tracers
+    (e.g. ``StatsTracer``) therefore cost zero host solves — the tracer
+    contract only promises the position, not an eager materialization
+    (reference tracer.go:13-15)."""
+
+    def __init__(self, variables, compute_conflicts):
+        self._variables = variables
+        self._compute = compute_conflicts
+        self._conflicts = None
+
+    def variables(self):
+        return self._variables
+
+    def conflicts(self):
+        if self._conflicts is None:
+            self._conflicts = self._compute()
+        return self._conflicts
+
+
 def _replay_trace(problem: Problem, res: core.SolveResult, tracer) -> None:
     """Decode the device trace buffer into host ``Tracer.trace`` calls.
 
     Each recorded row is the guess-variable stack at one backtrack.  The
-    conflict set is reconstructed by replaying one host-engine Test under
-    those assumptions (the host engine is the semantic spec; BCP is
-    confluent, so the replayed fixpoint — and its conflict attribution —
-    matches the device's).  A backtrack caused by an exhausted leaf DPLL
-    rather than a propagation conflict replays without conflict and
-    reports an empty conflict list, where the host engine surfaces its
-    DPLL's final internal conflict — the assumption stacks agree exactly,
-    the conflict annotation is best-effort (reference gini would compute a
+    conflict set is reconstructed — lazily, on first ``conflicts()``
+    access — by replaying one host-engine Test under those assumptions
+    (the host engine is the semantic spec; BCP is confluent, so the
+    replayed fixpoint — and its conflict attribution — matches the
+    device's).  A backtrack caused by an exhausted leaf DPLL rather than
+    a propagation conflict replays without conflict and reports an empty
+    conflict list, where the host engine surfaces its DPLL's final
+    internal conflict — the assumption stacks agree exactly, the conflict
+    annotation is best-effort (reference gini would compute a
     failed-assumption core here, lit_mapping.go:198-207)."""
-    from ..sat.host import UNSAT as HOST_UNSAT
-    from ..sat.host import HostEngine, _Position
-
     total = int(res.trace_n)
     rows = min(total, res.trace_stack.shape[0])
     if rows == 0:
@@ -229,13 +251,27 @@ def _replay_trace(problem: Problem, res: core.SolveResult, tracer) -> None:
             RuntimeWarning,
             stacklevel=3,
         )
-    eng = HostEngine(problem)
+    eng_box: list = []
+
+    def _conflicts_for(gv):
+        def compute():
+            from ..sat.host import UNSAT as HOST_UNSAT
+            from ..sat.host import HostEngine
+
+            if not eng_box:
+                eng_box.append(HostEngine(problem))
+            eng = eng_box[0]
+            outcome, _ = eng._test(guessed=tuple(gv))
+            return list(eng.last_conflicts) if outcome == HOST_UNSAT else []
+
+        return compute
+
     for i in range(rows):
         gv = [int(v) for v in res.trace_stack[i] if v >= 0]
-        outcome, _ = eng._test(guessed=tuple(gv))
-        conflicts = list(eng.last_conflicts) if outcome == HOST_UNSAT else []
         tracer.trace(
-            _Position([problem.variables[v] for v in gv], conflicts)
+            _LazyReplayPosition(
+                [problem.variables[v] for v in gv], _conflicts_for(gv)
+            )
         )
 
 

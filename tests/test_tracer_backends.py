@@ -99,6 +99,26 @@ def test_stats_tracer_counts_backtracks_on_tensor_backend():
     assert dev_t.backtracks == host_t.backtracks
 
 
+def test_stats_tracer_costs_zero_host_replays(monkeypatch):
+    """Conflict reconstruction replays a host-engine Test per backtrack —
+    but only when a tracer actually asks for ``conflicts()``.  A stats-only
+    tracer must never trigger a host solve (VERDICT r2 item 7)."""
+    from deppy_tpu.sat import host as host_mod
+
+    calls = {"n": 0}
+    real_test = host_mod.HostEngine._test
+
+    def counting_test(self, *a, **kw):
+        calls["n"] += 1
+        return real_test(self, *a, **kw)
+
+    monkeypatch.setattr(host_mod.HostEngine, "_test", counting_test)
+    dev_t = sat.StatsTracer()
+    _run(_unsat_instance(), "tpu", dev_t)
+    assert dev_t.backtracks > 0
+    assert calls["n"] == 0, "stats-only tracer triggered host replays"
+
+
 def test_logging_tracer_produces_transcript_on_tensor_backend():
     out = io.StringIO()
     _run(_backtracking_instance(), "tpu", sat.LoggingTracer(out))
