@@ -320,6 +320,10 @@ def set_bcp_impl(name: str) -> None:
         raise ValueError(f"unknown BCP impl {name!r}")
     _BCP_IMPL = name
     batched_solve.cache_clear()
+    batched_search.cache_clear()
+    batched_core.cache_clear()
+    batched_minimize_gated.cache_clear()
+    batched_core_gated.cache_clear()
 
 
 def _resolved_impl() -> str:
@@ -802,18 +806,14 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
 # full pipeline
 
 
-def solve_full(pt: ProblemTensors, budget: jax.Array,
-               *, V: int, NCON: int, NV: int, T: int = 0) -> SolveResult:
-    """One problem end to end (host: HostEngine.solve; reference
-    solve.go:53-119): baseline Test, guess search if undetermined,
-    extras-only minimization on SAT, deletion-based core on UNSAT.
-
-    Every phase runs unconditionally but lane-gated: under ``vmap`` a
-    ``lax.cond`` would execute both branches for every lane anyway (select
-    semantics), so the phases instead take an ``enabled`` flag that makes
-    their loops trip zero times on lanes that don't need them — a SAT lane
-    pays nothing for core extraction, an UNSAT lane nothing for
-    minimization."""
+def search_phase(pt: ProblemTensors, budget: jax.Array,
+                 en: jax.Array = jnp.bool_(True),
+                 *, V: int, NCON: int, NV: int, T: int = 0
+                 ) -> Tuple[jax.Array, ...]:
+    """Phase 1: baseline Test + preference-ordered guess search
+    (solve.go:53-85).  Returns (result, guessed, model, steps, tr_stack,
+    tr_n).  ``en`` gates the whole phase (padding lanes of a compacted
+    batch run zero propagation rounds and report RUNNING)."""
     idxV = jnp.arange(V, dtype=jnp.int32)
     pv_mask = idxV < pt.n_vars
     steps0 = jnp.int32(1)
@@ -828,13 +828,13 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     t0 = pack_mask(base == TRUE, Wv)
     f0 = pack_mask(base == FALSE, Wv)
     conflict0, t0, f0 = planes_fixpoint(
-        pt, t0, f0, no_min_bits, jnp.int32(0), jnp.bool_(True), V,
+        pt, t0, f0, no_min_bits, jnp.int32(0), en, V,
     )
     outcome0 = test_outcome(conflict0, t0, f0, pvb)
     a0 = planes_to_assign(t0, f0, V)
 
     # ---- guess search when the baseline Test is undetermined ----
-    need_search = outcome0 == RUNNING
+    need_search = en & (outcome0 == RUNNING)
     s_result, s_guessed, s_model, steps, tr_stack, tr_n = search(
         pt, t0, f0, outcome0, budget, steps0, V, NCON, NV, T,
         enabled=need_search,
@@ -844,24 +844,36 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     # minimization (solve.go:77-83).
     guessed = jnp.where(need_search, s_guessed, _anchor_mask(pt, V))
     model = jnp.where(need_search, s_model, a0)
+    result = jnp.where(en, result, jnp.int32(RUNNING))
+    return result, guessed, model, steps, tr_stack, tr_n
 
-    # ---- SAT: extras-only cardinality minimization (solve.go:86-113) ----
-    # The reference probes w = 0, 1, 2, … and stops at the first SAT
-    # (solve.go:105-110).  Satisfiability is monotone in w, so binary
-    # search over [0, n_extras] finds the same minimal w in O(log) solves.
-    # Caveat: the probe sequence (and so the steps consumed) differs from
-    # the host engine's linear scan — under a tight ``max_steps`` budget
-    # the two backends can disagree on complete-vs-incomplete for the same
-    # problem.  Outcome parity is only guaranteed with sufficient budget
-    # (pinned by tests/test_differential.py::test_minimization_budget_parity).
-    sat_en = result == SAT
+
+def minimize_phase(pt: ProblemTensors, model: jax.Array, guessed: jax.Array,
+                   budget: jax.Array, steps: jax.Array,
+                   en: jax.Array = jnp.bool_(True),
+                   *, V: int, NCON: int, NV: int
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Phase 2 (SAT lanes): extras-only cardinality minimization
+    (solve.go:86-113).  Returns (installed, min_found, steps).
+
+    The reference probes w = 0, 1, 2, … and stops at the first SAT
+    (solve.go:105-110).  Satisfiability is monotone in w, so binary
+    search over [0, n_extras] finds the same minimal w in O(log) solves.
+    Caveat: the probe sequence (and so the steps consumed) differs from
+    the host engine's linear scan — under a tight ``max_steps`` budget
+    the two backends can disagree on complete-vs-incomplete for the same
+    problem.  Outcome parity is only guaranteed with sufficient budget
+    (pinned by tests/test_differential.py::test_minimization_budget_parity)."""
+    idxV = jnp.arange(V, dtype=jnp.int32)
+    pv_mask = idxV < pt.n_vars
+    Wv = pt.pos_bits.shape[1]
     extras = (model == TRUE) & ~guessed & pv_mask
     excluded = (model != TRUE) & ~guessed & pv_mask
     m_init = _base_assignment(pt, V, NCON)
     m_init = _apply_anchors(pt, m_init, V)
     m_init = jnp.where(guessed, jnp.int32(TRUE), m_init)
     m_init = jnp.where(excluded, jnp.int32(FALSE), m_init)
-    n_extras = extras.sum()
+    n_extras = jnp.where(en, extras.sum(), 0)
     # Pack the probe's fixed partial assignment and the extras set once —
     # every minimization probe starts from the same planes.
     m_init_t = pack_mask(m_init == TRUE, Wv)
@@ -870,14 +882,14 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
 
     def mcond(c):
         lo, hi, _, _, _, steps = c
-        return sat_en & (lo < hi) & (steps <= budget)
+        return en & (lo < hi) & (steps <= budget)
 
     def mbody(c):
         lo, hi, best_w, m2_t, found, steps = c
         w = (lo + hi) // 2
         status, mt, _, steps = dpll(
             pt, m_init_t, m_init_f, extras_bits, w, budget, steps, NV, V,
-            enabled=sat_en,
+            enabled=en,
         )
         sat_w = status == SAT
         # SAT at w: the minimum is ≤ w — keep this probe's model and shrink
@@ -900,27 +912,42 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     # The reported model must come from a probe at the minimal w itself —
     # the reference returns the w-bounded dpll model, which can differ from
     # the search witness even at equal cardinality (solve.go:108).  Probe
-    # once more if the last SAT probe wasn't at the final bound (also
-    # covers n_extras == 0, where the loop never runs).
-    need_final = sat_en & (best_w != m_hi)
+    # once more if the last SAT probe wasn't at the final bound.  With zero
+    # extras the probe is skipped entirely: every variable is fixed by the
+    # guess/excluded partition, so propagation could only rederive the
+    # search model itself (the reference's single w=0 probe returns exactly
+    # that model; skipping it changes the step count but never the answer).
+    need_final = en & (best_w != m_hi) & (n_extras > 0)
     f_status, f_t, _, steps = dpll(
         pt, m_init_t, m_init_f, extras_bits, m_hi, budget, steps, NV, V,
         enabled=need_final,
     )
     m2_t = jnp.where(need_final & (f_status == SAT), f_t, m2_t)
-    min_found = jnp.where(need_final, f_status == SAT, m_found)
-    installed = unpack_mask(m2_t, V) & pv_mask & min_found & sat_en
+    min_found = (
+        jnp.where(need_final, f_status == SAT, m_found)
+        | (en & (n_extras == 0))
+    )
+    installed = unpack_mask(m2_t, V) & pv_mask & min_found & en
+    return installed, min_found, steps
 
-    # ---- UNSAT: deletion-based unsat-core minimization ----
-    # Start from all applied constraints active and drop any whose removal
-    # keeps the remainder unsatisfiable (host: _unsat_core; the analog of
-    # gini's failed-assumption Why, lit_mapping.go:198-207).
-    unsat_en = result == UNSAT
-    active0 = (jnp.arange(NCON, dtype=jnp.int32) < pt.n_cons) & unsat_en
+
+def core_phase(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
+               en: jax.Array = jnp.bool_(True),
+               *, V: int, NCON: int, NV: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Phase 3 (UNSAT lanes): deletion-based unsat-core minimization.
+    Returns (core, steps).
+
+    Start from all applied constraints active and drop any whose removal
+    keeps the remainder unsatisfiable (host: _unsat_core; the analog of
+    gini's failed-assumption Why, lit_mapping.go:198-207)."""
+    Wv = pt.pos_bits.shape[1]
+    no_min_bits = jnp.zeros((1, Wv), jnp.int32)
+    active0 = (jnp.arange(NCON, dtype=jnp.int32) < pt.n_cons) & en
 
     def ccond(c):
         j, _, steps = c
-        return unsat_en & (j < pt.n_cons) & (steps <= budget)
+        return en & (j < pt.n_cons) & (steps <= budget)
 
     def cbody(c):
         j, active, steps = c
@@ -929,7 +956,7 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
         status, _, _, steps = dpll(
             pt, pack_mask(init == TRUE, Wv), pack_mask(init == FALSE, Wv),
             no_min_bits, jnp.int32(0), budget, steps, NV, V,
-            enabled=unsat_en,
+            enabled=en,
         )
         active = jnp.where(status == UNSAT, trial, active)
         return j + 1, active, steps
@@ -937,7 +964,39 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     _, core, steps = lax.while_loop(
         ccond, cbody, (jnp.int32(0), active0, steps)
     )
+    return core, steps
 
+
+def solve_full(pt: ProblemTensors, budget: jax.Array,
+               *, V: int, NCON: int, NV: int, T: int = 0) -> SolveResult:
+    """One problem end to end (host: HostEngine.solve; reference
+    solve.go:53-119): baseline Test, guess search if undetermined,
+    extras-only minimization on SAT, deletion-based core on UNSAT.
+
+    Every phase runs unconditionally but lane-gated: under ``vmap`` a
+    ``lax.cond`` would execute both branches for every lane anyway (select
+    semantics), so the phases instead take an ``enabled`` flag that makes
+    their loops trip zero times on lanes that don't need them — a SAT lane
+    pays nothing for core extraction, an UNSAT lane nothing for
+    minimization.
+
+    This single-program composition is kept for single-dispatch users (the
+    mesh dry run, the graft entry); the driver's default path dispatches
+    the three phases as separate compacted batches
+    (:func:`deppy_tpu.engine.driver.solve_problems`), which removes the
+    vmap max-over-lanes coupling between phases — a batch's few UNSAT
+    lanes no longer serialize every SAT lane through the deletion loop."""
+    result, guessed, model, steps, tr_stack, tr_n = search_phase(
+        pt, budget, V=V, NCON=NCON, NV=NV, T=T,
+    )
+    sat_en = result == SAT
+    installed, min_found, steps = minimize_phase(
+        pt, model, guessed, budget, steps, sat_en, V=V, NCON=NCON, NV=NV,
+    )
+    unsat_en = result == UNSAT
+    core, steps = core_phase(
+        pt, budget, steps, unsat_en, V=V, NCON=NCON, NV=NV,
+    )
     incomplete = (steps > budget) | (result == RUNNING) | (
         sat_en & ~min_found
     )
@@ -948,9 +1007,59 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
 
 @functools.lru_cache(maxsize=128)
 def batched_solve(V: int, NCON: int, NV: int, T: int = 0):
-    """Jitted, vmapped solve for one padded shape signature.  Cached so each
-    shape bucket compiles exactly once per process (the driver buckets
-    padded dims to powers of two to bound the number of entries).  ``T`` is
-    the static trace capacity (0 = tracing compiled out)."""
+    """Jitted, vmapped single-program solve for one padded shape signature.
+    Cached so each shape bucket compiles exactly once per process (the
+    driver buckets padded dims to powers of two to bound the number of
+    entries).  ``T`` is the static trace capacity (0 = tracing compiled
+    out)."""
     fn = functools.partial(solve_full, V=V, NCON=NCON, NV=NV, T=T)
     return jax.jit(jax.vmap(fn, in_axes=(0, None)))
+
+
+@functools.lru_cache(maxsize=128)
+def batched_search(V: int, NCON: int, NV: int, T: int = 0):
+    """Jitted, vmapped phase-1 program (baseline + search); per-lane
+    ``en`` mask gates padding lanes."""
+    fn = functools.partial(search_phase, V=V, NCON=NCON, NV=NV, T=T)
+    return jax.jit(jax.vmap(fn, in_axes=(0, None, 0)))
+
+
+@functools.lru_cache(maxsize=128)
+def batched_core(V: int, NCON: int, NV: int):
+    """Jitted, vmapped phase-3 program over a compacted UNSAT batch."""
+    fn = functools.partial(core_phase, V=V, NCON=NCON, NV=NV)
+    return jax.jit(jax.vmap(fn, in_axes=(0, None, 0, 0)))
+
+
+def _minimize_gated(pt, result, model, guessed, budget, steps, en_lanes,
+                    *, V, NCON, NV):
+    return minimize_phase(
+        pt, model, guessed, budget, steps,
+        en_lanes & (result == SAT), V=V, NCON=NCON, NV=NV,
+    )
+
+
+@functools.lru_cache(maxsize=128)
+def batched_minimize_gated(V: int, NCON: int, NV: int):
+    """Phase-2 program gated by the phase-1 ``result`` on device: runs over
+    the SAME chunks (and device-resident tensors) as phase 1, so no
+    host-side compaction round trip and no re-upload of problem tensors.
+    Non-SAT lanes trip zero loop iterations."""
+    fn = functools.partial(_minimize_gated, V=V, NCON=NCON, NV=NV)
+    return jax.jit(jax.vmap(fn, in_axes=(0, 0, 0, 0, None, 0, 0)))
+
+
+def _core_gated(pt, result, budget, steps, en_lanes, *, V, NCON, NV):
+    return core_phase(
+        pt, budget, steps, en_lanes & (result == UNSAT),
+        V=V, NCON=NCON, NV=NV,
+    )
+
+
+@functools.lru_cache(maxsize=128)
+def batched_core_gated(V: int, NCON: int, NV: int):
+    """Phase-3 program gated by the phase-1 ``result`` on device — used
+    when most of a batch is UNSAT, where compaction would re-upload nearly
+    everything for no lane savings."""
+    fn = functools.partial(_core_gated, V=V, NCON=NCON, NV=NV)
+    return jax.jit(jax.vmap(fn, in_axes=(0, 0, None, 0, 0)))

@@ -20,8 +20,10 @@ variables) which solve trivially and are dropped on decode.
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence, Union
 
+import jax
 import numpy as np
 
 from ..sat.constraints import Variable
@@ -128,6 +130,98 @@ def pad_problem(p: Problem, d: _Dims) -> core.ProblemTensors:
     )
 
 
+def _pack_planes_batch(clauses: np.ndarray, Wv: int) -> tuple:
+    """Batched signed clause matrices [B, C, K] → (pos, neg) packed int32
+    bitplanes [B, C, Wv].  Vectorized over the whole batch: per-word
+    OR-reductions instead of the scalar ``np.bitwise_or.at`` scatter."""
+    mask = clauses != 0
+    v = np.where(mask, np.abs(clauses) - 1, 0).astype(np.int64)
+    word = v >> 5
+    shifted = np.left_shift(np.uint32(1), (v & 31).astype(np.uint32))
+    pos_sh = np.where(clauses > 0, shifted, np.uint32(0))
+    neg_sh = np.where(clauses < 0, shifted, np.uint32(0))
+    B, C, _ = clauses.shape
+    pos = np.zeros((B, C, Wv), np.uint32)
+    neg = np.zeros((B, C, Wv), np.uint32)
+    for w in range(Wv):
+        m = word == w
+        pos[:, :, w] = np.bitwise_or.reduce(np.where(m, pos_sh, 0), axis=2)
+        neg[:, :, w] = np.bitwise_or.reduce(np.where(m, neg_sh, 0), axis=2)
+    return pos.view(np.int32), neg.view(np.int32)
+
+
+def _pack_index_batch(rows: np.ndarray, Wv: int) -> np.ndarray:
+    """Batched 0-based index matrices [B, R, M] (-1 pad) → packed int32
+    membership bitplanes [B, R, Wv]."""
+    mask = rows >= 0
+    v = np.where(mask, rows, 0).astype(np.int64)
+    word = v >> 5
+    shifted = np.where(
+        mask, np.left_shift(np.uint32(1), (v & 31).astype(np.uint32)),
+        np.uint32(0),
+    )
+    B, R, _ = rows.shape
+    out = np.zeros((B, R, Wv), np.uint32)
+    for w in range(Wv):
+        out[:, :, w] = np.bitwise_or.reduce(np.where(word == w, shifted, 0), axis=2)
+    return out.view(np.int32)
+
+
+def pad_stack(problems: Sequence[Problem], d: _Dims, total: int
+              ) -> core.ProblemTensors:
+    """Pad and stack a whole problem list to [total, ...] batch tensors in
+    one vectorized pass (trailing lanes beyond ``len(problems)`` are empty
+    problems).  Equivalent to ``_stack([pad_problem(p, d) ...])`` but ~10×
+    faster on fleet-scale batches — per-problem work is one slice
+    assignment per field; all bit-packing is batched."""
+    n = len(problems)
+    clauses = np.zeros((total, d.C, d.K), np.int32)
+    card_ids = np.full((total, d.NA, d.M), -1, np.int32)
+    card_n = np.zeros((total, d.NA), np.int32)
+    card_act = np.full((total, d.NA), -1, np.int32)
+    anchors = np.full((total, d.A), -1, np.int32)
+    choice_cand = np.full((total, d.NC, d.Kc), -1, np.int32)
+    var_choices = np.full((total, d.NV, d.W), -1, np.int32)
+    n_vars = np.zeros(total, np.int32)
+    n_cons = np.zeros(total, np.int32)
+    for i, p in enumerate(problems):
+        c = p.clauses
+        clauses[i, : c.shape[0], : c.shape[1]] = c
+        ci = p.card_ids
+        card_ids[i, : ci.shape[0], : ci.shape[1]] = ci
+        card_n[i, : p.card_n.shape[0]] = p.card_n
+        card_act[i, : p.card_act.shape[0]] = p.card_act
+        anchors[i, : p.anchors.shape[0]] = p.anchors
+        cc = p.choice_cand
+        choice_cand[i, : cc.shape[0], : cc.shape[1]] = cc
+        vc = p.var_choices
+        var_choices[i, : vc.shape[0], : vc.shape[1]] = vc
+        n_vars[i] = p.n_vars
+        n_cons[i] = p.n_cons
+    pos_bits, neg_bits = _pack_planes_batch(clauses, d.Wv)
+    return core.ProblemTensors(
+        clauses=clauses,
+        card_ids=card_ids,
+        card_n=card_n,
+        card_act=card_act,
+        anchors=anchors,
+        choice_cand=choice_cand,
+        var_choices=var_choices,
+        n_vars=n_vars,
+        n_cons=n_cons,
+        pos_bits=pos_bits,
+        neg_bits=neg_bits,
+        card_member_bits=_pack_index_batch(card_ids, d.Wv),
+        card_act_bits=_pack_index_batch(card_act[:, :, None], d.Wv),
+    )
+
+
+# Fields the bitplane ("bits"/"pallas") BCP paths never read; kept as host
+# numpy so jit's unused-argument pruning skips their upload entirely.  The
+# "gather" path reads them, so it uploads everything.
+_GATHER_ONLY_FIELDS = ("clauses", "card_ids")
+
+
 _EMPTY_PROBLEM: Optional[Problem] = None
 
 
@@ -144,33 +238,85 @@ def _stack(pts: Sequence[core.ProblemTensors]) -> core.ProblemTensors:
     )
 
 
-def solve_problems(
-    problems: Sequence[Problem],
-    max_steps: Optional[int] = None,
-    mesh=None,
-    trace_cap: int = 0,
-) -> List[core.SolveResult]:
-    """Solve lowered problems as one device batch; per-problem results with
-    host numpy arrays.  With ``mesh`` (a 1-D ``jax.sharding.Mesh`` from
-    :mod:`deppy_tpu.parallel`), the batch axis is sharded over the mesh's
-    devices and XLA partitions the solve — the fleet-scale path.
-    ``trace_cap`` > 0 compiles in backtrack tracing with that buffer depth
-    (see :class:`core.SolveResult`)."""
-    for p in problems:
-        if p.errors:
-            raise InternalSolverError(p.errors)
+def _budget(max_steps: Optional[int]) -> np.int32:
+    return np.int32(min(max_steps if max_steps is not None else DEFAULT_MAX_STEPS,
+                        np.iinfo(np.int32).max - 1))
+
+
+def _to_device(tree, mesh):
+    if mesh is None:
+        return tree
+    from ..parallel.mesh import shard_batch
+
+    return shard_batch(mesh, tree)
+
+
+def _put_chunk(pts_chunk: core.ProblemTensors, mesh) -> core.ProblemTensors:
+    """Upload one chunk's problem tensors explicitly so later phases reuse
+    the device-resident buffers instead of re-transferring.  On the
+    bitplane BCP paths the clause/cardinality index matrices are never
+    read, so they stay host-side (jit prunes unused args and skips their
+    upload)."""
+    if mesh is not None:
+        return _to_device(pts_chunk, mesh)
+    if core._resolved_impl() == "gather":
+        return jax.device_put(pts_chunk)
+    return core.ProblemTensors(**{
+        f: (getattr(pts_chunk, f) if f in _GATHER_ONLY_FIELDS
+            else jax.device_put(getattr(pts_chunk, f)))
+        for f in core.ProblemTensors._fields
+    })
+
+
+def _pad_group(k: int, mesh) -> int:
+    """Padded batch size for a compacted phase group: power of two and a
+    multiple of the mesh size."""
+    b = _bucket(k)
+    m = mesh.size if mesh is not None else 1
+    if b % m:
+        b *= m // np.gcd(b, m)
+    return b
+
+
+def _gather_rows(pts: core.ProblemTensors, idx: np.ndarray, B: int,
+                 empty_row: core.ProblemTensors) -> core.ProblemTensors:
+    """Compact batch rows ``idx`` out of a stacked pytree, padding to ``B``
+    lanes with the empty problem."""
+    pad = B - idx.size
+    fields = []
+    for f in core.ProblemTensors._fields:
+        a = getattr(pts, f)[idx]
+        e = getattr(empty_row, f)
+        if pad:
+            a = np.concatenate(
+                [a, np.broadcast_to(e[None], (pad,) + e.shape).copy()]
+            )
+        fields.append(a)
+    return core.ProblemTensors(*fields)
+
+
+def _pad_rows(a: np.ndarray, B: int, fill=0) -> np.ndarray:
+    pad = B - a.shape[0]
+    if not pad:
+        return a
+    return np.concatenate(
+        [a, np.full((pad,) + a.shape[1:], fill, dtype=a.dtype)]
+    )
+
+
+def _solve_monolith(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
+    """Single-dispatch path (one jitted program, all phases lane-gated):
+    the right trade for a batch of one, where phase compaction buys
+    nothing and one compile beats three."""
     n = len(problems)
     d = _Dims(problems, max(n, 1), batch_multiple=mesh.size if mesh is not None else 1)
-    padded = list(problems) + [_empty_problem()] * (d.B - n)
-    pts = _stack([pad_problem(p, d) for p in padded])
-    if mesh is not None:
-        from ..parallel.mesh import shard_batch
-
-        pts = shard_batch(mesh, pts)
-    budget = np.int32(min(max_steps if max_steps is not None else DEFAULT_MAX_STEPS,
-                          np.iinfo(np.int32).max - 1))
+    pts = _to_device(pad_stack(problems, d, d.B), mesh)
     fn = core.batched_solve(d.V, d.NCON, d.NV, trace_cap)
     res = fn(pts, budget)
+    # One batched fetch for the whole result tree: each individual
+    # device→host transfer pays a full round trip on a tunneled TPU
+    # (~70ms+), so per-field np.asarray would cost 6 round trips.
+    res = jax.device_get(res)
     outcome = np.asarray(res.outcome)
     installed = np.asarray(res.installed)
     cores = np.asarray(res.core)
@@ -182,6 +328,238 @@ def solve_problems(
                          trace_stack[i], trace_n[i])
         for i in range(n)
     ]
+
+
+# Per-dispatch lane cap (power of two).  Two reasons: (1) the axon-tunneled
+# v5e worker is unstable executing ≥1024-lane programs of this engine
+# (reproducible worker crashes; 512 is rock solid), and (2) smaller
+# dispatches bound max-over-lanes lockstep waste while async dispatch keeps
+# the device busy across chunks.  One batched fetch per phase still pays a
+# single tunnel round trip regardless of chunk count.
+MAX_LANES = int(os.environ.get("DEPPY_TPU_MAX_LANES", "512"))
+
+
+def _chunk_slices(total: int, ch: int) -> List[slice]:
+    return [slice(i, i + ch) for i in range(0, total, ch)]
+
+
+def _rows(pts: core.ProblemTensors, sl: slice) -> core.ProblemTensors:
+    return core.ProblemTensors(
+        *[getattr(pts, f)[sl] for f in core.ProblemTensors._fields]
+    )
+
+
+def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
+    """Chunked three-phase path: search over the batch in ≤ MAX_LANES
+    dispatches, then minimization on compacted SAT-lane chunks and core
+    extraction on compacted UNSAT-lane chunks.
+
+    Under ``vmap`` every ``while_loop`` runs max-over-lanes iterations, so
+    in the single-program composition a batch's few UNSAT lanes serialize
+    every lane through the O(n_cons) deletion loop and SAT lanes pay for
+    minimization they may not need; compaction confines each phase's cost
+    to the lanes that need it (SURVEY.md §7.3 item 4's divergence
+    mitigation).  All chunks of a phase dispatch asynchronously (device
+    work pipelines) and their results come back in one batched fetch."""
+    n = len(problems)
+    # MAX_LANES caps every dispatch, mesh or not: sharding divides lanes
+    # across devices but each worker still executes its shard of one
+    # program, and oversized programs are what crash the axon worker.
+    ch_cap = min(max(n, 1), MAX_LANES)
+    d = _Dims(problems, ch_cap, batch_multiple=mesh.size if mesh is not None else 1)
+    CH = d.B
+    n_chunks = max(1, -(-n // CH))
+    total = n_chunks * CH
+    empty_row = pad_problem(_empty_problem(), d)
+    pts_np = pad_stack(problems, d, total)
+    en = np.arange(total) < n
+    slices = _chunk_slices(total, CH)
+
+    # Problem tensors go to the device once per chunk and stay resident:
+    # phase 2 reuses them directly, so nothing is re-uploaded.
+    pts_dev = [_put_chunk(_rows(pts_np, sl), mesh) for sl in slices]
+    en_dev = [_to_device(en[sl], mesh) for sl in slices]
+
+    fn_a = core.batched_search(d.V, d.NCON, d.NV, trace_cap)
+    outs = [fn_a(p, budget, e) for p, e in zip(pts_dev, en_dev)]
+
+    # Phase 2 dispatches immediately on the same device-resident chunks,
+    # gated per lane by the phase-1 result — no host round trip in between.
+    fn_b = core.batched_minimize_gated(d.V, d.NCON, d.NV)
+    res_b = [
+        fn_b(p, o[0], o[2], o[1], budget, o[3], e)
+        for p, o, e in zip(pts_dev, outs, en_dev)
+    ]
+
+    # One small fetch decides the phase-3 strategy (results + steps only).
+    small = jax.device_get([(o[0], o[3], o[5]) for o in outs])
+    result = np.concatenate([s[0] for s in small])
+    steps = np.concatenate([s[1] for s in small])
+    trace_n = np.concatenate([s[2] for s in small])
+
+    installed = np.zeros((total, d.V), bool)
+    min_found = np.zeros(total, bool)
+    cores = np.zeros((total, d.NCON), bool)
+
+    unsat_idx = np.nonzero(en & (result == core.UNSAT))[0]
+    sat_any = bool((en & (result == core.SAT)).any())
+
+    res_c: list = []
+    core_gated = unsat_idx.size > total // 2
+    if unsat_idx.size and core_gated:
+        # UNSAT-heavy batch: compaction would re-upload nearly every row —
+        # run the deletion loop en-gated on the resident chunks instead.
+        fn_cg = core.batched_core_gated(d.V, d.NCON, d.NV)
+        res_c = [
+            fn_cg(p, o[0], budget, o[3], e)
+            for p, o, e in zip(pts_dev, outs, en_dev)
+        ]
+    elif unsat_idx.size:
+        # Few UNSAT lanes: compact them into (usually) one small dispatch;
+        # only those rows transfer again.
+        fn_c = core.batched_core(d.V, d.NCON, d.NV)
+        b = min(_pad_group(unsat_idx.size, mesh), CH)
+        for idx in [unsat_idx[i: i + b] for i in range(0, unsat_idx.size, b)]:
+            res_c.append(fn_c(
+                _to_device(_gather_rows(pts_np, idx, b, empty_row), mesh),
+                budget,
+                _to_device(_pad_rows(steps[idx], b), mesh),
+                _to_device(np.arange(b) < idx.size, mesh),
+            ))
+
+    # Final batched fetch: all phase-2 and phase-3 results (and trace
+    # buffers if compiled in) in one round trip.
+    fetch = {"b": res_b if sat_any else [], "c": res_c}
+    if trace_cap > 0:
+        fetch["tr"] = [o[4] for o in outs]
+    fetched = jax.device_get(fetch)
+
+    if sat_any:
+        inst_c = np.concatenate([r[0] for r in fetched["b"]])
+        mf_c = np.concatenate([r[1] for r in fetched["b"]])
+        st_c = np.concatenate([r[2] for r in fetched["b"]])
+        sat_mask = en & (result == core.SAT)
+        installed[sat_mask] = inst_c[sat_mask]
+        min_found[sat_mask] = mf_c[sat_mask]
+        steps[sat_mask] = st_c[sat_mask]
+    if unsat_idx.size:
+        if core_gated:
+            core_c = np.concatenate([r[0] for r in fetched["c"]])
+            st_c = np.concatenate([r[1] for r in fetched["c"]])
+            cores[unsat_idx] = core_c[unsat_idx]
+            steps[unsat_idx] = st_c[unsat_idx]
+        else:
+            core_c = np.concatenate([r[0] for r in fetched["c"]])
+            st_c = np.concatenate([r[1] for r in fetched["c"]])
+            ks = [min(b, unsat_idx.size - j)
+                  for j in range(0, unsat_idx.size, b)]
+            keep = np.concatenate([np.arange(b) < k for k in ks])
+            cores[unsat_idx] = core_c[keep]
+            steps[unsat_idx] = st_c[keep]
+    if trace_cap > 0:
+        trace_stack = np.concatenate(fetched["tr"])
+    else:
+        trace_stack = np.zeros((total, 0, 0), np.int32)
+
+    incomplete = (
+        (steps > int(budget))
+        | (result == core.RUNNING)
+        | ((result == core.SAT) & ~min_found)
+    )
+    outcome = np.where(incomplete, core.RUNNING, result).astype(np.int32)
+    return [
+        core.SolveResult(outcome[i], installed[i], cores[i], steps[i],
+                         trace_stack[i], trace_n[i])
+        for i in range(n)
+    ]
+
+
+# Size-class bucketing (SURVEY.md §7.3 items 4-5): a heterogeneous fleet
+# batch is partitioned into up to MAX_BUCKETS shape classes so one large
+# straggler doesn't inflate every lane's padded planes.  Buckets below
+# MIN_BUCKET problems aren't worth a separate dispatch and merge upward.
+MAX_BUCKETS = 4
+MIN_BUCKET = 16
+# Only split at a size-class boundary when the padded per-lane cost ratio
+# across it is at least this factor.
+SPLIT_RATIO = 2.0
+
+
+def _cost_proxy(p: Problem) -> int:
+    """Padded per-lane cost proxy: clause-plane area dominates BCP; the
+    var count drives DPLL snapshot size and iteration count."""
+    NV = _bucket(max(p.n_vars, 1))
+    NCON = _bucket(max(p.n_cons, 1))
+    Wv = -(-(NV + NCON) // core.WORD)
+    C = _bucket(p.clauses.shape[0])
+    return (C + 2 * NV) * Wv
+
+
+def partition_buckets(problems: Sequence[Problem]) -> List[List[int]]:
+    """Partition problem indices into ≤ MAX_BUCKETS size classes, splitting
+    only at ≥ SPLIT_RATIO jumps in padded cost.  Returns index lists; a
+    homogeneous batch comes back as one bucket."""
+    n = len(problems)
+    if n < 2 * MIN_BUCKET:
+        return [list(range(n))]
+    costs = np.array([_cost_proxy(p) for p in problems], dtype=np.int64)
+    order = np.argsort(costs, kind="stable")
+    sc = costs[order]
+    ratios = sc[1:] / np.maximum(sc[:-1], 1)
+    cand = np.nonzero(ratios >= SPLIT_RATIO)[0]
+    # Keep the largest jumps first, at most MAX_BUCKETS - 1 splits.
+    cand = cand[np.argsort(ratios[cand])[::-1][: MAX_BUCKETS - 1]]
+    splits = sorted(int(i) + 1 for i in cand)
+    bounds = [0] + splits + [n]
+    buckets = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        idxs = order[lo:hi].tolist()
+        # Too-small buckets merge into the previous (larger-capacity
+        # neighbors would re-pad them; merging upward wastes less than a
+        # dedicated dispatch for a handful of lanes).
+        if buckets and (len(idxs) < MIN_BUCKET or len(buckets[-1]) < MIN_BUCKET):
+            buckets[-1].extend(idxs)
+        else:
+            buckets.append(idxs)
+    return buckets
+
+
+def solve_problems(
+    problems: Sequence[Problem],
+    max_steps: Optional[int] = None,
+    mesh=None,
+    trace_cap: int = 0,
+    split_phases: Optional[bool] = None,
+    bucketing: bool = True,
+) -> List[core.SolveResult]:
+    """Solve lowered problems as device batches; per-problem results with
+    host numpy arrays.  With ``mesh`` (a 1-D ``jax.sharding.Mesh`` from
+    :mod:`deppy_tpu.parallel`), each dispatch's batch axis is sharded over
+    the mesh's devices and XLA partitions the solve — the fleet-scale path.
+    ``trace_cap`` > 0 compiles in backtrack tracing with that buffer depth
+    (see :class:`core.SolveResult`).
+
+    ``split_phases`` (default: automatic — on for real batches, off for a
+    batch of one) dispatches search / minimization / core extraction as
+    separate compacted batches; ``bucketing`` partitions heterogeneous
+    batches into size classes first."""
+    for p in problems:
+        if p.errors:
+            raise InternalSolverError(p.errors)
+    n = len(problems)
+    budget = _budget(max_steps)
+    if split_phases is None:
+        split_phases = n > 1
+    impl = _solve_split if split_phases else _solve_monolith
+    buckets = partition_buckets(problems) if (bucketing and n > 1) else [list(range(n))]
+    if len(buckets) == 1:
+        return impl(list(problems), budget, mesh, trace_cap)
+    results: List[Optional[core.SolveResult]] = [None] * n
+    for idxs in buckets:
+        sub = impl([problems[i] for i in idxs], budget, mesh, trace_cap)
+        for i, r in zip(idxs, sub):
+            results[i] = r
+    return results  # type: ignore[return-value]
 
 
 def _decode_installed(p: Problem, installed: np.ndarray) -> List[Variable]:

@@ -6,15 +6,21 @@
 # deploys to kind and runs the ginkgo suite; the reference suite has zero
 # specs — this one actually asserts).
 #
+# Runs one full pass per backend: "host" (the spec engine) and "auto"
+# (resolves to the tensor engine on the forced-CPU platform) — so a broken
+# device engine fails e2e instead of hiding behind the host fallback.
+#
 # Modes:
 #   DEPPY_E2E_MODE=local   (default) run `python -m deppy_tpu serve` directly
 #   DEPPY_E2E_MODE=docker  build/run the container image ($IMG)
+# DEPPY_E2E_BACKENDS overrides the backend list (default "host auto").
 set -euo pipefail
 
 MODE="${DEPPY_E2E_MODE:-local}"
 IMG="${IMG:-deppy-tpu:latest}"
 API_PORT="${DEPPY_E2E_API_PORT:-18080}"
 PROBE_PORT="${DEPPY_E2E_PROBE_PORT:-18081}"
+BACKENDS="${DEPPY_E2E_BACKENDS:-host auto}"
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 GOLDEN="$ROOT/test/e2e/problem.json"
 EXPECTED="$ROOT/test/e2e/expected.json"
@@ -31,45 +37,50 @@ cleanup() {
 }
 trap cleanup EXIT
 
-echo "== starting service ($MODE) =="
-if [ "$MODE" = "docker" ]; then
-  CONTAINER_ID=$(docker run -d \
-    -p "127.0.0.1:$API_PORT:8080" -p "127.0.0.1:$PROBE_PORT:8081" \
-    "$IMG" --backend host)
-else
-  JAX_PLATFORMS=cpu python -m deppy_tpu serve \
-    --bind-address "127.0.0.1:$API_PORT" \
-    --health-probe-bind-address "127.0.0.1:$PROBE_PORT" \
-    --backend host &
-  SERVER_PID=$!
-fi
+run_pass() {
+  local BACKEND="$1"
 
-echo "== waiting for /healthz =="
-for i in $(seq 1 60); do
-  if curl -fsS "http://127.0.0.1:$PROBE_PORT/healthz" >/dev/null 2>&1; then
-    break
+  echo "== [$BACKEND] starting service ($MODE) =="
+  if [ "$MODE" = "docker" ]; then
+    CONTAINER_ID=$(docker run -d \
+      -p "127.0.0.1:$API_PORT:8080" -p "127.0.0.1:$PROBE_PORT:8081" \
+      "$IMG" --backend "$BACKEND")
+  else
+    JAX_PLATFORMS=cpu python -m deppy_tpu serve \
+      --bind-address "127.0.0.1:$API_PORT" \
+      --health-probe-bind-address "127.0.0.1:$PROBE_PORT" \
+      --backend "$BACKEND" &
+    SERVER_PID=$!
   fi
-  if [ "$i" = 60 ]; then
-    echo "FAIL: service never became healthy" >&2
-    exit 1
-  fi
-  sleep 1
-done
 
-fail() { echo "FAIL: $1" >&2; exit 1; }
+  echo "== [$BACKEND] waiting for /healthz =="
+  for i in $(seq 1 60); do
+    if curl -fsS "http://127.0.0.1:$PROBE_PORT/healthz" >/dev/null 2>&1; then
+      break
+    fi
+    if [ "$i" = 60 ]; then
+      echo "FAIL: [$BACKEND] service never became healthy" >&2
+      exit 1
+    fi
+    sleep 1
+  done
 
-echo "== probes =="
-[ "$(curl -fsS "http://127.0.0.1:$PROBE_PORT/healthz")" = "ok" ] \
-  || fail "/healthz != ok"
-[ "$(curl -fsS "http://127.0.0.1:$PROBE_PORT/readyz")" = "ok" ] \
-  || fail "/readyz != ok"
+  fail() { echo "FAIL: [$BACKEND] $1" >&2; exit 1; }
 
-echo "== resolve golden problem =="
-RESP_FILE=$(mktemp)
-curl -fsS -X POST -H 'Content-Type: application/json' \
-  --data-binary "@$GOLDEN" "http://127.0.0.1:$API_PORT/v1/resolve" \
-  > "$RESP_FILE"
-python - "$RESP_FILE" "$EXPECTED" <<'PYEOF'
+  echo "== [$BACKEND] probes =="
+  [ "$(curl -fsS "http://127.0.0.1:$PROBE_PORT/healthz")" = "ok" ] \
+    || fail "/healthz != ok"
+  [ "$(curl -fsS "http://127.0.0.1:$PROBE_PORT/readyz")" = "ok" ] \
+    || fail "/readyz != ok"
+
+  echo "== [$BACKEND] resolve golden problem =="
+  RESP_FILE=$(mktemp)
+  # The tensor engine's first solve compiles (~tens of seconds on CPU);
+  # give the request a generous client-side timeout.
+  curl -fsS --max-time 300 -X POST -H 'Content-Type: application/json' \
+    --data-binary "@$GOLDEN" "http://127.0.0.1:$API_PORT/v1/resolve" \
+    > "$RESP_FILE"
+  python - "$RESP_FILE" "$EXPECTED" <<'PYEOF'
 import json, sys
 got = json.load(open(sys.argv[1]))
 want = json.load(open(sys.argv[2]))
@@ -81,34 +92,43 @@ for i, exp in enumerate(want["results"]):
         )
 print("resolve results match golden expectations")
 PYEOF
-rm -f "$RESP_FILE"
+  rm -f "$RESP_FILE"
 
-echo "== metrics =="
-METRICS=$(curl -fsS "http://127.0.0.1:$API_PORT/metrics")
-echo "$METRICS" | grep -q 'deppy_resolutions_total{outcome="sat"} 1' \
-  || fail "sat counter not advanced"
-echo "$METRICS" | grep -q 'deppy_resolutions_total{outcome="unsat"} 1' \
-  || fail "unsat counter not advanced"
-echo "$METRICS" | grep -q 'deppy_batches_total 1' \
-  || fail "batch counter not advanced"
+  echo "== [$BACKEND] metrics =="
+  METRICS=$(curl -fsS "http://127.0.0.1:$API_PORT/metrics")
+  echo "$METRICS" | grep -q 'deppy_resolutions_total{outcome="sat"} 1' \
+    || fail "sat counter not advanced"
+  echo "$METRICS" | grep -q 'deppy_resolutions_total{outcome="unsat"} 1' \
+    || fail "unsat counter not advanced"
+  echo "$METRICS" | grep -q 'deppy_batches_total 1' \
+    || fail "batch counter not advanced"
 
-echo "== graceful shutdown (SIGTERM) =="
-if [ "$MODE" = "docker" ]; then
-  docker stop -t 20 "$CONTAINER_ID" >/dev/null
-  RC=$(docker wait "$CONTAINER_ID" 2>/dev/null || docker inspect -f '{{.State.ExitCode}}' "$CONTAINER_ID")
-  [ "$RC" = "0" ] || fail "container exit code $RC after SIGTERM"
-else
-  kill -TERM "$SERVER_PID"
-  for i in $(seq 1 20); do
-    kill -0 "$SERVER_PID" 2>/dev/null || break
-    sleep 1
-  done
-  if kill -0 "$SERVER_PID" 2>/dev/null; then
-    fail "service did not exit within 20s of SIGTERM"
+  echo "== [$BACKEND] graceful shutdown (SIGTERM) =="
+  if [ "$MODE" = "docker" ]; then
+    docker stop -t 20 "$CONTAINER_ID" >/dev/null
+    RC=$(docker wait "$CONTAINER_ID" 2>/dev/null || docker inspect -f '{{.State.ExitCode}}' "$CONTAINER_ID")
+    docker rm -f "$CONTAINER_ID" >/dev/null 2>&1 || true
+    CONTAINER_ID=""
+    [ "$RC" = "0" ] || fail "container exit code $RC after SIGTERM"
+  else
+    kill -TERM "$SERVER_PID"
+    for i in $(seq 1 20); do
+      kill -0 "$SERVER_PID" 2>/dev/null || break
+      sleep 1
+    done
+    if kill -0 "$SERVER_PID" 2>/dev/null; then
+      fail "service did not exit within 20s of SIGTERM"
+    fi
+    wait "$SERVER_PID" && RC=0 || RC=$?
+    SERVER_PID=""
+    [ "$RC" = "0" ] || fail "service exit code $RC after SIGTERM"
   fi
-  wait "$SERVER_PID" && RC=0 || RC=$?
-  SERVER_PID=""
-  [ "$RC" = "0" ] || fail "service exit code $RC after SIGTERM"
-fi
+
+  echo "e2e [$BACKEND]: PASS"
+}
+
+for BACKEND in $BACKENDS; do
+  run_pass "$BACKEND"
+done
 
 echo "e2e: PASS"

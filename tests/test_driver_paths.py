@@ -1,0 +1,143 @@
+"""Dispatch-path coverage for the chunked three-phase driver.
+
+The driver defaults to these paths for every multi-problem call
+(driver.solve_problems): size-class bucketing, ≤ MAX_LANES chunked
+dispatch, device-resident gated minimization, and the compacted-vs-gated
+unsat-core strategy fork.  These tests pin each against the host engine
+(the semantic spec, host.py) and against the single-program monolith.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deppy_tpu import sat
+from deppy_tpu.models import random_instance
+from deppy_tpu.sat.encode import encode
+
+pytest.importorskip("jax")
+
+from deppy_tpu.engine import core, driver  # noqa: E402
+
+
+def _outcomes(results):
+    return [(int(r.outcome), tuple(np.nonzero(r.installed)[0])) for r in results]
+
+
+# ----------------------------------------------------------------- buckets
+
+
+def _fake_problem(n_vars: int, n_clauses: int):
+    """Encoded problem with controllable padded cost."""
+    vs = [sat.variable(f"v{i}") for i in range(n_vars)]
+    vs[0] = sat.variable("v0", sat.mandatory(),
+                         *[sat.dependency(f"v{i}") for i in range(1, n_clauses)])
+    return encode(vs)
+
+
+def test_partition_buckets_covers_all_indices_once():
+    problems = (
+        [_fake_problem(4, 2)] * 40
+        + [_fake_problem(180, 60)] * 40
+        + [_fake_problem(16, 4)] * 40
+    )
+    buckets = driver.partition_buckets(problems)
+    assert 1 <= len(buckets) <= driver.MAX_BUCKETS
+    flat = sorted(i for b in buckets for i in b)
+    assert flat == list(range(len(problems)))
+
+
+def test_partition_buckets_splits_heterogeneous_sizes():
+    problems = [_fake_problem(4, 2)] * 64 + [_fake_problem(200, 60)] * 64
+    buckets = driver.partition_buckets(problems)
+    assert len(buckets) == 2
+    assert sorted(len(b) for b in buckets) == [64, 64]
+    # Small problems land together: their dims stay small.
+    small = min(buckets, key=lambda b: driver._cost_proxy(problems[b[0]]))
+    assert all(i < 64 for i in small)
+
+
+def test_partition_buckets_homogeneous_stays_whole():
+    problems = [encode(random_instance(length=24, seed=s)) for s in range(64)]
+    assert [len(b) for b in driver.partition_buckets(problems)] == [64]
+
+
+# ------------------------------------------------------- chunked dispatch
+
+
+def test_chunked_split_matches_monolith(monkeypatch):
+    """Multi-chunk split path == single-program monolith on a mixed batch
+    (2 UNSAT lanes < half, so the compacted phase-3 strategy runs)."""
+    monkeypatch.setattr(driver, "MAX_LANES", 8)
+    problems = [encode(random_instance(length=16, seed=s, p_conflict=0.2))
+                for s in range(20)]
+    split = driver.solve_problems(problems, split_phases=True)
+    mono = driver.solve_problems(problems, split_phases=False)
+    assert _outcomes(split) == _outcomes(mono)
+    for a, b in zip(split, mono):
+        assert (a.core == b.core).all()
+
+
+def test_unsat_heavy_batch_uses_gated_core_and_matches_host(monkeypatch):
+    """An all-UNSAT batch exercises the en-gated phase-3 fork
+    (unsat fraction > 1/2) and must reproduce the host engine's cores."""
+    monkeypatch.setattr(driver, "MAX_LANES", 8)
+
+    def unsat_vars(seed):
+        return [
+            sat.variable("a", sat.mandatory(), sat.dependency("b")),
+            sat.variable("b", sat.conflict("a")),
+            sat.variable(f"pad{seed}"),
+        ]
+
+    problems = [encode(unsat_vars(s)) for s in range(12)]
+    results = driver.solve_problems(problems, split_phases=True)
+    from deppy_tpu.sat.host import HostEngine
+    from deppy_tpu.sat.errors import NotSatisfiable
+
+    for p, r in zip(problems, results):
+        assert int(r.outcome) == core.UNSAT
+        with pytest.raises(NotSatisfiable) as ei:
+            HostEngine(p).solve()
+        want = sorted(str(c) for c in ei.value.constraints)
+        got = sorted(str(p.applied[j]) for j in np.nonzero(r.core)[0])
+        assert got == want
+
+
+def test_bucketed_solve_reassembles_original_order():
+    """Heterogeneous batch: results come back in input order with the
+    right per-problem answers despite bucket reordering."""
+    big = [sat.variable("m", sat.mandatory(), sat.dependency("x")),
+           sat.variable("x")] + [sat.variable(f"f{i}") for i in range(150)]
+    small_sat = [sat.variable("s", sat.mandatory())]
+    small_unsat = [sat.variable("u", sat.mandatory(), sat.prohibited())]
+    problems = [encode(small_sat), encode(big), encode(small_unsat)] * 22
+    results = driver.solve_problems(problems)
+    for i, r in enumerate(results):
+        kind = i % 3
+        if kind == 0:
+            assert int(r.outcome) == core.SAT
+            assert np.nonzero(r.installed)[0].tolist() == [0]
+        elif kind == 1:
+            assert int(r.outcome) == core.SAT
+            assert np.nonzero(r.installed)[0].tolist() == [0, 1]
+        else:
+            assert int(r.outcome) == core.UNSAT
+
+
+# ----------------------------------------------------------- batch packing
+
+
+def test_pad_stack_matches_per_problem_padding():
+    problems = [encode(random_instance(length=16, seed=s)) for s in range(9)]
+    d = driver._Dims(problems, 16)
+    batched = driver.pad_stack(problems, d, 16)
+    reference = driver._stack(
+        [driver.pad_problem(p, d) for p in problems]
+        + [driver.pad_problem(driver._empty_problem(), d)] * 7
+    )
+    for f in core.ProblemTensors._fields:
+        a, b = getattr(batched, f), getattr(reference, f)
+        assert a.dtype == b.dtype and a.shape == b.shape, f
+        assert (np.asarray(a) == np.asarray(b)).all(), f
