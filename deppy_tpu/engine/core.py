@@ -89,11 +89,24 @@ class ProblemTensors(NamedTuple):
     neg_bits: jax.Array         # i32[C, Wv]  negative-literal membership
     card_member_bits: jax.Array  # i32[NA, Wv] AtMost member sets
     card_act_bits: jax.Array    # i32[NA, Wv] one-hot activation var (0 = pad)
+    # Reduced-space planes (packed over the problem-var region only,
+    # Wr = ceil(NV/32) words): the search and minimization phases never
+    # disable constraint activations — every activation variable is
+    # constant TRUE there — so each clause's ¬activation literal is
+    # constant-false and folds away.  Dropping the activation columns
+    # shrinks every propagation round's plane traffic by V/NV (often
+    # 2-3×, the activation region usually outnumbering real variables).
+    # Only the unsat-core phase, which probes with activation subsets
+    # disabled, needs the full-space planes above.
+    pos_bits_r: jax.Array       # i32[C, Wr]
+    neg_bits_r: jax.Array       # i32[C, Wr]
+    card_member_bits_r: jax.Array  # i32[NA, Wr]
+    card_valid: jax.Array       # i32[NA]  1 on real AtMost rows, 0 on pads
 
 
 class SolveResult(NamedTuple):
     outcome: jax.Array     # i32: SAT / UNSAT / RUNNING (= incomplete)
-    installed: jax.Array   # bool[V] (problem-var region)
+    installed: jax.Array   # bool[NV] (problem-var region, every impl/mode)
     core: jax.Array        # bool[NCON] active applied constraints (UNSAT only)
     steps: jax.Array       # i32 step counter (tests + DPLL iterations)
     # Backtrack trace (tracer.go:13-15): row i = the guess-variable stack
@@ -126,6 +139,14 @@ def _base_assignment(pt: ProblemTensors, V: int, NCON: int,
         jnp.int32(UNASSIGNED),
         jnp.where(in_act, act_val, jnp.int32(FALSE)),
     )
+
+
+def _base_assignment_red(pt: ProblemTensors, NV: int) -> jax.Array:
+    """Reduced-space base assignment: no activation region exists (all
+    activations are constant TRUE and folded into the reduced planes);
+    padding slots beyond ``n_vars`` are pinned false."""
+    idx = jnp.arange(NV, dtype=jnp.int32)
+    return jnp.where(idx < pt.n_vars, jnp.int32(UNASSIGNED), jnp.int32(FALSE))
 
 
 def _apply_anchors(pt: ProblemTensors, assign: jax.Array, V: int) -> jax.Array:
@@ -195,13 +216,18 @@ def unpack_mask(words: jax.Array, V: int) -> jax.Array:
     return bits.reshape(-1)[:V]
 
 
-def round_planes(pos, neg, mem, act, card_n2, min_bits, min_w, t, f):
+def round_planes(pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f):
     """One propagation round on bitplanes — the exact bitwise translation of
     :func:`bcp_round` (itself the dense analog of gini's watched-literal BCP).
-    Shapes: pos/neg i32[C, Wv]; mem/act i32[NA, Wv]; card_n2 i32[NA, 1];
-    min_bits/t/f i32[1, Wv]; min_w i32 scalar.  Returns
+    Shapes: pos/neg i32[C, Wv]; mem i32[NA, Wv]; card_active bool[NA, 1];
+    card_n2 i32[NA, 1]; min_bits/t/f i32[1, Wv]; min_w i32 scalar.  Returns
     (conflict, new_t, new_f, changed).  Runs unchanged under jit and inside
-    the Pallas kernel (:mod:`deppy_tpu.engine.pallas_bcp`)."""
+    the Pallas kernel (:mod:`deppy_tpu.engine.pallas_bcp`).
+
+    ``card_active`` is precomputed by the caller: activation variables are
+    assumptions — propagation never flips one (a clause forcing ¬act on a
+    true act is a conflict, not a flip) — so row activity is invariant
+    across a fixpoint and need not be re-derived every round."""
     a = t | f
     sat = (((pos & t) | (neg & f)) != 0).any(axis=1, keepdims=True)   # [C,1]
     upos = pos & ~a
@@ -215,9 +241,9 @@ def round_planes(pos, neg, mem, act, card_n2, min_bits, min_w, t, f):
     wpos = or_reduce_rows(jnp.where(unit, upos, 0))                    # [1,Wv]
     wneg = or_reduce_rows(jnp.where(unit, uneg, 0))
 
-    # AtMost rows: active iff the activation bit is set true; count true /
-    # unassigned members; > n conflicts, == n forces the rest false.
-    active = ((act & t) != 0).any(axis=1, keepdims=True)               # [NA,1]
+    # AtMost rows: count true / unassigned members; > n conflicts, == n
+    # forces the rest false.
+    active = card_active                                               # [NA,1]
     trues = popcount32(mem & t).sum(axis=1, keepdims=True)
     unk = popcount32(mem & ~a).sum(axis=1, keepdims=True)
     over = active & (trues > card_n2)
@@ -377,14 +403,18 @@ def bcp(pt: ProblemTensors, assign: jax.Array,
 
 def planes_fixpoint(pt: ProblemTensors, t: jax.Array, f: jax.Array,
                     min_bits: jax.Array, min_w: jax.Array,
-                    enabled: jax.Array, V: int
+                    enabled: jax.Array, V: int, red: bool = False
                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Fixpoint directly on packed (t, f) planes — the incremental engine
     primitive: starting from a previous fixpoint plus newly set literals,
     propagation converges in the few rounds the *new* implications need
     (BCP is monotone and confluent, so the result equals a from-scratch
     run).  Returns (conflict, t, f).  Dispatches on the selected impl; the
-    gather path unpacks to assignment form and back."""
+    gather path unpacks to assignment form and back.
+
+    ``red`` (static) selects the reduced problem-var-only plane space (see
+    ProblemTensors.pos_bits_r): activations are constant TRUE there, so row
+    activity is just row validity.  Only the "bits" impl supports it."""
     impl = _resolved_impl()
     card_n2 = pt.card_n[:, None]
     # Incremental starts can assert a literal whose negation is already
@@ -394,15 +424,8 @@ def planes_fixpoint(pt: ProblemTensors, t: jax.Array, f: jax.Array,
     # kernel, masking it.  From-scratch starts never overlap.
     pre_conflict = enabled & ((t & f) != 0).any()
     run = enabled & ~pre_conflict
-    if impl == "pallas":
-        from . import pallas_bcp
-
-        conflict, t, f = pallas_bcp.bcp_fixpoint(
-            pt.pos_bits, pt.neg_bits, pt.card_member_bits, pt.card_act_bits,
-            card_n2, min_bits, min_w, t, f, run,
-        )
-        return conflict | pre_conflict, t, f
     if impl == "gather":
+        assert not red, "reduced planes are a bits-impl path"
         assign = planes_to_assign(t, f, V)
         conflict, assign = _bcp_gather(
             pt, assign, unpack_mask(min_bits, V), min_w, run
@@ -410,6 +433,22 @@ def planes_fixpoint(pt: ProblemTensors, t: jax.Array, f: jax.Array,
         Wv = t.shape[1]
         return (conflict | pre_conflict,
                 pack_mask(assign == TRUE, Wv), pack_mask(assign == FALSE, Wv))
+    if red:
+        assert impl == "bits", "reduced planes are a bits-impl path"
+        pos, neg, mem = pt.pos_bits_r, pt.neg_bits_r, pt.card_member_bits_r
+        card_active = (pt.card_valid != 0)[:, None]
+    else:
+        pos, neg, mem = pt.pos_bits, pt.neg_bits, pt.card_member_bits
+        # Activation bits never flip inside a fixpoint (see round_planes),
+        # so row activity is computed once from the entry state.
+        card_active = ((pt.card_act_bits & t) != 0).any(axis=1, keepdims=True)
+    if impl == "pallas":
+        from . import pallas_bcp
+
+        conflict, t, f = pallas_bcp.bcp_fixpoint(
+            pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f, run,
+        )
+        return conflict | pre_conflict, t, f
 
     def cond(state):
         conflict, _, _, changed = state
@@ -418,8 +457,7 @@ def planes_fixpoint(pt: ProblemTensors, t: jax.Array, f: jax.Array,
     def body(state):
         _, t, f, _ = state
         return round_planes(
-            pt.pos_bits, pt.neg_bits, pt.card_member_bits,
-            pt.card_act_bits, card_n2, min_bits, min_w, t, f,
+            pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f,
         )
 
     conflict, t, f, _ = lax.while_loop(cond, body, (jnp.bool_(False), t, f, run))
@@ -469,7 +507,7 @@ def test_outcome(conflict: jax.Array, t: jax.Array, f: jax.Array,
 def dpll(pt: ProblemTensors, t_init: jax.Array, f_init: jax.Array,
          min_bits: jax.Array, min_w: jax.Array, budget: jax.Array,
          steps: jax.Array, NV: int, V: int,
-         enabled: jax.Array = jnp.bool_(True)
+         enabled: jax.Array = jnp.bool_(True), red: bool = False
          ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Complete search under the fixed partial assignment given as packed
     ``(t_init, f_init)`` planes — the analog of gini ``Solve()``
@@ -489,12 +527,12 @@ def dpll(pt: ProblemTensors, t_init: jax.Array, f_init: jax.Array,
 
     A disabled lane runs zero iterations and returns status RUNNING; the
     caller must discard it (see :func:`bcp` for the lane-gating idiom)."""
-    Wv = pt.pos_bits.shape[1]
+    Wv = (pt.pos_bits_r if red else pt.pos_bits).shape[1]
     lvl = jnp.arange(NV, dtype=jnp.int32)
     pvb = pack_mask(jnp.arange(V, dtype=jnp.int32) < pt.n_vars, Wv)
 
     conflict0, t0, f0 = planes_fixpoint(
-        pt, t_init, f_init, min_bits, min_w, enabled, V
+        pt, t_init, f_init, min_bits, min_w, enabled, V, red
     )
     status0 = jnp.where(conflict0, jnp.int32(UNSAT), jnp.int32(RUNNING))
     snap_t0 = jnp.zeros((NV + 1, Wv), jnp.int32).at[0].set(t0[0])
@@ -537,7 +575,7 @@ def dpll(pt: ProblemTensors, t_init: jax.Array, f_init: jax.Array,
         t2 = set_plane_bit(t, var, do_step & ~neg_phase)
         f2 = set_plane_bit(f, var, do_step & neg_phase)
         conflict, t3, f3 = planes_fixpoint(
-            pt, t2, f2, min_bits, min_w, do_step, V
+            pt, t2, f2, min_bits, min_w, do_step, V, red
         )
 
         ok = do_step & ~conflict
@@ -590,7 +628,7 @@ def dpll(pt: ProblemTensors, t_init: jax.Array, f_init: jax.Array,
 def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
            outcome0: jax.Array, budget: jax.Array, steps: jax.Array,
            V: int, NCON: int, NV: int, T: int = 0,
-           enabled: jax.Array = jnp.bool_(True)
+           enabled: jax.Array = jnp.bool_(True), red: bool = False
            ) -> Tuple[jax.Array, ...]:
     """The reference guess search (search.go:158-203; host: _search).
 
@@ -634,7 +672,7 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
     NC, Kc = pt.choice_cand.shape
     DQ = NC + 1
     GS = NC + 1
-    Wv = pt.pos_bits.shape[1]
+    Wv = (pt.pos_bits_r if red else pt.pos_bits).shape[1]
     dq_pos = jnp.arange(DQ, dtype=jnp.int32)
     pvb = pack_mask(jnp.arange(V, dtype=jnp.int32) < pt.n_vars, Wv)
     no_min_bits = jnp.zeros((1, Wv), jnp.int32)
@@ -679,7 +717,7 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
         # straight through — no assignment-form round trip.
         leaf_status, leaf_t, leaf_f, steps = dpll(
             pt, cur_t, cur_f, no_min_bits, jnp.int32(0), budget, steps,
-            NV, V, enabled=is_leaf,
+            NV, V, enabled=is_leaf, red=red,
         )
         result = jnp.where(is_leaf, leaf_status, result)
         leaf_sat = is_leaf & (leaf_status == SAT)
@@ -744,7 +782,7 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
         push_test = is_push & (var >= 0)
         t2 = set_plane_bit(cur_t, jnp.clip(var, 0), push_test)
         conflict, t3, f3 = planes_fixpoint(
-            pt, t2, cur_f, no_min_bits, jnp.int32(0), push_test, V
+            pt, t2, cur_f, no_min_bits, jnp.int32(0), push_test, V, red
         )
         push_out = test_outcome(conflict, t3, f3, pvb)
         sidx = jnp.where(is_push, jnp.clip(gsp + 1, 0, GS), GS + 1)
@@ -808,27 +846,34 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
 
 def search_phase(pt: ProblemTensors, budget: jax.Array,
                  en: jax.Array = jnp.bool_(True),
-                 *, V: int, NCON: int, NV: int, T: int = 0
+                 *, V: int, NCON: int, NV: int, T: int = 0, red: bool = False
                  ) -> Tuple[jax.Array, ...]:
     """Phase 1: baseline Test + preference-ordered guess search
     (solve.go:53-85).  Returns (result, guessed, model, steps, tr_stack,
     tr_n).  ``en`` gates the whole phase (padding lanes of a compacted
-    batch run zero propagation rounds and report RUNNING)."""
+    batch run zero propagation rounds and report RUNNING).
+
+    With ``red`` (static), ``V`` is the reduced problem-var space width
+    (== NV) and all planes/outputs live in that space — activations are
+    constant TRUE during search, so their columns are folded away."""
     idxV = jnp.arange(V, dtype=jnp.int32)
     pv_mask = idxV < pt.n_vars
     steps0 = jnp.int32(1)
-    Wv = pt.pos_bits.shape[1]
+    Wv = (pt.pos_bits_r if red else pt.pos_bits).shape[1]
     pvb = pack_mask(pv_mask, Wv)
     no_min_bits = jnp.zeros((1, Wv), jnp.int32)
 
     # Baseline Test under anchors + activations (solve.go:74-79), computed
     # as planes so the search can snapshot from it.
-    base = _base_assignment(pt, V, NCON)
+    if red:
+        base = _base_assignment_red(pt, V)
+    else:
+        base = _base_assignment(pt, V, NCON)
     base = _apply_anchors(pt, base, V)
     t0 = pack_mask(base == TRUE, Wv)
     f0 = pack_mask(base == FALSE, Wv)
     conflict0, t0, f0 = planes_fixpoint(
-        pt, t0, f0, no_min_bits, jnp.int32(0), en, V,
+        pt, t0, f0, no_min_bits, jnp.int32(0), en, V, red,
     )
     outcome0 = test_outcome(conflict0, t0, f0, pvb)
     a0 = planes_to_assign(t0, f0, V)
@@ -837,7 +882,7 @@ def search_phase(pt: ProblemTensors, budget: jax.Array,
     need_search = en & (outcome0 == RUNNING)
     s_result, s_guessed, s_model, steps, tr_stack, tr_n = search(
         pt, t0, f0, outcome0, budget, steps0, V, NCON, NV, T,
-        enabled=need_search,
+        enabled=need_search, red=red,
     )
     result = jnp.where(need_search, s_result, outcome0)
     # Baseline already decided: the anchors play the guess-set role for
@@ -851,10 +896,10 @@ def search_phase(pt: ProblemTensors, budget: jax.Array,
 def minimize_phase(pt: ProblemTensors, model: jax.Array, guessed: jax.Array,
                    budget: jax.Array, steps: jax.Array,
                    en: jax.Array = jnp.bool_(True),
-                   *, V: int, NCON: int, NV: int
+                   *, V: int, NCON: int, NV: int, red: bool = False
                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Phase 2 (SAT lanes): extras-only cardinality minimization
-    (solve.go:86-113).  Returns (installed, min_found, steps).
+    (solve.go:86-113).  Returns (installed [NV], min_found, steps).
 
     The reference probes w = 0, 1, 2, … and stops at the first SAT
     (solve.go:105-110).  Satisfiability is monotone in w, so binary
@@ -863,13 +908,19 @@ def minimize_phase(pt: ProblemTensors, model: jax.Array, guessed: jax.Array,
     the host engine's linear scan — under a tight ``max_steps`` budget
     the two backends can disagree on complete-vs-incomplete for the same
     problem.  Outcome parity is only guaranteed with sufficient budget
-    (pinned by tests/test_differential.py::test_minimization_budget_parity)."""
+    (pinned by tests/test_differential.py::test_minimization_budget_parity).
+
+    ``red``/``V`` as in :func:`search_phase`; ``model``/``guessed`` are in
+    the same space as that phase's outputs."""
     idxV = jnp.arange(V, dtype=jnp.int32)
     pv_mask = idxV < pt.n_vars
-    Wv = pt.pos_bits.shape[1]
+    Wv = (pt.pos_bits_r if red else pt.pos_bits).shape[1]
     extras = (model == TRUE) & ~guessed & pv_mask
     excluded = (model != TRUE) & ~guessed & pv_mask
-    m_init = _base_assignment(pt, V, NCON)
+    if red:
+        m_init = _base_assignment_red(pt, V)
+    else:
+        m_init = _base_assignment(pt, V, NCON)
     m_init = _apply_anchors(pt, m_init, V)
     m_init = jnp.where(guessed, jnp.int32(TRUE), m_init)
     m_init = jnp.where(excluded, jnp.int32(FALSE), m_init)
@@ -889,7 +940,7 @@ def minimize_phase(pt: ProblemTensors, model: jax.Array, guessed: jax.Array,
         w = (lo + hi) // 2
         status, mt, _, steps = dpll(
             pt, m_init_t, m_init_f, extras_bits, w, budget, steps, NV, V,
-            enabled=en,
+            enabled=en, red=red,
         )
         sat_w = status == SAT
         # SAT at w: the minimum is ≤ w — keep this probe's model and shrink
@@ -920,14 +971,16 @@ def minimize_phase(pt: ProblemTensors, model: jax.Array, guessed: jax.Array,
     need_final = en & (best_w != m_hi) & (n_extras > 0)
     f_status, f_t, _, steps = dpll(
         pt, m_init_t, m_init_f, extras_bits, m_hi, budget, steps, NV, V,
-        enabled=need_final,
+        enabled=need_final, red=red,
     )
     m2_t = jnp.where(need_final & (f_status == SAT), f_t, m2_t)
     min_found = (
         jnp.where(need_final, f_status == SAT, m_found)
         | (en & (n_extras == 0))
     )
-    installed = unpack_mask(m2_t, V) & pv_mask & min_found & en
+    # Uniform [NV] output in both spaces (full space's activation/padding
+    # tail can never be "installed").
+    installed = (unpack_mask(m2_t, V) & pv_mask & min_found & en)[:NV]
     return installed, min_found, steps
 
 
@@ -986,12 +1039,15 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     (:func:`deppy_tpu.engine.driver.solve_problems`), which removes the
     vmap max-over-lanes coupling between phases — a batch's few UNSAT
     lanes no longer serialize every SAT lane through the deletion loop."""
+    red = phases_reduced()
+    Vs = NV if red else V
     result, guessed, model, steps, tr_stack, tr_n = search_phase(
-        pt, budget, V=V, NCON=NCON, NV=NV, T=T,
+        pt, budget, V=Vs, NCON=NCON, NV=NV, T=T, red=red,
     )
     sat_en = result == SAT
     installed, min_found, steps = minimize_phase(
-        pt, model, guessed, budget, steps, sat_en, V=V, NCON=NCON, NV=NV,
+        pt, model, guessed, budget, steps, sat_en,
+        V=Vs, NCON=NCON, NV=NV, red=red,
     )
     unsat_en = result == UNSAT
     core, steps = core_phase(
@@ -1003,6 +1059,12 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
     outcome = jnp.where(incomplete, jnp.int32(RUNNING), result)
     return SolveResult(outcome=outcome, installed=installed, core=core,
                        steps=steps, trace_stack=tr_stack, trace_n=tr_n)
+
+
+def phases_reduced() -> bool:
+    """Whether the search/minimization phases run in the reduced
+    problem-var plane space (bits impl only; see ProblemTensors)."""
+    return _resolved_impl() == "bits"
 
 
 @functools.lru_cache(maxsize=128)
@@ -1020,7 +1082,9 @@ def batched_solve(V: int, NCON: int, NV: int, T: int = 0):
 def batched_search(V: int, NCON: int, NV: int, T: int = 0):
     """Jitted, vmapped phase-1 program (baseline + search); per-lane
     ``en`` mask gates padding lanes."""
-    fn = functools.partial(search_phase, V=V, NCON=NCON, NV=NV, T=T)
+    red = phases_reduced()
+    fn = functools.partial(search_phase, V=NV if red else V,
+                           NCON=NCON, NV=NV, T=T, red=red)
     return jax.jit(jax.vmap(fn, in_axes=(0, None, 0)))
 
 
@@ -1032,10 +1096,10 @@ def batched_core(V: int, NCON: int, NV: int):
 
 
 def _minimize_gated(pt, result, model, guessed, budget, steps, en_lanes,
-                    *, V, NCON, NV):
+                    *, V, NCON, NV, red):
     return minimize_phase(
         pt, model, guessed, budget, steps,
-        en_lanes & (result == SAT), V=V, NCON=NCON, NV=NV,
+        en_lanes & (result == SAT), V=V, NCON=NCON, NV=NV, red=red,
     )
 
 
@@ -1045,7 +1109,9 @@ def batched_minimize_gated(V: int, NCON: int, NV: int):
     the SAME chunks (and device-resident tensors) as phase 1, so no
     host-side compaction round trip and no re-upload of problem tensors.
     Non-SAT lanes trip zero loop iterations."""
-    fn = functools.partial(_minimize_gated, V=V, NCON=NCON, NV=NV)
+    red = phases_reduced()
+    fn = functools.partial(_minimize_gated, V=NV if red else V,
+                           NCON=NCON, NV=NV, red=red)
     return jax.jit(jax.vmap(fn, in_axes=(0, 0, 0, 0, None, 0, 0)))
 
 

@@ -76,6 +76,7 @@ class _Dims:
         self.NCON = _bucket(max((p.n_cons for p in problems), default=1))
         self.V = self.NV + self.NCON
         self.Wv = -(-self.V // core.WORD)  # bitplane words per variable set
+        self.Wr = -(-self.NV // core.WORD)  # reduced (problem-var-only) words
         # Batch padded to a power of two AND a multiple of the mesh size so
         # the batch axis shards evenly.
         b = _bucket(batch)
@@ -113,6 +114,18 @@ def pad_problem(p: Problem, d: _Dims) -> core.ProblemTensors:
     card_ids = _pad2(p.card_ids, d.NA, d.M, -1)
     card_act = _pad1(p.card_act, d.NA, -1)
     pos_bits, neg_bits = _pack_planes(clauses, d.Wv)
+    # Reduced planes: drop activation-variable literals (constant TRUE in
+    # the search/minimization phases, so their ¬act literals fold away).
+    # Only the bits impl reads them — other impls get 1-word dummies so
+    # neither packing time nor upload bytes are spent on them.
+    if core.phases_reduced():
+        clauses_r = np.where(np.abs(clauses) <= p.n_vars, clauses, 0)
+        pos_bits_r, neg_bits_r = _pack_planes(clauses_r, d.Wr)
+        member_r = _pack_index_rows(card_ids, d.Wr)
+    else:
+        pos_bits_r = np.zeros((d.C, 1), np.int32)
+        neg_bits_r = np.zeros((d.C, 1), np.int32)
+        member_r = np.zeros((d.NA, 1), np.int32)
     return core.ProblemTensors(
         clauses=clauses,
         card_ids=card_ids,
@@ -127,6 +140,10 @@ def pad_problem(p: Problem, d: _Dims) -> core.ProblemTensors:
         neg_bits=neg_bits,
         card_member_bits=_pack_index_rows(card_ids, d.Wv),
         card_act_bits=_pack_index_rows(card_act[:, None], d.Wv),
+        pos_bits_r=pos_bits_r,
+        neg_bits_r=neg_bits_r,
+        card_member_bits_r=member_r,
+        card_valid=(card_act >= 0).astype(np.int32),
     )
 
 
@@ -199,6 +216,16 @@ def pad_stack(problems: Sequence[Problem], d: _Dims, total: int
         n_vars[i] = p.n_vars
         n_cons[i] = p.n_cons
     pos_bits, neg_bits = _pack_planes_batch(clauses, d.Wv)
+    if core.phases_reduced():
+        clauses_r = np.where(
+            np.abs(clauses) <= n_vars[:, None, None], clauses, 0
+        )
+        pos_bits_r, neg_bits_r = _pack_planes_batch(clauses_r, d.Wr)
+        member_r = _pack_index_batch(card_ids, d.Wr)
+    else:
+        pos_bits_r = np.zeros((total, d.C, 1), np.int32)
+        neg_bits_r = np.zeros((total, d.C, 1), np.int32)
+        member_r = np.zeros((total, d.NA, 1), np.int32)
     return core.ProblemTensors(
         clauses=clauses,
         card_ids=card_ids,
@@ -213,13 +240,28 @@ def pad_stack(problems: Sequence[Problem], d: _Dims, total: int
         neg_bits=neg_bits,
         card_member_bits=_pack_index_batch(card_ids, d.Wv),
         card_act_bits=_pack_index_batch(card_act[:, :, None], d.Wv),
+        pos_bits_r=pos_bits_r,
+        neg_bits_r=neg_bits_r,
+        card_member_bits_r=member_r,
+        card_valid=(card_act >= 0).astype(np.int32),
     )
 
 
-# Fields the bitplane ("bits"/"pallas") BCP paths never read; kept as host
-# numpy so jit's unused-argument pruning skips their upload entirely.  The
-# "gather" path reads them, so it uploads everything.
-_GATHER_ONLY_FIELDS = ("clauses", "card_ids")
+# Per-impl: fields the search/minimization phases never read, kept as host
+# numpy so jit's unused-argument pruning skips their upload.  Full-space
+# planes under "bits" are only read by the unsat-core phase, which either
+# runs compacted (few rows re-uploaded) or, when gated on the resident
+# chunks, pulls them lazily on its own dispatch.  "pallas" reads the full
+# packed planes but never the index matrices or reduced dummies; "gather"
+# reads only the index matrices.
+_HOST_KEPT_FIELDS = {
+    "bits": ("clauses", "card_ids",
+             "pos_bits", "neg_bits", "card_member_bits", "card_act_bits"),
+    "pallas": ("clauses", "card_ids",
+               "pos_bits_r", "neg_bits_r", "card_member_bits_r"),
+    "gather": ("pos_bits", "neg_bits", "card_member_bits", "card_act_bits",
+               "pos_bits_r", "neg_bits_r", "card_member_bits_r"),
+}
 
 
 _EMPTY_PROBLEM: Optional[Problem] = None
@@ -259,10 +301,9 @@ def _put_chunk(pts_chunk: core.ProblemTensors, mesh) -> core.ProblemTensors:
     upload)."""
     if mesh is not None:
         return _to_device(pts_chunk, mesh)
-    if core._resolved_impl() == "gather":
-        return jax.device_put(pts_chunk)
+    kept = _HOST_KEPT_FIELDS[core._resolved_impl()]
     return core.ProblemTensors(**{
-        f: (getattr(pts_chunk, f) if f in _GATHER_ONLY_FIELDS
+        f: (getattr(pts_chunk, f) if f in kept
             else jax.device_put(getattr(pts_chunk, f)))
         for f in core.ProblemTensors._fields
     })
@@ -397,7 +438,7 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
     steps = np.concatenate([s[1] for s in small])
     trace_n = np.concatenate([s[2] for s in small])
 
-    installed = np.zeros((total, d.V), bool)
+    installed = np.zeros((total, d.NV), bool)
     min_found = np.zeros(total, bool)
     cores = np.zeros((total, d.NCON), bool)
 

@@ -52,7 +52,7 @@ def _kernel(minw_ref, en_ref, pos_ref, neg_ref, mem_ref, act_ref, cardn_ref,
     pos = pos_ref[:]
     neg = neg_ref[:]
     mem = mem_ref[:]
-    act = act_ref[:]
+    card_active = act_ref[:] != 0    # [NA, 1] row-activity mask
     card_n2 = cardn_ref[:]
     min_bits = min_ref[:]
     min_w = minw_ref[0, 0]
@@ -64,7 +64,7 @@ def _kernel(minw_ref, en_ref, pos_ref, neg_ref, mem_ref, act_ref, cardn_ref,
     def body(state):
         _, t, f, _ = state
         return core.round_planes(
-            pos, neg, mem, act, card_n2, min_bits, min_w, t, f
+            pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f
         )
 
     # The lane-gating flag seeds `changed`: a disabled lane runs zero
@@ -76,15 +76,17 @@ def _kernel(minw_ref, en_ref, pos_ref, neg_ref, mem_ref, act_ref, cardn_ref,
     f_ref[:] = f
 
 
-def bcp_fixpoint(pos, neg, mem, act, card_n2, min_bits, min_w, t0, f0,
+def bcp_fixpoint(pos, neg, mem, card_active, card_n2, min_bits, min_w, t0, f0,
                  enabled=True):
     """Run BCP to fixpoint on bitplanes.  Shapes as in
-    :func:`deppy_tpu.engine.core.round_planes`; returns (conflict, t, f).
+    :func:`deppy_tpu.engine.core.round_planes` (``card_active`` is the
+    precomputed [NA, 1] row-activity mask); returns (conflict, t, f).
     Interprets on non-TPU backends so the same code path is testable on the
     CPU mesh used by the test suite."""
     Wv = pos.shape[1]
     minw2 = jnp.full((1, 1), min_w, jnp.int32)
     en2 = jnp.full((1, 1), enabled, jnp.int32)
+    act = card_active.astype(jnp.int32)
     vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
     smem = pl.BlockSpec((1, 1), memory_space=pltpu.SMEM)
     conf, t, f = pl.pallas_call(
