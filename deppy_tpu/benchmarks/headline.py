@@ -52,6 +52,10 @@ def run(n_problems: int = 512, length: int = 48, host_sample: int = 24,
 def main() -> None:
     import argparse
 
+    from ..utils.platform_env import apply_platform_env
+
+    apply_platform_env()
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) before running")

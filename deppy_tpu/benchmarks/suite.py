@@ -122,6 +122,9 @@ def run(quick: bool = False, out_path: Optional[str] = None,
 
 
 def main() -> None:
+    from ..utils.platform_env import apply_platform_env
+
+    apply_platform_env()
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
                     help="shrink batch sizes ~8x for smoke runs")

@@ -229,6 +229,9 @@ def _cmd_serve(args) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from .utils.platform_env import apply_platform_env
+
+    apply_platform_env()
     parser = _build_parser()
     args = parser.parse_args(argv)
     if args.command is None:

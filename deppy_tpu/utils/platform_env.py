@@ -11,7 +11,25 @@ query (the ``tests/conftest.py`` recipe).
 
 from __future__ import annotations
 
+import os
 from typing import Mapping
+
+
+def apply_platform_env() -> None:
+    """Make ``JAX_PLATFORMS`` from the environment actually stick.
+
+    The baked sitecustomize registers the axon TPU plugin at interpreter
+    start and pins the platform selection, so the env var alone is ignored
+    by the time user code runs; re-asserting it through ``jax.config``
+    before the first backend query restores the standard semantics.  Called
+    by every process entry point (CLI, service, benchmarks) so
+    ``JAX_PLATFORMS=cpu python -m deppy_tpu ...`` behaves as documented —
+    in particular it cannot hang on a crashed/restarting TPU worker."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
 
 
 def force_cpu_env(environ: Mapping[str, str], n_devices: int = 1) -> dict:
