@@ -689,8 +689,8 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
 
     def body(st):
         (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-         snap_t, snap_f, out_st, result, m_t, m_f, assumed, done, steps,
-         tr_stack, tr_n) = st
+         snap_t, snap_f, out_st, result, m_t, m_f, assumed, done, need_leaf,
+         steps, tr_stack, tr_n) = st
 
         # Arm selection (mutually exclusive; reference precedence order).
         is_leaf = (cnt == 0) & (result == RUNNING)
@@ -711,19 +711,13 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
         cur_t = snap_t[jnp.clip(gsp, 0, GS)][None, :]
         cur_f = snap_f[jnp.clip(gsp, 0, GS)][None, :]
 
-        # --- arm 0: leaf DPLL (search.go:167-169), lane-gated -----------
-        # Starts from the current guess-level fixpoint (equivalent to the
-        # assumption set: same fixpoint, so same search).  Planes pass
-        # straight through — no assignment-form round trip.
-        leaf_status, leaf_t, leaf_f, steps = dpll(
-            pt, cur_t, cur_f, no_min_bits, jnp.int32(0), budget, steps,
-            NV, V, enabled=is_leaf, red=red,
-        )
-        result = jnp.where(is_leaf, leaf_status, result)
-        leaf_sat = is_leaf & (leaf_status == SAT)
-        m_t = jnp.where(leaf_sat, leaf_t, m_t)
-        m_f = jnp.where(leaf_sat, leaf_f, m_f)
-        # Budget exhaustion leaves status RUNNING; the outer cond exits.
+        # --- arm 0: leaf DPLL request (search.go:167-169) ---------------
+        # The full solve is NOT embedded here: the lane freezes (the
+        # control loop's cond excludes need_leaf lanes) and one lane-gated
+        # dpll per episode runs after the control loop drains — so control
+        # iterations don't pay the dpll prologue/snapshot machinery, and
+        # concurrent leaf lanes share a single dpll invocation.
+        need_leaf = need_leaf | is_leaf
 
         # --- arm 1: backtrack bookkeeping (PopGuess, search.go:79-98) ---
         give_up = is_bt & (gsp == 0)
@@ -814,12 +808,41 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
         done = done | give_up | is_done
         steps = steps + (bt | is_push).astype(jnp.int32)
         return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
-                snap_t, snap_f, out_st, result, m_t, m_f, assumed, done, steps,
-                tr_stack, tr_n)
+                snap_t, snap_f, out_st, result, m_t, m_f, assumed, done,
+                need_leaf, steps, tr_stack, tr_n)
 
-    def cond(st):
-        done = st[-4]
-        steps = st[-3]
+    def ctl_cond(st):
+        done = st[16]
+        need_leaf = st[17]
+        steps = st[18]
+        return enabled & ~done & ~need_leaf & (steps <= budget)
+
+    def episode_body(st):
+        # Drain control arms until every live lane is done or parked at a
+        # leaf, then run one lane-gated dpll for all parked lanes.
+        st = lax.while_loop(ctl_cond, body, st)
+        (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
+         snap_t, snap_f, out_st, result, m_t, m_f, assumed, done, need_leaf,
+         steps, tr_stack, tr_n) = st
+        cur_t = snap_t[jnp.clip(gsp, 0, GS)][None, :]
+        cur_f = snap_f[jnp.clip(gsp, 0, GS)][None, :]
+        leaf_status, leaf_t, leaf_f, steps = dpll(
+            pt, cur_t, cur_f, no_min_bits, jnp.int32(0), budget, steps,
+            NV, V, enabled=need_leaf, red=red,
+        )
+        result = jnp.where(need_leaf, leaf_status, result)
+        leaf_sat = need_leaf & (leaf_status == SAT)
+        m_t = jnp.where(leaf_sat, leaf_t, m_t)
+        m_f = jnp.where(leaf_sat, leaf_f, m_f)
+        # Budget exhaustion leaves status RUNNING; the episode cond exits.
+        need_leaf = jnp.bool_(False)
+        return (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
+                snap_t, snap_f, out_st, result, m_t, m_f, assumed, done,
+                need_leaf, steps, tr_stack, tr_n)
+
+    def episode_cond(st):
+        done = st[16]
+        steps = st[18]
         return enabled & ~done & (steps <= budget)
 
     st = (
@@ -829,12 +852,12 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
         snap_t0, snap_f0, out_st0,
         jnp.int32(RUNNING), jnp.zeros((1, Wv), jnp.int32),
         jnp.zeros((1, Wv), jnp.int32), jnp.zeros(V, bool),
-        jnp.bool_(False), steps,
+        jnp.bool_(False), jnp.bool_(False), steps,
         jnp.full((T, GS), -1, jnp.int32), jnp.int32(0),
     )
-    st = lax.while_loop(cond, body, st)
+    st = lax.while_loop(episode_cond, episode_body, st)
     (_, _, _, _, _, _, _, _, _, _, _, _,
-     result, m_t, m_f, assumed, done, steps, tr_stack, tr_n) = st
+     result, m_t, m_f, assumed, done, _, steps, tr_stack, tr_n) = st
     result = jnp.where(done, result, jnp.int32(RUNNING))
     model = planes_to_assign(m_t, m_f, V)
     return result, assumed, model, steps, tr_stack, tr_n
