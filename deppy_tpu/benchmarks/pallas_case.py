@@ -1,0 +1,115 @@
+"""The Pallas kernel's target workload: ONE giant catalog problem.
+
+The fused VMEM-resident fixpoint kernel (:mod:`deppy_tpu.engine.pallas_bcp`)
+loses to the vmapped jnp "bits" path on batched workloads — XLA vectorizes
+the batch axis across the VPU lanes — and is predicted by its own docstring
+to win only on a single problem whose clause planes approach VMEM capacity,
+where each propagation round's HBM re-streaming is the bottleneck.  This
+benchmark builds exactly that case (a ~2k-package catalog lowering to
+clause planes of several MB) and measures ``bits`` vs ``pallas`` on it.
+
+Run on TPU: ``python -m deppy_tpu.benchmarks.pallas_case``.
+Prints one JSON line per impl and a final comparison line; feeds the
+"earn the Pallas kernel's keep" row of BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from .harness import log
+
+
+def _build(n_packages: int, versions: int):
+    from ..models import operatorhub_catalog
+    from ..sat.encode import encode
+
+    t0 = time.perf_counter()
+    p = encode(operatorhub_catalog(
+        n_packages=n_packages, versions_per_package=versions, seed=0
+    ))
+    log(f"encode: {time.perf_counter() - t0:.2f}s — n_vars={p.n_vars} "
+        f"n_cons={p.n_cons} clauses={p.clauses.shape}")
+    return p
+
+
+def _measure(problem, impl: str, repeats: int) -> dict:
+    from ..engine import core, driver
+
+    core.set_bcp_impl(impl)
+    try:
+        t0 = time.perf_counter()
+        (res,) = driver.solve_problems([problem])
+        warm_s = time.perf_counter() - t0
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            (res,) = driver.solve_problems([problem])
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        rec = {
+            "impl": impl,
+            "solve_ms": round(best * 1e3, 2),
+            "rate": round(1.0 / best, 2),
+            "warmup_s": round(warm_s, 2),
+            "outcome": int(res.outcome),
+            "steps": int(res.steps),
+        }
+    finally:
+        core.set_bcp_impl("auto")
+    return rec
+
+
+def run(n_packages: int, versions: int, repeats: int) -> list:
+    import jax
+
+    backend = jax.default_backend()
+    log(f"jax backend: {backend} devices={jax.devices()}")
+    problem = _build(n_packages, versions)
+
+    # Respect the kernel's VMEM budget (pallas_bcp.py docstring): the
+    # dominant planes are 2*C*Wv int32 words.
+    from ..engine.driver import _Dims
+
+    d = _Dims([problem], 1)
+    vmem_mb = 2 * d.C * d.Wv * 4 / 2**20
+    log(f"padded dims: C={d.C} V={d.V} Wv={d.Wv} -> clause planes "
+        f"{vmem_mb:.1f} MiB in VMEM")
+
+    impls = ["bits", "pallas"] if backend == "tpu" else ["bits"]
+    if backend != "tpu":
+        log("pallas requires the TPU backend; measuring bits only")
+    out = []
+    for impl in impls:
+        rec = _measure(problem, impl, repeats)
+        print(json.dumps(rec), flush=True)
+        out.append(rec)
+    if len(out) == 2:
+        cmp = {
+            "metric": "single giant catalog solve, pallas vs bits",
+            "bits_ms": out[0]["solve_ms"],
+            "pallas_ms": out[1]["solve_ms"],
+            "pallas_speedup": round(out[0]["solve_ms"] / out[1]["solve_ms"], 3),
+            "agree": out[0]["outcome"] == out[1]["outcome"],
+        }
+        print(json.dumps(cmp), flush=True)
+        out.append(cmp)
+    return out
+
+
+def main() -> None:
+    from ..utils.platform_env import apply_platform_env
+
+    apply_platform_env()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--packages", type=int, default=250)
+    ap.add_argument("--versions", type=int, default=8)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+    run(args.packages, args.versions, args.repeats)
+
+
+if __name__ == "__main__":
+    main()

@@ -729,6 +729,7 @@ def solve_batch(
     max_steps: Optional[int] = None,
     mesh=None,
     stats: Optional[dict] = None,
+    checkpoint_dir: Optional[str] = None,
 ):
     """Batch entry used by :class:`deppy_tpu.resolution.facade.BatchResolver`:
     N independent variable lists → per-problem result: a ``Solution`` dict,
@@ -736,9 +737,17 @@ def solve_batch(
     marker when that problem exhausted the step budget (problems are
     independent, so one straggler never voids its batchmates' answers).  A
     ``stats`` dict, when given, receives ``{"steps": N}`` summed over the
-    batch."""
+    batch.  ``checkpoint_dir`` enables group-wise resume for fleet-scale
+    batches (see :mod:`deppy_tpu.engine.checkpoint`)."""
     problems = [encode(vs) for vs in problem_vars]
-    results = solve_problems(problems, max_steps=max_steps, mesh=mesh)
+    if checkpoint_dir is not None:
+        from .checkpoint import solve_problems_checkpointed
+
+        results = solve_problems_checkpointed(
+            problems, checkpoint_dir, max_steps=max_steps, mesh=mesh
+        )
+    else:
+        results = solve_problems(problems, max_steps=max_steps, mesh=mesh)
     if stats is not None:
         stats["steps"] = int(sum(int(r.steps) for r in results))
     out: List[Union[dict, NotSatisfiable, Incomplete]] = []

@@ -87,10 +87,15 @@ class BatchResolver:
         backend: str = "auto",
         max_steps: Optional[int] = None,
         mesh=None,
+        checkpoint_dir: Optional[str] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
         self.mesh = mesh  # jax.sharding.Mesh from deppy_tpu.parallel
+        # Group-wise resume for fleet-scale batches: completed groups of a
+        # crashed run are loaded instead of re-solved (tensor backend only;
+        # see deppy_tpu.engine.checkpoint).
+        self.checkpoint_dir = checkpoint_dir
         # Engine iterations consumed by the last solve, summed over the
         # batch (SURVEY.md §5 observability; exported by the service).
         self.last_steps: int = 0
@@ -123,7 +128,8 @@ class BatchResolver:
         stats: dict = {}
         try:
             return solve_batch(
-                problems, max_steps=self.max_steps, mesh=self.mesh, stats=stats
+                problems, max_steps=self.max_steps, mesh=self.mesh,
+                stats=stats, checkpoint_dir=self.checkpoint_dir,
             )
         finally:
             self.last_steps = stats.get("steps", 0)
