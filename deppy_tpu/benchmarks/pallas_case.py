@@ -5,8 +5,10 @@ loses to the vmapped jnp "bits" path on batched workloads — XLA vectorizes
 the batch axis across the VPU lanes — and is predicted by its own docstring
 to win only on a single problem whose clause planes approach VMEM capacity,
 where each propagation round's HBM re-streaming is the bottleneck.  This
-benchmark builds exactly that case (a ~2k-package catalog lowering to
-clause planes of several MB) and measures ``bits`` vs ``pallas`` on it.
+benchmark builds exactly that case — the default 250 packages × 8 versions
+is a ~2k-bundle catalog whose padded plane dims sit just under the
+kernel's VMEM caps (C ≤ 8192, Wv ≤ 128; see pallas_bcp.py) — and
+measures ``bits`` vs ``pallas`` on it.
 
 Run on TPU: ``python -m deppy_tpu.benchmarks.pallas_case``.
 Prints one JSON line per impl and a final comparison line; feeds the

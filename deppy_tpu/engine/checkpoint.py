@@ -25,6 +25,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import zipfile
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -65,7 +66,9 @@ def _save_group(ckpt_dir: str, i: int, results: List[core.SolveResult]) -> None:
     tmp = _group_path(ckpt_dir, i) + ".tmp"
     with open(tmp, "wb") as fh:
         np.savez(fh, **arrays)
-    os.replace(tmp, _group_path(ckpt_dir, i))  # atomic: crash → no torn file
+        fh.flush()
+        os.fsync(fh.fileno())  # data on disk before the rename points at it
+    os.replace(tmp, _group_path(ckpt_dir, i))
 
 
 def _load_group(ckpt_dir: str, i: int, n: int) -> Optional[List[core.SolveResult]]:
@@ -75,7 +78,7 @@ def _load_group(ckpt_dir: str, i: int, n: int) -> Optional[List[core.SolveResult
     try:
         with np.load(path) as z:
             arrays = {f: z[f] for f in core.SolveResult._fields}
-    except (OSError, ValueError, KeyError):
+    except (OSError, ValueError, KeyError, EOFError, zipfile.BadZipFile):
         return None  # torn/stale file: recompute the group
     if arrays["outcome"].shape[0] != n:
         return None

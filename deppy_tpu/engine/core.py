@@ -338,6 +338,85 @@ def bcp_round(pt: ProblemTensors, assign: jax.Array,
 _BCP_IMPL = os.environ.get("DEPPY_TPU_BCP", "auto")
 
 
+def _batch_planes(clauses: jax.Array, W: int) -> Tuple[jax.Array, jax.Array]:
+    """Batched signed clause matrices [B, C, K] → (pos, neg) packed int32
+    bitplanes [B, C, W].  The device-side equivalent of the driver's numpy
+    packing.  O(K) emitted ops (K is small and static): each literal
+    column scatters into its word via a one-hot compare over the word
+    axis, OR-folded into the accumulators — compile size stays flat as W
+    grows (the near-VMEM single-problem case has W in the hundreds)."""
+    B, C, K = clauses.shape
+    w_idx = jnp.arange(W, dtype=jnp.int32)
+    acc_p = jnp.zeros((B, C, W), jnp.int32)
+    acc_n = jnp.zeros((B, C, W), jnp.int32)
+    for k in range(K):
+        lit = clauses[..., k]
+        v = jnp.where(lit != 0, jnp.abs(lit) - 1, 0)
+        onehot = _srl(v, 5)[..., None] == w_idx
+        bit = jnp.left_shift(jnp.int32(1), v & 31)[..., None]
+        acc_p = acc_p | jnp.where(onehot & (lit > 0)[..., None], bit, 0)
+        acc_n = acc_n | jnp.where(onehot & (lit < 0)[..., None], bit, 0)
+    return acc_p, acc_n
+
+
+def _batch_index_planes(rows: jax.Array, W: int) -> jax.Array:
+    """Batched 0-based index matrices [B, R, M] (-1 pad) → packed int32
+    membership bitplanes [B, R, W].  Same O(M)-op structure as
+    :func:`_batch_planes`."""
+    B, R, M = rows.shape
+    w_idx = jnp.arange(W, dtype=jnp.int32)
+    acc = jnp.zeros((B, R, W), jnp.int32)
+    for m in range(M):
+        v0 = rows[..., m]
+        valid = v0 >= 0
+        v = jnp.where(valid, v0, 0)
+        onehot = _srl(v, 5)[..., None] == w_idx
+        bit = jnp.left_shift(jnp.int32(1), v & 31)[..., None]
+        acc = acc | jnp.where(onehot & valid[..., None], bit, 0)
+    return acc
+
+
+def derive_planes(clauses: jax.Array, card_ids: jax.Array,
+                  card_act: jax.Array, n_vars: jax.Array,
+                  *, Wv: int, Wr: int, red: bool, full: bool = True
+                  ) -> Tuple[jax.Array, ...]:
+    """Compute packed-bitplane fields of :class:`ProblemTensors` from the
+    compact clause/cardinality tensors, on device and batched.
+
+    Returns (pos_bits, neg_bits, card_member_bits, card_act_bits,
+    pos_bits_r, neg_bits_r, card_member_bits_r).  The driver calls this
+    once per uploaded chunk (jitted, cached per shape): dispatches ship
+    only the compact [B, C, K] literal matrices and the device builds the
+    plane variants in a few fused passes instead of a host numpy loop.
+
+    ``red``/``full`` select which spaces materialize (the other side comes
+    back as 1-word dummies): the bits impl's search/minimization phases
+    read only the reduced problem-var space, so SAT-dominated batches
+    never hold full-space planes resident — only a dispatch that will run
+    the unsat-core phase (which probes with activations disabled) asks for
+    ``full=True``."""
+    B, C, _ = clauses.shape
+    NA = card_ids.shape[1]
+    if full:
+        pos, neg = _batch_planes(clauses, Wv)
+        member = _batch_index_planes(card_ids, Wv)
+        act_bits = _batch_index_planes(card_act[:, :, None], Wv)
+    else:
+        pos = jnp.zeros((B, C, 1), jnp.int32)
+        neg = jnp.zeros((B, C, 1), jnp.int32)
+        member = jnp.zeros((B, NA, 1), jnp.int32)
+        act_bits = jnp.zeros((B, NA, 1), jnp.int32)
+    if red:
+        cl_r = jnp.where(jnp.abs(clauses) <= n_vars[:, None, None], clauses, 0)
+        pos_r, neg_r = _batch_planes(cl_r, Wr)
+        mem_r = _batch_index_planes(card_ids, Wr)
+    else:
+        pos_r = jnp.zeros((B, C, 1), jnp.int32)
+        neg_r = jnp.zeros((B, C, 1), jnp.int32)
+        mem_r = jnp.zeros((B, NA, 1), jnp.int32)
+    return pos, neg, member, act_bits, pos_r, neg_r, mem_r
+
+
 def set_bcp_impl(name: str) -> None:
     """Select the BCP implementation ('auto'|'gather'|'bits'|'pallas') and
     invalidate compiled solves."""

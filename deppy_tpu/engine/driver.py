@@ -20,6 +20,7 @@ variables) which solve trivially and are dropped on decode.
 
 from __future__ import annotations
 
+import functools as _functools
 import os
 from typing import List, Optional, Sequence, Union
 
@@ -108,17 +109,30 @@ def _pack_index_rows(rows: np.ndarray, Wv: int) -> np.ndarray:
     return out.view(np.int32)
 
 
-def pad_problem(p: Problem, d: _Dims) -> core.ProblemTensors:
-    """Pad one lowered problem to the batch dims (numpy, host-side)."""
+def pad_problem(p: Problem, d: _Dims, pack: bool = True) -> core.ProblemTensors:
+    """Pad one lowered problem to the batch dims (numpy, host-side).
+
+    ``pack=False`` fills every bitplane field with 1-word dummies: the
+    dispatch paths derive planes on device (:func:`core.derive_planes`),
+    so host packing time and plane upload bytes are spent only by callers
+    that ask for them (single-problem tests, the graft entry)."""
     clauses = _pad2(p.clauses, d.C, d.K, 0)
     card_ids = _pad2(p.card_ids, d.NA, d.M, -1)
     card_act = _pad1(p.card_act, d.NA, -1)
-    pos_bits, neg_bits = _pack_planes(clauses, d.Wv)
+    if pack:
+        pos_bits, neg_bits = _pack_planes(clauses, d.Wv)
+        member_bits = _pack_index_rows(card_ids, d.Wv)
+        act_bits = _pack_index_rows(card_act[:, None], d.Wv)
+    else:
+        pos_bits = np.zeros((d.C, 1), np.int32)
+        neg_bits = np.zeros((d.C, 1), np.int32)
+        member_bits = np.zeros((d.NA, 1), np.int32)
+        act_bits = np.zeros((d.NA, 1), np.int32)
     # Reduced planes: drop activation-variable literals (constant TRUE in
     # the search/minimization phases, so their ¬act literals fold away).
     # Only the bits impl reads them — other impls get 1-word dummies so
     # neither packing time nor upload bytes are spent on them.
-    if core.phases_reduced():
+    if pack and core.phases_reduced():
         clauses_r = np.where(np.abs(clauses) <= p.n_vars, clauses, 0)
         pos_bits_r, neg_bits_r = _pack_planes(clauses_r, d.Wr)
         member_r = _pack_index_rows(card_ids, d.Wr)
@@ -138,8 +152,8 @@ def pad_problem(p: Problem, d: _Dims) -> core.ProblemTensors:
         n_cons=np.int32(p.n_cons),
         pos_bits=pos_bits,
         neg_bits=neg_bits,
-        card_member_bits=_pack_index_rows(card_ids, d.Wv),
-        card_act_bits=_pack_index_rows(card_act[:, None], d.Wv),
+        card_member_bits=member_bits,
+        card_act_bits=act_bits,
         pos_bits_r=pos_bits_r,
         neg_bits_r=neg_bits_r,
         card_member_bits_r=member_r,
@@ -184,13 +198,17 @@ def _pack_index_batch(rows: np.ndarray, Wv: int) -> np.ndarray:
     return out.view(np.int32)
 
 
-def pad_stack(problems: Sequence[Problem], d: _Dims, total: int
-              ) -> core.ProblemTensors:
+def pad_stack(problems: Sequence[Problem], d: _Dims, total: int,
+              pack: bool = True) -> core.ProblemTensors:
     """Pad and stack a whole problem list to [total, ...] batch tensors in
     one vectorized pass (trailing lanes beyond ``len(problems)`` are empty
     problems).  Equivalent to ``_stack([pad_problem(p, d) ...])`` but ~10×
     faster on fleet-scale batches — per-problem work is one slice
-    assignment per field; all bit-packing is batched."""
+    assignment per field.  ``pack=False`` (what the dispatch paths use)
+    skips host bit-packing entirely: plane fields come back as 1-word
+    dummies and the device derives the real planes from the compact
+    clause tensors (:func:`core.derive_planes`), which both removes the
+    dominant host cost of a dispatch and ships fewer bytes."""
     n = len(problems)
     clauses = np.zeros((total, d.C, d.K), np.int32)
     card_ids = np.full((total, d.NA, d.M), -1, np.int32)
@@ -215,8 +233,16 @@ def pad_stack(problems: Sequence[Problem], d: _Dims, total: int
         var_choices[i, : vc.shape[0], : vc.shape[1]] = vc
         n_vars[i] = p.n_vars
         n_cons[i] = p.n_cons
-    pos_bits, neg_bits = _pack_planes_batch(clauses, d.Wv)
-    if core.phases_reduced():
+    if pack:
+        pos_bits, neg_bits = _pack_planes_batch(clauses, d.Wv)
+        member_bits = _pack_index_batch(card_ids, d.Wv)
+        act_bits = _pack_index_batch(card_act[:, :, None], d.Wv)
+    else:
+        pos_bits = np.zeros((total, d.C, 1), np.int32)
+        neg_bits = np.zeros((total, d.C, 1), np.int32)
+        member_bits = np.zeros((total, d.NA, 1), np.int32)
+        act_bits = np.zeros((total, d.NA, 1), np.int32)
+    if pack and core.phases_reduced():
         clauses_r = np.where(
             np.abs(clauses) <= n_vars[:, None, None], clauses, 0
         )
@@ -238,8 +264,8 @@ def pad_stack(problems: Sequence[Problem], d: _Dims, total: int
         n_cons=n_cons,
         pos_bits=pos_bits,
         neg_bits=neg_bits,
-        card_member_bits=_pack_index_batch(card_ids, d.Wv),
-        card_act_bits=_pack_index_batch(card_act[:, :, None], d.Wv),
+        card_member_bits=member_bits,
+        card_act_bits=act_bits,
         pos_bits_r=pos_bits_r,
         neg_bits_r=neg_bits_r,
         card_member_bits_r=member_r,
@@ -247,21 +273,64 @@ def pad_stack(problems: Sequence[Problem], d: _Dims, total: int
     )
 
 
-# Per-impl: fields the search/minimization phases never read, kept as host
-# numpy so jit's unused-argument pruning skips their upload.  Full-space
-# planes under "bits" are only read by the unsat-core phase, which either
-# runs compacted (few rows re-uploaded) or, when gated on the resident
-# chunks, pulls them lazily on its own dispatch.  "pallas" reads the full
-# packed planes but never the index matrices or reduced dummies; "gather"
-# reads only the index matrices.
-_HOST_KEPT_FIELDS = {
-    "bits": ("clauses", "card_ids",
-             "pos_bits", "neg_bits", "card_member_bits", "card_act_bits"),
-    "pallas": ("clauses", "card_ids",
-               "pos_bits_r", "neg_bits_r", "card_member_bits_r"),
-    "gather": ("pos_bits", "neg_bits", "card_member_bits", "card_act_bits",
-               "pos_bits_r", "neg_bits_r", "card_member_bits_r"),
-}
+# Compact fields a dispatch uploads; every bitplane field is derived from
+# them on device (core.derive_planes), so no plane bytes ever cross
+# host→device and no host time is spent packing.
+_COMPACT_FIELDS = (
+    "clauses", "card_ids", "card_n", "card_act", "anchors", "choice_cand",
+    "var_choices", "n_vars", "n_cons", "card_valid",
+)
+
+
+@_functools.lru_cache(maxsize=128)
+def _planes_fn(Wv: int, Wr: int, red: bool, full: bool):
+    return jax.jit(
+        _functools.partial(core.derive_planes, Wv=Wv, Wr=Wr, red=red,
+                           full=full)
+    )
+
+
+def _derive_planes(pts: core.ProblemTensors, d: _Dims,
+                   full: Optional[bool] = None) -> core.ProblemTensors:
+    """Replace the (dummy) plane fields with device-derived planes.
+
+    ``full=None`` materializes the full-space planes only when the
+    selected impl's search/minimization phases read them — under the
+    default bits impl those run in the reduced space, so SAT-dominated
+    chunks never hold full planes resident; the unsat-core dispatches ask
+    for ``full=True`` explicitly (:func:`_derive_full`).
+
+    The gather impl never reads plane *contents* (its BCP walks the
+    compact clause matrices), but the packed DPLL state is still sized by
+    ``pos_bits.shape[-1]`` — it gets single-row zero planes carrying only
+    that width."""
+    if core._resolved_impl() == "gather":
+        B = np.shape(pts.clauses)[0]
+        z = np.zeros((B, 1, d.Wv), np.int32)
+        return pts._replace(
+            pos_bits=z, neg_bits=z, card_member_bits=z, card_act_bits=z,
+        )
+    if full is None:
+        full = not core.phases_reduced()
+    pos, neg, mem, act, pos_r, neg_r, mem_r = _planes_fn(
+        d.Wv, d.Wr, core.phases_reduced(), full
+    )(pts.clauses, pts.card_ids, pts.card_act, pts.n_vars)
+    return pts._replace(
+        pos_bits=pos, neg_bits=neg, card_member_bits=mem, card_act_bits=act,
+        pos_bits_r=pos_r, neg_bits_r=neg_r, card_member_bits_r=mem_r,
+    )
+
+
+def _derive_full(pts: core.ProblemTensors, d: _Dims) -> core.ProblemTensors:
+    """Add full-space planes to an already-resident chunk (unsat-core
+    phase inputs; reads the chunk's device-resident compact tensors, so
+    nothing re-crosses the host boundary)."""
+    pos, neg, mem, act, _, _, _ = _planes_fn(d.Wv, d.Wr, False, True)(
+        pts.clauses, pts.card_ids, pts.card_act, pts.n_vars
+    )
+    return pts._replace(
+        pos_bits=pos, neg_bits=neg, card_member_bits=mem, card_act_bits=act,
+    )
 
 
 _EMPTY_PROBLEM: Optional[Problem] = None
@@ -293,20 +362,21 @@ def _to_device(tree, mesh):
     return shard_batch(mesh, tree)
 
 
-def _put_chunk(pts_chunk: core.ProblemTensors, mesh) -> core.ProblemTensors:
-    """Upload one chunk's problem tensors explicitly so later phases reuse
-    the device-resident buffers instead of re-transferring.  On the
-    bitplane BCP paths the clause/cardinality index matrices are never
-    read, so they stay host-side (jit prunes unused args and skips their
-    upload)."""
+def _put_chunk(pts_chunk: core.ProblemTensors, mesh, d: _Dims,
+               full: Optional[bool] = None) -> core.ProblemTensors:
+    """Upload one chunk's compact tensors explicitly (so later phases
+    reuse the device-resident buffers instead of re-transferring) and
+    derive its bitplanes on device.  Under a mesh the compact fields are
+    sharded over the batch axis first; the derived planes inherit that
+    sharding (elementwise build)."""
     if mesh is not None:
-        return _to_device(pts_chunk, mesh)
-    kept = _HOST_KEPT_FIELDS[core._resolved_impl()]
-    return core.ProblemTensors(**{
-        f: (getattr(pts_chunk, f) if f in kept
-            else jax.device_put(getattr(pts_chunk, f)))
+        return _derive_planes(_to_device(pts_chunk, mesh), d, full)
+    put = core.ProblemTensors(**{
+        f: (jax.device_put(getattr(pts_chunk, f)) if f in _COMPACT_FIELDS
+            else getattr(pts_chunk, f))
         for f in core.ProblemTensors._fields
     })
+    return _derive_planes(put, d, full)
 
 
 def _pad_group(k: int, mesh) -> int:
@@ -351,7 +421,11 @@ def _solve_monolith(problems, budget, mesh, trace_cap) -> List[core.SolveResult]
     nothing and one compile beats three."""
     n = len(problems)
     d = _Dims(problems, max(n, 1), batch_multiple=mesh.size if mesh is not None else 1)
-    pts = _to_device(pad_stack(problems, d, d.B), mesh)
+    # The single program runs every phase, so both plane spaces
+    # materialize; _put_chunk device_puts the compact tensors first so
+    # they cross host→device exactly once.
+    pts = _put_chunk(pad_stack(problems, d, d.B, pack=False), mesh, d,
+                     full=True)
     fn = core.batched_solve(d.V, d.NCON, d.NV, trace_cap)
     res = fn(pts, budget)
     # One batched fetch for the whole result tree: each individual
@@ -411,14 +485,15 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
     CH = d.B
     n_chunks = max(1, -(-n // CH))
     total = n_chunks * CH
-    empty_row = pad_problem(_empty_problem(), d)
-    pts_np = pad_stack(problems, d, total)
+    empty_row = pad_problem(_empty_problem(), d, pack=False)
+    pts_np = pad_stack(problems, d, total, pack=False)
     en = np.arange(total) < n
     slices = _chunk_slices(total, CH)
 
-    # Problem tensors go to the device once per chunk and stay resident:
-    # phase 2 reuses them directly, so nothing is re-uploaded.
-    pts_dev = [_put_chunk(_rows(pts_np, sl), mesh) for sl in slices]
+    # Compact problem tensors go to the device once per chunk, planes are
+    # derived there, and everything stays resident: phase 2 reuses the
+    # buffers directly, so nothing is re-uploaded.
+    pts_dev = [_put_chunk(_rows(pts_np, sl), mesh, d) for sl in slices]
     en_dev = [_to_device(en[sl], mesh) for sl in slices]
 
     fn_a = core.batched_search(d.V, d.NCON, d.NV, trace_cap)
@@ -450,19 +525,28 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
     if unsat_idx.size and core_gated:
         # UNSAT-heavy batch: compaction would re-upload nearly every row —
         # run the deletion loop en-gated on the resident chunks instead.
+        # Under the bits impl the resident chunks carry only reduced
+        # planes; the core phase probes with activations disabled, so its
+        # full-space planes are derived here from the resident compact
+        # tensors (no host round trip).
         fn_cg = core.batched_core_gated(d.V, d.NCON, d.NV)
+        red = core.phases_reduced()
+        # Derive per chunk inside the loop so only one chunk's full planes
+        # are live at a time (they free once its dispatch retires).
         res_c = [
-            fn_cg(p, o[0], budget, o[3], e)
+            fn_cg(_derive_full(p, d) if red else p, o[0], budget, o[3], e)
             for p, o, e in zip(pts_dev, outs, en_dev)
         ]
     elif unsat_idx.size:
         # Few UNSAT lanes: compact them into (usually) one small dispatch;
-        # only those rows transfer again.
+        # only those rows transfer again (and only their compact tensors —
+        # the core phase's full-space planes are derived on device).
         fn_c = core.batched_core(d.V, d.NCON, d.NV)
         b = min(_pad_group(unsat_idx.size, mesh), CH)
         for idx in [unsat_idx[i: i + b] for i in range(0, unsat_idx.size, b)]:
             res_c.append(fn_c(
-                _to_device(_gather_rows(pts_np, idx, b, empty_row), mesh),
+                _put_chunk(_gather_rows(pts_np, idx, b, empty_row), mesh, d,
+                           full=True),
                 budget,
                 _to_device(_pad_rows(steps[idx], b), mesh),
                 _to_device(np.arange(b) < idx.size, mesh),

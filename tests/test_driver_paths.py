@@ -141,3 +141,22 @@ def test_pad_stack_matches_per_problem_padding():
         a, b = getattr(batched, f), getattr(reference, f)
         assert a.dtype == b.dtype and a.shape == b.shape, f
         assert (np.asarray(a) == np.asarray(b)).all(), f
+
+
+def test_device_derived_planes_match_host_packing():
+    """core.derive_planes (what dispatches run on device) must reproduce
+    the host numpy packing bit for bit, in both plane spaces."""
+    problems = [encode(random_instance(length=16, seed=s)) for s in range(9)]
+    d = driver._Dims(problems, 16)
+    host = driver.pad_stack(problems, d, 16, pack=True)
+    derived = driver._derive_planes(
+        driver.pad_stack(problems, d, 16, pack=False), d, full=True
+    )
+    plane_fields = (
+        "pos_bits", "neg_bits", "card_member_bits", "card_act_bits",
+        "pos_bits_r", "neg_bits_r", "card_member_bits_r",
+    )
+    for f in plane_fields:
+        a, b = np.asarray(getattr(derived, f)), np.asarray(getattr(host, f))
+        assert a.shape == b.shape, f
+        assert (a == b).all(), f
