@@ -59,6 +59,14 @@ def _build_parser() -> argparse.ArgumentParser:
         default=None,
         help="iteration budget per problem; exceeding it reports incomplete",
     )
+    p_resolve.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        metavar="DIR",
+        help="persist each dispatch group's results under DIR and resume "
+        "a crashed batch run from its completed groups (tensor backend; "
+        "see deppy_tpu.engine.checkpoint)",
+    )
 
     p_bench = sub.add_parser(
         "bench", help="run the headline benchmark (one JSON line on stdout)"
@@ -153,7 +161,8 @@ def _cmd_resolve(args) -> int:
 
     try:
         results = BatchResolver(
-            backend=args.backend, max_steps=args.max_steps
+            backend=args.backend, max_steps=args.max_steps,
+            checkpoint_dir=args.checkpoint_dir,
         ).solve(problems)
     except (DuplicateIdentifier, InternalSolverError) as e:
         print(f"error: {e}", file=sys.stderr)

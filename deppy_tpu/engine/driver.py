@@ -291,7 +291,8 @@ def _planes_fn(Wv: int, Wr: int, red: bool, full: bool):
 
 
 def _derive_planes(pts: core.ProblemTensors, d: _Dims,
-                   full: Optional[bool] = None) -> core.ProblemTensors:
+                   full: Optional[bool] = None,
+                   red: Optional[bool] = None) -> core.ProblemTensors:
     """Replace the (dummy) plane fields with device-derived planes.
 
     ``full=None`` materializes the full-space planes only when the
@@ -312,8 +313,10 @@ def _derive_planes(pts: core.ProblemTensors, d: _Dims,
         )
     if full is None:
         full = not core.phases_reduced()
+    if red is None:
+        red = core.phases_reduced()
     pos, neg, mem, act, pos_r, neg_r, mem_r = _planes_fn(
-        d.Wv, d.Wr, core.phases_reduced(), full
+        d.Wv, d.Wr, red, full
     )(pts.clauses, pts.card_ids, pts.card_act, pts.n_vars)
     return pts._replace(
         pos_bits=pos, neg_bits=neg, card_member_bits=mem, card_act_bits=act,
@@ -363,20 +366,21 @@ def _to_device(tree, mesh):
 
 
 def _put_chunk(pts_chunk: core.ProblemTensors, mesh, d: _Dims,
-               full: Optional[bool] = None) -> core.ProblemTensors:
+               full: Optional[bool] = None,
+               red: Optional[bool] = None) -> core.ProblemTensors:
     """Upload one chunk's compact tensors explicitly (so later phases
     reuse the device-resident buffers instead of re-transferring) and
     derive its bitplanes on device.  Under a mesh the compact fields are
     sharded over the batch axis first; the derived planes inherit that
     sharding (elementwise build)."""
     if mesh is not None:
-        return _derive_planes(_to_device(pts_chunk, mesh), d, full)
+        return _derive_planes(_to_device(pts_chunk, mesh), d, full, red)
     put = core.ProblemTensors(**{
         f: (jax.device_put(getattr(pts_chunk, f)) if f in _COMPACT_FIELDS
             else getattr(pts_chunk, f))
         for f in core.ProblemTensors._fields
     })
-    return _derive_planes(put, d, full)
+    return _derive_planes(put, d, full, red)
 
 
 def _pad_group(k: int, mesh) -> int:
@@ -545,8 +549,10 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
         b = min(_pad_group(unsat_idx.size, mesh), CH)
         for idx in [unsat_idx[i: i + b] for i in range(0, unsat_idx.size, b)]:
             res_c.append(fn_c(
+                # The core phase reads only the full-space planes: skip the
+                # reduced build on these re-gathered rows.
                 _put_chunk(_gather_rows(pts_np, idx, b, empty_row), mesh, d,
-                           full=True),
+                           full=True, red=False),
                 budget,
                 _to_device(_pad_rows(steps[idx], b), mesh),
                 _to_device(np.arange(b) < idx.size, mesh),

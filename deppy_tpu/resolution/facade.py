@@ -108,6 +108,15 @@ class BatchResolver:
         backend = resolve_backend(self.backend)
         self.last_steps = 0
         if backend == "host":
+            if self.checkpoint_dir is not None:
+                import sys
+
+                print(
+                    "warning: checkpoint_dir is a tensor-backend feature; "
+                    "the host engine solves serially without persisting "
+                    "groups — a crashed run will restart from scratch",
+                    file=sys.stderr,
+                )
             out: List[Union[Solution, NotSatisfiable, Incomplete]] = []
             for variables in problems:
                 solver = Solver(

@@ -18,6 +18,13 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Pin the env var as well as jax.config below: process entry points (the
+# CLI, the service) call apply_platform_env(), which re-asserts
+# JAX_PLATFORMS from the environment — on this machine the inherited value
+# is the axon TPU platform, and a test driving cli.main() with the tensor
+# backend would flip the session onto (possibly hung) TPU init mid-suite.
+os.environ["JAX_PLATFORMS"] = os.environ.get("DEPPY_TEST_PLATFORM", "cpu")
+
 try:
     import jax  # noqa: E402
 except ImportError:  # jax-less install: importorskip guards handle the rest

@@ -138,6 +138,26 @@ class TestResolveCommand:
         assert rc == 2
         assert "no such file" in capsys.readouterr().err
 
+    def test_checkpoint_dir_resume(self, tmp_path, capsys):
+        pytest.importorskip("jax")
+        path = write_doc(tmp_path, {"problems": [
+            {"variables": [{"id": "a", "constraints": [{"type": "mandatory"}]}]},
+            {"variables": [{"id": "b", "constraints": []}]},
+        ]})
+        ck = str(tmp_path / "ck")
+        rc = main(["resolve", path, "--backend", "tpu", "--output", "json",
+                   "--checkpoint-dir", ck])
+        first = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        # Second run resumes from disk and must agree exactly.
+        rc = main(["resolve", path, "--backend", "tpu", "--output", "json",
+                   "--checkpoint-dir", ck])
+        second = json.loads(capsys.readouterr().out)
+        assert rc == 0 and first == second
+        import os
+
+        assert any(n.endswith(".npz") for n in os.listdir(ck))
+
     def test_invalid_json(self, tmp_path, capsys):
         path = tmp_path / "bad.json"
         path.write_text("{nope")
