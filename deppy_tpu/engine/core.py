@@ -216,6 +216,48 @@ def unpack_mask(words: jax.Array, V: int) -> jax.Array:
     return bits.reshape(-1)[:V]
 
 
+# Mesh axis for clause-sharded propagation (intra-problem parallelism,
+# SURVEY.md §2.7 axis 3 / §5's beyond-one-core scaling): when set, each
+# device holds a row shard of the clause/cardinality planes and every
+# propagation round combines the per-shard unit/conflict partials with an
+# OR collective.  Module state (like _BCP_IMPL) so the whole solve stack
+# runs unmodified inside ``shard_map`` — control flow is replicated, only
+# the clause row axis is distributed.
+_CLAUSE_AXIS: "str | None" = None
+
+
+class clause_axis:
+    """Context manager: trace the enclosed programs with clause-row
+    collectives over ``name`` (a mesh axis inside ``shard_map``)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        global _CLAUSE_AXIS
+        self._prev = _CLAUSE_AXIS
+        _CLAUSE_AXIS = self.name
+        return self
+
+    def __exit__(self, *exc):
+        global _CLAUSE_AXIS
+        _CLAUSE_AXIS = self._prev
+        return False
+
+
+def _axis_or(x: jax.Array, axis_name: str) -> jax.Array:
+    """Bitwise OR across a mesh axis (static size at trace time)."""
+    g = lax.all_gather(x, axis_name)  # [D, ...]
+    out = g[0]
+    for i in range(1, g.shape[0]):
+        out = out | g[i]
+    return out
+
+
+def _axis_any(flag: jax.Array, axis_name: str) -> jax.Array:
+    return lax.psum(flag.astype(jnp.int32), axis_name) > 0
+
+
 def round_planes(pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f):
     """One propagation round on bitplanes — the exact bitwise translation of
     :func:`bcp_round` (itself the dense analog of gini's watched-literal BCP).
@@ -227,7 +269,14 @@ def round_planes(pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f):
     ``card_active`` is precomputed by the caller: activation variables are
     assumptions — propagation never flips one (a clause forcing ¬act on a
     true act is a conflict, not a flip) — so row activity is invariant
-    across a fixpoint and need not be re-derived every round."""
+    across a fixpoint and need not be re-derived every round.
+
+    Under :class:`clause_axis`, ``pos``/``neg``/``mem`` rows are one mesh
+    shard of the problem's clause set and ``t``/``f``/``min_bits`` are
+    replicated: the per-shard forced-literal masks and conflict flags
+    combine with one OR all-gather + psum per round — the only cross-device
+    traffic of a clause-sharded solve, a few dozen words per round over
+    ICI."""
     a = t | f
     sat = (((pos & t) | (neg & f)) != 0).any(axis=1, keepdims=True)   # [C,1]
     upos = pos & ~a
@@ -251,11 +300,20 @@ def round_planes(pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f):
     wneg = wneg | or_reduce_rows(jnp.where(full, mem & ~a, 0))
 
     # Dynamic "at most w of the extras" bound for the minimization loop.
+    # (min_bits/t are replicated under clause sharding — no collective.)
     mtrues = popcount32(min_bits & t).sum()
     min_over = mtrues > min_w
     wneg = jnp.where(mtrues == min_w, wneg | (min_bits & ~a), wneg)
 
-    conflict = dead.any() | over.any() | min_over | ((wpos & wneg) != 0).any()
+    row_conflict = dead.any() | over.any()
+    if _CLAUSE_AXIS is not None:
+        # Combine shard partials: forced-literal masks OR together (the
+        # replicated min-bound contribution is idempotent under OR), row
+        # conflicts any-reduce.
+        wpos = _axis_or(wpos, _CLAUSE_AXIS)
+        wneg = _axis_or(wneg, _CLAUSE_AXIS)
+        row_conflict = _axis_any(row_conflict, _CLAUSE_AXIS)
+    conflict = row_conflict | min_over | ((wpos & wneg) != 0).any()
     new_t = t | (wpos & ~a)
     new_f = f | (wneg & ~a)
     changed = ((new_t != t) | (new_f != f)).any() & ~conflict
