@@ -102,6 +102,27 @@ def _bench_config(cfg: Dict, host_sample: int = 16) -> Dict:
     }
 
 
+def _bench_encode_only(n: int = 200) -> Dict:
+    """The reference's ``BenchmarkNewInput`` analog (bench_test.go:79-86):
+    encode-only (constraint lowering, no solve) on the same seeded
+    256-variable random instance the solve benchmark uses."""
+    from ..models import random_instance
+    from ..sat.encode import encode
+
+    vs = random_instance()  # length=256, seed=9 — the bench_test instance
+    encode(vs)  # warm allocator/caches
+    t0 = time.perf_counter()
+    for _ in range(n):
+        encode(vs)
+    per = (time.perf_counter() - t0) / n
+    log(f"encode-only: {per * 1e6:.0f} us/encode")
+    return {
+        "config": "encode-only (BenchmarkNewInput analog, 256-var seeded instance)",
+        "encode_us": round(per * 1e6, 1),
+        "encodes_per_sec": round(1.0 / per, 1),
+    }
+
+
 def run(quick: bool = False, out_path: Optional[str] = None,
         only: Optional[int] = None) -> List[Dict]:
     import jax
@@ -112,6 +133,10 @@ def run(quick: bool = False, out_path: Optional[str] = None,
         if only is not None and i != only:
             continue
         res = _bench_config(cfg)
+        print(json.dumps(res), flush=True)
+        results.append(res)
+    if only is None:
+        res = _bench_encode_only()
         print(json.dumps(res), flush=True)
         results.append(res)
     if out_path:

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from typing import NamedTuple, Tuple
 
 import jax
@@ -220,10 +221,17 @@ def unpack_mask(words: jax.Array, V: int) -> jax.Array:
 # SURVEY.md §2.7 axis 3 / §5's beyond-one-core scaling): when set, each
 # device holds a row shard of the clause/cardinality planes and every
 # propagation round combines the per-shard unit/conflict partials with an
-# OR collective.  Module state (like _BCP_IMPL) so the whole solve stack
-# runs unmodified inside ``shard_map`` — control flow is replicated, only
-# the clause row axis is distributed.
-_CLAUSE_AXIS: "str | None" = None
+# OR collective.  Trace-time state (like _BCP_IMPL) so the whole solve
+# stack runs unmodified inside ``shard_map`` — control flow is replicated,
+# only the clause row axis is distributed.  Thread-local: a retrace of an
+# unsharded program on another thread while one thread holds the context
+# must not capture the collectives (an unbound axis name outside
+# shard_map is a trace error).
+_AXIS_STATE = threading.local()
+
+
+def _clause_axis_name() -> "str | None":
+    return getattr(_AXIS_STATE, "name", None)
 
 
 class clause_axis:
@@ -234,28 +242,30 @@ class clause_axis:
         self.name = name
 
     def __enter__(self):
-        global _CLAUSE_AXIS
-        self._prev = _CLAUSE_AXIS
-        _CLAUSE_AXIS = self.name
+        self._prev = _clause_axis_name()
+        _AXIS_STATE.name = self.name
         return self
 
     def __exit__(self, *exc):
-        global _CLAUSE_AXIS
-        _CLAUSE_AXIS = self._prev
+        _AXIS_STATE.name = self._prev
         return False
 
 
-def _axis_or(x: jax.Array, axis_name: str) -> jax.Array:
-    """Bitwise OR across a mesh axis (static size at trace time)."""
-    g = lax.all_gather(x, axis_name)  # [D, ...]
+def _axis_or_fused(wpos: jax.Array, wneg: jax.Array, conflict: jax.Array,
+                   axis_name: str) -> tuple:
+    """Combine a round's shard partials in ONE collective: the forced
+    masks and the conflict flag concatenate into a single [1, 2Wv+1]
+    buffer, one all-gather crosses ICI, and the OR-fold splits back out
+    (conflict OR == any)."""
+    Wv = wpos.shape[1]
+    buf = jnp.concatenate(
+        [wpos, wneg, conflict.astype(jnp.int32).reshape(1, 1)], axis=1
+    )
+    g = lax.all_gather(buf, axis_name)  # [D, 1, 2Wv+1]
     out = g[0]
     for i in range(1, g.shape[0]):
         out = out | g[i]
-    return out
-
-
-def _axis_any(flag: jax.Array, axis_name: str) -> jax.Array:
-    return lax.psum(flag.astype(jnp.int32), axis_name) > 0
+    return out[:, :Wv], out[:, Wv: 2 * Wv], out[0, 2 * Wv] != 0
 
 
 def round_planes(pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f):
@@ -306,13 +316,14 @@ def round_planes(pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f):
     wneg = jnp.where(mtrues == min_w, wneg | (min_bits & ~a), wneg)
 
     row_conflict = dead.any() | over.any()
-    if _CLAUSE_AXIS is not None:
+    axis = _clause_axis_name()
+    if axis is not None:
         # Combine shard partials: forced-literal masks OR together (the
         # replicated min-bound contribution is idempotent under OR), row
-        # conflicts any-reduce.
-        wpos = _axis_or(wpos, _CLAUSE_AXIS)
-        wneg = _axis_or(wneg, _CLAUSE_AXIS)
-        row_conflict = _axis_any(row_conflict, _CLAUSE_AXIS)
+        # conflicts any-reduce — all in one fused all-gather.
+        wpos, wneg, row_conflict = _axis_or_fused(
+            wpos, wneg, row_conflict, axis
+        )
     conflict = row_conflict | min_over | ((wpos & wneg) != 0).any()
     new_t = t | (wpos & ~a)
     new_f = f | (wneg & ~a)

@@ -21,6 +21,7 @@ like the batched path's.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional
 
 import jax
@@ -67,9 +68,6 @@ class _ShardDims(driver._Dims):
         for f in ("C", "NA"):
             v = getattr(self, f)
             setattr(self, f, -(-v // n_devices) * n_devices)
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=64)

@@ -1,0 +1,145 @@
+"""TPU backend diagnostics: root-cause a hanging/failing accelerator init.
+
+Rounds 1-2 of this build lost every TPU measurement to an "init hang" no
+one could explain.  Round 3 root-caused it (see BASELINE.md TPU notes):
+
+  * programs with too many vmap lanes reproducibly crash the tunneled
+    worker (the engine now chunks dispatches, driver.MAX_LANES);
+  * a crashed worker then makes PJRT init HANG for minutes while it
+    restarts — so "init hangs" is usually "worker is restarting", and the
+    right response is a bounded wait + retry, not a fast fallback;
+  * killing a probe mid-init can wedge the client side too, so probes must
+    run in disposable subprocesses.
+
+This module packages those findings as a tool: ``python -m
+deppy_tpu.utils.tpu_doctor`` probes the backend in a subprocess with a
+timeout, classifies the outcome (healthy / worker-restarting / plugin
+failure / no accelerator), reports suspicious sibling processes that may
+be holding the chip, and exits 0 only on a healthy accelerator.  bench.py
+embeds the same retry logic; this is the standalone "why is my TPU not
+answering" entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+# The probe re-asserts JAX_PLATFORMS from the environment (the baked
+# sitecustomize pins the platform selection otherwise — see
+# utils/platform_env.py), so `JAX_PLATFORMS=cpu` correctly diagnoses
+# "no accelerator" instead of hanging on the pinned TPU plugin.
+PROBE_SRC = (
+    "import os, time, jax; "
+    "p = os.environ.get('JAX_PLATFORMS'); "
+    "p and jax.config.update('jax_platforms', p); "
+    "t0=time.time(); d=jax.devices(); "
+    "print(jax.default_backend(), len(d), round(time.time()-t0, 1))"
+)
+
+
+def _probe(timeout_s: int) -> dict:
+    """One subprocess probe.  Returns {status, backend?, init_s?, detail}."""
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"status": "hang", "detail": f"init exceeded {timeout_s}s"}
+    wall = time.time() - t0
+    if out.returncode != 0:
+        tail = (out.stderr or "").strip().splitlines()[-3:]
+        return {"status": "error", "detail": " | ".join(tail)}
+    parts = (out.stdout or "").strip().split()
+    backend = parts[0] if parts else "?"
+    return {
+        "status": "ok" if backend not in ("cpu", "?") else "cpu-only",
+        "backend": backend,
+        "init_s": round(wall, 1),
+        "detail": out.stdout.strip(),
+    }
+
+
+def _chip_holders() -> list:
+    """Best-effort list of other python processes that might hold the chip
+    (a held chip makes init fail or hang until they exit)."""
+    me = os.getpid()
+    holders = []
+    try:
+        out = subprocess.run(
+            ["pgrep", "-af", "python"], capture_output=True, text=True,
+            timeout=10,
+        )
+        for line in (out.stdout or "").splitlines():
+            pid_s, _, cmd = line.partition(" ")
+            if "tpu_doctor" in cmd:  # ourselves / our parent shell
+                continue
+            if pid_s.isdigit() and int(pid_s) != me and (
+                "jax" in cmd or "deppy" in cmd or "bench" in cmd
+            ):
+                holders.append(line.strip())
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    return holders
+
+
+def diagnose(probe_timeout: int = 120, retries: int = 3,
+             retry_delay: int = 90) -> int:
+    """Run the diagnosis; prints a human report to stderr, returns an exit
+    code: 0 healthy accelerator, 1 worker-restart suspected (retry later),
+    2 plugin/config failure, 3 no accelerator configured."""
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+    plat = os.environ.get("JAX_PLATFORMS", "(unset)")
+    log(f"JAX_PLATFORMS={plat}")
+    hangs = 0
+    for attempt in range(1, retries + 1):
+        log(f"probe {attempt}/{retries} (timeout {probe_timeout}s)...")
+        r = _probe(probe_timeout)
+        if r["status"] == "ok":
+            log(f"HEALTHY: backend={r['backend']} init={r['init_s']}s "
+                f"({r['detail']})")
+            return 0
+        if r["status"] == "cpu-only":
+            log("NO ACCELERATOR: jax resolved to the CPU backend — either "
+                "JAX_PLATFORMS pins cpu or no TPU plugin is registered.")
+            return 3
+        if r["status"] == "error":
+            log(f"PLUGIN FAILURE: probe crashed: {r['detail']}")
+            log("Likely a config/env problem, not a busy worker; fix the "
+                "plugin before retrying.")
+            return 2
+        hangs += 1
+        log(f"probe hung ({r['detail']}).")
+        holders = _chip_holders()
+        if holders:
+            log("other python processes that may hold the chip:")
+            for h in holders[:8]:
+                log(f"  {h}")
+            log("if one of these is a stale run, terminate it and re-probe.")
+        if attempt < retries:
+            log(f"a crashed worker restarts in ~1-3 min; waiting "
+                f"{retry_delay}s before the next probe...")
+            time.sleep(retry_delay)
+    log(f"WORKER RESTART SUSPECTED: {hangs}/{retries} probes hung. "
+        "A crashed/restarting TPU worker blocks PJRT init for minutes; "
+        "wait and re-run, and keep per-dispatch lane counts bounded "
+        "(DEPPY_TPU_MAX_LANES) so programs do not crash it again.")
+    return 1
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--probe-timeout", type=int, default=120)
+    ap.add_argument("--retries", type=int, default=3)
+    ap.add_argument("--retry-delay", type=int, default=90)
+    args = ap.parse_args()
+    sys.exit(diagnose(args.probe_timeout, args.retries, args.retry_delay))
+
+
+if __name__ == "__main__":
+    main()
