@@ -106,7 +106,6 @@ def _bench_encode_only(n: int = 200) -> Dict:
     """The reference's ``BenchmarkNewInput`` analog (bench_test.go:79-86):
     encode-only (constraint lowering, no solve) on the same seeded
     256-variable random instance the solve benchmark uses."""
-    from ..models import random_instance
     from ..sat.encode import encode
 
     vs = random_instance()  # length=256, seed=9 — the bench_test instance

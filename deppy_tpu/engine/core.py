@@ -284,7 +284,7 @@ def round_planes(pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f):
     Under :class:`clause_axis`, ``pos``/``neg``/``mem`` rows are one mesh
     shard of the problem's clause set and ``t``/``f``/``min_bits`` are
     replicated: the per-shard forced-literal masks and conflict flags
-    combine with one OR all-gather + psum per round — the only cross-device
+    combine with one fused OR all-gather per round — the only cross-device
     traffic of a clause-sharded solve, a few dozen words per round over
     ICI."""
     a = t | f

@@ -9,7 +9,7 @@ replicated solve control flow (baseline Test, guess search, DPLL leaves,
 minimization, core extraction — all of :func:`deppy_tpu.engine.core
 .solve_full`); only boolean-constraint propagation touches the sharded
 rows, and each round combines the per-shard forced-literal masks and
-conflict flags with one OR all-gather + psum (:class:`core.clause_axis`).
+conflict flags with one fused OR all-gather (:class:`core.clause_axis`).
 That is the entire communication pattern — a few dozen packed words per
 round over ICI, no resharding, no host round trips inside the solve.
 

@@ -1,0 +1,120 @@
+"""Differential soak: host vs tensor (vs clause-sharded) on random problems.
+
+Extended fuzzing beyond the committed test suite's budget: sweeps problem
+sizes, constraint densities, and AtMost-heavy shapes, comparing the host
+engine (the semantic spec) against the batched tensor engine — and, every
+few cases, the clause-sharded path.  Exact comparison: installed sets for
+SAT, rendered minimal cores for UNSAT.
+
+Run: ``python scripts/soak.py [--cases N] [--seed S]`` (forces the
+8-device virtual-CPU platform).  Exits nonzero on the first divergence
+with a reproducer line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+
+def _force_cpu() -> None:
+    from deppy_tpu.utils.platform_env import apply_platform_env, force_cpu_env
+
+    # force_cpu_env REPLACES any pre-existing device-count flag (a stale
+    # count of 1 would make the sharded check trivially single-device).
+    os.environ.update(force_cpu_env(os.environ, n_devices=8))
+    apply_platform_env()
+
+
+def _generate(rng: random.Random):
+    """One random problem with randomized shape/density; returns
+    (description, variables)."""
+    from deppy_tpu.models import (
+        gvk_conflict_catalog,
+        operatorhub_catalog,
+        random_instance,
+        version_pinned_chains,
+    )
+
+    kind = rng.randrange(4)
+    seed = rng.randrange(1 << 30)
+    if kind == 0:
+        length = rng.choice([4, 12, 33, 64, 100])
+        p_m = rng.choice([0.05, 0.1, 0.3])
+        p_d = rng.choice([0.1, 0.15, 0.4])
+        p_c = rng.choice([0.05, 0.15, 0.3])
+        desc = f"random_instance(length={length}, seed={seed}, p_mandatory={p_m}, p_dependency={p_d}, p_conflict={p_c})"
+        vs = random_instance(length=length, seed=seed, p_mandatory=p_m,
+                             p_dependency=p_d, p_conflict=p_c)
+    elif kind == 1:
+        np_, vp = rng.choice([(3, 2), (8, 3), (15, 4)])
+        desc = f"operatorhub_catalog(n_packages={np_}, versions_per_package={vp}, seed={seed})"
+        vs = operatorhub_catalog(n_packages=np_, versions_per_package=vp, seed=seed)
+    elif kind == 2:
+        depth, width = rng.choice([(3, 2), (8, 3), (15, 2)])
+        desc = f"version_pinned_chains(depth={depth}, width={width}, seed={seed})"
+        vs = version_pinned_chains(depth=depth, width=width, seed=seed)
+    else:
+        g, p, r = rng.choice([(4, 3, 3), (8, 4, 6), (12, 2, 8)])
+        desc = f"gvk_conflict_catalog(n_groups={g}, providers_per_group={p}, n_required={r}, seed={seed})"
+        vs = gvk_conflict_catalog(n_groups=g, providers_per_group=p, n_required=r, seed=seed)
+    return desc, vs
+
+
+def _outcome(solver_call):
+    from deppy_tpu import sat
+
+    try:
+        return ("sat", tuple(sorted(v.identifier for v in solver_call())))
+    except sat.NotSatisfiable as e:
+        return ("unsat", str(e))
+    except sat.Incomplete:
+        return ("incomplete", None)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cases", type=int, default=300)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shard-every", type=int, default=10,
+                    help="also run the clause-sharded path every N cases")
+    args = ap.parse_args()
+
+    _force_cpu()
+    from deppy_tpu import sat
+    from deppy_tpu.parallel import clause_mesh, solve_one_sharded
+
+    rng = random.Random(args.seed)
+    mesh = clause_mesh()
+    t0 = time.time()
+    counts = {"sat": 0, "unsat": 0, "incomplete": 0}
+    for case in range(args.cases):
+        desc, vs = _generate(rng)
+        host = _outcome(lambda: sat.Solver(vs, backend="host").solve())
+        tensor = _outcome(lambda: sat.Solver(vs, backend="tpu").solve())
+        if host != tensor:
+            print(f"DIVERGENCE (host vs tensor) at case {case}: {desc}\n"
+                  f"  host:   {host}\n  tensor: {tensor}", flush=True)
+            return 1
+        if args.shard_every and case % args.shard_every == 0:
+            sharded = _outcome(lambda: solve_one_sharded(vs, mesh=mesh))
+            if host != sharded:
+                print(f"DIVERGENCE (host vs sharded) at case {case}: {desc}\n"
+                      f"  host:    {host}\n  sharded: {sharded}", flush=True)
+                return 1
+        counts[host[0]] += 1
+        if (case + 1) % 25 == 0:
+            print(f"[{case + 1}/{args.cases}] ok "
+                  f"({counts['sat']} sat / {counts['unsat']} unsat / "
+                  f"{counts['incomplete']} incomplete, "
+                  f"{time.time() - t0:.0f}s)", flush=True)
+    print(f"soak clean: {args.cases} cases, {counts}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main())
