@@ -1,4 +1,4 @@
-"""Full benchmark suite: the five BASELINE.json workload configs.
+"""Full benchmark suite: the BASELINE.json workload configs + extras.
 
 The reference publishes no numbers (SURVEY.md §6), so this suite produces
 the rebuild's own: for each config, a sampled serial host-engine baseline
@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 from ..models import (
     gvk_conflict_catalog,
     operatorhub_catalog,
+    pinned_tenant_catalog,
     random_instance,
     version_pinned_chains,
 )
@@ -27,7 +28,8 @@ from .harness import log
 
 
 def _configs(quick: bool) -> List[Dict]:
-    """The five BASELINE.json configs.  ``quick`` shrinks batch sizes for
+    """The five BASELINE.json configs plus the UNSAT-heavy extra.
+    ``quick`` shrinks batch sizes for
     CI smoke runs; full sizes match the config descriptions."""
     scale = 8 if quick else 1
     return [
@@ -62,6 +64,14 @@ def _configs(quick: bool) -> List[Dict]:
             ),
             "n": 10_000 // scale,
             "mesh": True,
+        },
+        # Beyond BASELINE.json's five: the UNSAT-heavy fleet shape, where
+        # the unsat-core extraction phase (gated or compacted deletion,
+        # chunk-first probing) dominates rather than idles.
+        {
+            "name": "UNSAT-heavy fleet: pinned tenants over shared GVK catalog",
+            "gen": lambda s: pinned_tenant_catalog(seed=s),
+            "n": 2048 // scale,
         },
     ]
 
@@ -154,7 +164,7 @@ def main() -> None:
                     help="shrink batch sizes ~8x for smoke runs")
     ap.add_argument("--out", default=None, help="also write a JSON file")
     ap.add_argument("--only", type=int, default=None,
-                    help="run a single config by index (0-4)")
+                    help="run a single config by index (0-5)")
     args = ap.parse_args()
     run(quick=args.quick, out_path=args.out, only=args.only)
 

@@ -12,6 +12,7 @@ from .catalog import (
     fleet_states,
     gvk_conflict_catalog,
     operatorhub_catalog,
+    pinned_tenant_catalog,
     version_pinned_chains,
 )
 
@@ -19,6 +20,7 @@ __all__ = [
     "fleet_states",
     "gvk_conflict_catalog",
     "operatorhub_catalog",
+    "pinned_tenant_catalog",
     "random_instance",
     "version_pinned_chains",
 ]

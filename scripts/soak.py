@@ -35,11 +35,12 @@ def _generate(rng: random.Random):
     from deppy_tpu.models import (
         gvk_conflict_catalog,
         operatorhub_catalog,
+        pinned_tenant_catalog,
         random_instance,
         version_pinned_chains,
     )
 
-    kind = rng.randrange(4)
+    kind = rng.randrange(5)
     seed = rng.randrange(1 << 30)
     if kind == 0:
         length = rng.choice([4, 12, 33, 64, 100])
@@ -57,10 +58,14 @@ def _generate(rng: random.Random):
         depth, width = rng.choice([(3, 2), (8, 3), (15, 2)])
         desc = f"version_pinned_chains(depth={depth}, width={width}, seed={seed})"
         vs = version_pinned_chains(depth=depth, width=width, seed=seed)
-    else:
+    elif kind == 3:
         g, p, r = rng.choice([(4, 3, 3), (8, 4, 6), (12, 2, 8)])
         desc = f"gvk_conflict_catalog(n_groups={g}, providers_per_group={p}, n_required={r}, seed={seed})"
         vs = gvk_conflict_catalog(n_groups=g, providers_per_group=p, n_required=r, seed=seed)
+    else:
+        nt = rng.choice([2, 4, 6])
+        desc = f"pinned_tenant_catalog(n_tenants={nt}, seed={seed})"
+        vs = pinned_tenant_catalog(n_tenants=nt, seed=seed)
     return desc, vs
 
 
