@@ -33,13 +33,24 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
     n = len(problems)
 
     sample = problems[: min(host_sample, n)]
-    t0 = time.perf_counter()
-    for p in sample:
-        try:
-            HostEngine(p).solve()
-        except NotSatisfiable:
-            pass  # UNSAT is a valid (timed) outcome; real errors propagate
-    host_s = (time.perf_counter() - t0) / len(sample)
+    t_start = time.perf_counter()
+    pass_times = []
+    while True:
+        t0 = time.perf_counter()
+        for p in sample:
+            try:
+                HostEngine(p).solve()
+            except NotSatisfiable:
+                pass  # UNSAT is a valid (timed) outcome; errors propagate
+        pass_times.append((time.perf_counter() - t0) / len(sample))
+        # Tiny samples (n=1 configs) repeat until the measurement window
+        # is long enough to dominate timer/GC jitter.  Best-of-passes, the
+        # same statistic the device side uses below — keeping the
+        # host/device ratio an apples-to-apples min/min.
+        if (time.perf_counter() - t_start >= 0.25
+                or len(pass_times) * len(sample) >= host_sample):
+            break
+    host_s = min(pass_times)
     log(f"host: {host_s * 1e3:.2f} ms/problem ({1.0 / host_s:.1f}/s serial)")
 
     t0 = time.perf_counter()
@@ -48,6 +59,16 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
     t0 = time.perf_counter()
     results = driver.solve_problems(problems, mesh=mesh)
     dev_s = time.perf_counter() - t0
+    # Sub-50ms dispatches (the single-problem config) are dominated by
+    # timer/GC jitter in one sample: re-time and keep the best.
+    if dev_s < 0.05:
+        reps = max(3, int(0.2 / max(dev_s, 1e-4)))
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            results = driver.solve_problems(problems, mesh=mesh)
+            times.append(time.perf_counter() - t0)
+        dev_s = min(times + [dev_s])
     n_sat = sum(1 for r in results if r.outcome == core.SAT)
     n_unsat = sum(1 for r in results if r.outcome == core.UNSAT)
     rate = n / dev_s

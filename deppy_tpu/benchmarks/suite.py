@@ -72,6 +72,7 @@ def _configs(quick: bool) -> List[Dict]:
             "name": "UNSAT-heavy fleet: pinned tenants over shared GVK catalog",
             "gen": lambda s: pinned_tenant_catalog(seed=s),
             "n": 2048 // scale,
+            "mesh": True,
         },
     ]
 
