@@ -16,4 +16,25 @@ Modules:
 
 from .driver import solve_batch, solve_one
 
-__all__ = ["solve_batch", "solve_one"]
+
+def clear_compile_caches() -> None:
+    """Drop every cached compiled program (the batched_* entry-point
+    caches, the plane-derivation cache, and JAX's own executable caches).
+
+    A long-lived process that solves problems of many *distinct padded
+    shapes* accumulates one executable per shape signature; the driver's
+    power-of-two bucketing bounds this for any one workload family, but a
+    service fed continually-novel shapes can grow compile memory without
+    bound (observed: an LLVM "Cannot allocate memory" after ~600 unique
+    single-problem shapes in one process).  Call this at a convenient
+    quiesce point to reset; the next solve of each shape recompiles."""
+    import jax
+
+    from . import core, driver
+
+    core.clear_batched_caches()
+    driver._planes_fn.cache_clear()
+    jax.clear_caches()
+
+
+__all__ = ["solve_batch", "solve_one", "clear_compile_caches"]

@@ -486,6 +486,18 @@ def derive_planes(clauses: jax.Array, card_ids: jax.Array,
     return pos, neg, member, act_bits, pos_r, neg_r, mem_r
 
 
+def clear_batched_caches() -> None:
+    """Drop every cached batched_* entry-point wrapper (and with them
+    their compiled executables).  Shared by :func:`set_bcp_impl` and
+    :func:`deppy_tpu.engine.clear_compile_caches` — add new cached entry
+    points here so both invalidation paths stay complete."""
+    batched_solve.cache_clear()
+    batched_search.cache_clear()
+    batched_core.cache_clear()
+    batched_minimize_gated.cache_clear()
+    batched_core_gated.cache_clear()
+
+
 def set_bcp_impl(name: str) -> None:
     """Select the BCP implementation ('auto'|'gather'|'bits'|'pallas') and
     invalidate compiled solves."""
@@ -493,11 +505,7 @@ def set_bcp_impl(name: str) -> None:
     if name not in ("auto", "gather", "bits", "pallas"):
         raise ValueError(f"unknown BCP impl {name!r}")
     _BCP_IMPL = name
-    batched_solve.cache_clear()
-    batched_search.cache_clear()
-    batched_core.cache_clear()
-    batched_minimize_gated.cache_clear()
-    batched_core_gated.cache_clear()
+    clear_batched_caches()
 
 
 def _resolved_impl() -> str:

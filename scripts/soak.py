@@ -111,6 +111,13 @@ def main() -> int:
                       f"  host:    {host}\n  sharded: {sharded}", flush=True)
                 return 1
         counts[host[0]] += 1
+        # Random shapes accumulate one executable per padded signature;
+        # reset periodically so a long soak doesn't OOM the compiler
+        # (engine.clear_compile_caches docstring has the numbers).
+        if (case + 1) % 100 == 0:
+            from deppy_tpu.engine import clear_compile_caches
+
+            clear_compile_caches()
         if (case + 1) % 25 == 0:
             print(f"[{case + 1}/{args.cases}] ok "
                   f"({counts['sat']} sat / {counts['unsat']} unsat / "

@@ -142,6 +142,7 @@ def test_pinned_tenant_catalog_unsat_core_shape():
     incompatible-pins failure: colliding tenant pins yield a small core of
     the two mandates, their pins, and the provider conflict — identically
     on both engines."""
+    pytest.importorskip("jax")
     from deppy_tpu import sat
     from deppy_tpu.models import pinned_tenant_catalog
 
