@@ -46,6 +46,10 @@ bench-suite: ## All five BASELINE.json workload configs.
 bench-suite-quick: ## Suite at ~1/8 batch sizes (smoke).
 	$(PYTHON) -m deppy_tpu.benchmarks.suite --quick
 
+.PHONY: soak
+soak: ## Differential fuzz: host vs tensor vs clause-sharded (scripts/soak.py).
+	$(PYTHON) scripts/soak.py --cases 300
+
 ##@ Run
 
 .PHONY: serve
