@@ -19,7 +19,7 @@ import json
 from .harness import bench_problems, log
 
 
-def run(n_problems: int = 512, length: int = 48, host_sample: int = 24,
+def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
         platform: str | None = None) -> dict:
     import jax
 
@@ -59,7 +59,7 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) before running")
-    ap.add_argument("--n-problems", type=int, default=512)
+    ap.add_argument("--n-problems", type=int, default=4096)
     ap.add_argument("--length", type=int, default=48)
     ap.add_argument("--host-sample", type=int, default=24)
     a = ap.parse_args()

@@ -71,7 +71,7 @@ def _build_parser() -> argparse.ArgumentParser:
     p_bench = sub.add_parser(
         "bench", help="run the headline benchmark (one JSON line on stdout)"
     )
-    p_bench.add_argument("--problems", type=int, default=512)
+    p_bench.add_argument("--problems", type=int, default=4096)
     p_bench.add_argument("--length", type=int, default=48)
 
     p_serve = sub.add_parser(
