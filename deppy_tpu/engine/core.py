@@ -1190,7 +1190,17 @@ def core_phase(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
     member-by-member probing in the host's order.  (Step *counts* differ:
     a core spread across many chunks pays the extra chunk probes, so a
     budget tuned to the wire of the sequential sweep can exhaust here —
-    the usual generous budgets are orders of magnitude away from this.)"""
+    the usual generous budgets are orders of magnitude away from this.)
+
+    Negative result, measured round 3: a second chunk level (64-wide
+    superblocks over these 8-chunks) is a net LOSS on every workload tried
+    (giant 1.7k-cons catalog: 9.0s vs 7.7s on CPU XLA; UNSAT-heavy fleet:
+    1920/s vs 2009/s on TPU).  The sweep's cost is dominated by the
+    kept-member probes — full SAT searches — and every hierarchy level
+    whose block contains a core member adds one more of those; the cheap
+    UNSAT block drops it saves were never the cost.  Don't re-try deeper
+    hierarchies; cut SAT-probe cost instead (or route to the host spec
+    engine for giant singles, driver.HOST_CORE_NCONS)."""
     Wv = pt.pos_bits.shape[1]
     no_min_bits = jnp.zeros((1, Wv), jnp.int32)
     active0 = (jnp.arange(NCON, dtype=jnp.int32) < pt.n_cons) & en
@@ -1238,7 +1248,8 @@ def core_phase(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
 
 
 def solve_full(pt: ProblemTensors, budget: jax.Array,
-               *, V: int, NCON: int, NV: int, T: int = 0) -> SolveResult:
+               *, V: int, NCON: int, NV: int, T: int = 0,
+               with_core: bool = True) -> SolveResult:
     """One problem end to end (host: HostEngine.solve; reference
     solve.go:53-119): baseline Test, guess search if undetermined,
     extras-only minimization on SAT, deletion-based core on UNSAT.
@@ -1266,10 +1277,16 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
         pt, model, guessed, budget, steps, sat_en,
         V=Vs, NCON=NCON, NV=NV, red=red,
     )
-    unsat_en = result == UNSAT
-    core, steps = core_phase(
-        pt, budget, steps, unsat_en, V=V, NCON=NCON, NV=NV,
-    )
+    if with_core:
+        unsat_en = result == UNSAT
+        core, steps = core_phase(
+            pt, budget, steps, unsat_en, V=V, NCON=NCON, NV=NV,
+        )
+    else:
+        # Core extraction delegated to the caller (the driver routes giant
+        # single problems to the host spec engine — driver.HOST_CORE_NCONS);
+        # compiling the deletion arm out keeps the program short.
+        core = jnp.zeros(NCON, bool)
     incomplete = (steps > budget) | (result == RUNNING) | (
         sat_en & ~min_found
     )
@@ -1285,13 +1302,16 @@ def phases_reduced() -> bool:
 
 
 @functools.lru_cache(maxsize=128)
-def batched_solve(V: int, NCON: int, NV: int, T: int = 0):
+def batched_solve(V: int, NCON: int, NV: int, T: int = 0,
+                  with_core: bool = True):
     """Jitted, vmapped single-program solve for one padded shape signature.
     Cached so each shape bucket compiles exactly once per process (the
     driver buckets padded dims to powers of two to bound the number of
     entries).  ``T`` is the static trace capacity (0 = tracing compiled
-    out)."""
-    fn = functools.partial(solve_full, V=V, NCON=NCON, NV=NV, T=T)
+    out); ``with_core=False`` compiles the deletion arm out (the driver
+    host-routes core extraction for giant single problems)."""
+    fn = functools.partial(solve_full, V=V, NCON=NCON, NV=NV, T=T,
+                           with_core=with_core)
     return jax.jit(jax.vmap(fn, in_axes=(0, None)))
 
 

@@ -423,18 +423,66 @@ def _pad_rows(a: np.ndarray, B: int, fill=0) -> np.ndarray:
     )
 
 
+# Core extraction for problems above this many applied constraints routes
+# to the host spec engine instead of the device deletion loop (monolith
+# and compacted-split paths; the en-gated UNSAT-heavy fleet path stays on
+# device, where batch parallelism amortizes the sweep).  Two measured
+# reasons: the sweep's cost is dominated by kept-member probes — full SAT
+# searches — which the serial host engine resolves faster than a lockstep
+# device program at giant sizes (2.1s vs 7.7s at 1.7k constraints, CPU
+# XLA), and on the tunneled TPU a minutes-long single program execution
+# can crash the worker (the same failure mode as ≥1024-lane programs).
+# Results are bit-identical: HostEngine.unsat_core_mask IS the spec the
+# device loop reproduces.
+HOST_CORE_NCONS = int(os.environ.get("DEPPY_TPU_HOST_CORE_NCONS", "768"))
+
+
+def _host_core_rows(problems, idx, d: _Dims, budget, spent) -> tuple:
+    """Host-engine core extraction for the given batch rows.  Returns
+    (cores [len(idx), NCON] bool, steps [len(idx)]) — steps to ADD to the
+    lane's device count.  Each lane's engine gets only the budget left
+    after its device solve (``spent``), so the combined count trips the
+    caller's ``steps > budget`` Incomplete check exactly like the device
+    core phase, which continues counting from the search's total against
+    the same budget — the routing stays outcome-invisible under tight
+    budgets, not just generous ones."""
+    from ..sat.host import HostEngine
+
+    cores = np.zeros((len(idx), d.NCON), bool)
+    steps = np.zeros(len(idx), np.int64)
+    for r, i in enumerate(idx):
+        remaining = int(budget) - int(spent[r])
+        if remaining <= 0:
+            steps[r] = 1  # already over: one tick keeps the lane RUNNING
+            continue
+        eng = HostEngine(problems[i], max_steps=remaining)
+        try:
+            cores[r, : problems[i].n_cons] = eng.unsat_core_mask()
+            steps[r] = eng.steps
+        except Incomplete:
+            # Budget exhausted mid-sweep: mirror the device contract —
+            # steps past the budget mark the lane Incomplete on decode.
+            steps[r] = remaining + 1
+    return cores, steps
+
+
 def _solve_monolith(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
     """Single-dispatch path (one jitted program, all phases lane-gated):
     the right trade for a batch of one, where phase compaction buys
     nothing and one compile beats three."""
     n = len(problems)
     d = _Dims(problems, max(n, 1), batch_multiple=mesh.size if mesh is not None else 1)
-    # The single program runs every phase, so both plane spaces
-    # materialize; _put_chunk device_puts the compact tensors first so
-    # they cross host→device exactly once.
+    host_core = any(p.n_cons > HOST_CORE_NCONS for p in problems)
+    # The single program runs every device phase, so both plane spaces
+    # materialize — except under host_core, where the deletion arm (the
+    # only reader of the full-space planes under the bits impl) is
+    # compiled out and the default derivation suffices.  _put_chunk
+    # device_puts the compact tensors first so they cross host→device
+    # exactly once.
     pts = _put_chunk(pad_stack(problems, d, d.B, pack=False), mesh, d,
-                     full=True)
-    fn = core.batched_solve(d.V, d.NCON, d.NV, trace_cap)
+                     full=True if not host_core else None)
+    fn = core.batched_solve(d.V, d.NCON, d.NV, trace_cap,
+                            with_core=not host_core)
     res = fn(pts, budget)
     # One batched fetch for the whole result tree: each individual
     # device→host transfer pays a full round trip on a tunneled TPU
@@ -443,9 +491,18 @@ def _solve_monolith(problems, budget, mesh, trace_cap) -> List[core.SolveResult]
     outcome = np.asarray(res.outcome)
     installed = np.asarray(res.installed)
     cores = np.asarray(res.core)
-    steps = np.asarray(res.steps)
+    steps = np.asarray(res.steps).astype(np.int64)
     trace_stack = np.asarray(res.trace_stack)
     trace_n = np.asarray(res.trace_n)
+    if host_core:
+        unsat_idx = np.nonzero(outcome[:n] == core.UNSAT)[0]
+        if unsat_idx.size:
+            hc, hs = _host_core_rows(problems, unsat_idx, d, budget,
+                                     steps[unsat_idx])
+            cores = cores.copy()
+            cores[unsat_idx] = hc
+            steps[unsat_idx] += hs
+            outcome = np.where(steps > int(budget), core.RUNNING, outcome)
     return [
         core.SolveResult(outcome[i], installed[i], cores[i], steps[i],
                          trace_stack[i], trace_n[i])
@@ -531,7 +588,7 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
     # One small fetch decides the phase-3 strategy (results + steps only).
     small = jax.device_get([(o[0], o[3], o[5]) for o in outs])
     result = np.concatenate([s[0] for s in small])
-    steps = np.concatenate([s[1] for s in small])
+    steps = np.concatenate([s[1] for s in small]).astype(np.int64)
     trace_n = np.concatenate([s[2] for s in small])
 
     installed = np.zeros((total, d.NV), bool)
@@ -559,21 +616,40 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
             for p, o, e in zip(pts_dev, outs, en_dev)
         ]
     elif unsat_idx.size:
-        # Few UNSAT lanes: compact them into (usually) one small dispatch;
-        # only those rows transfer again (and only their compact tensors —
-        # the core phase's full-space planes are derived on device).
-        fn_c = core.batched_core(d.V, d.NCON, d.NV)
-        b = min(_pad_group(unsat_idx.size, mesh), CH)
-        for idx in [unsat_idx[i: i + b] for i in range(0, unsat_idx.size, b)]:
-            res_c.append(fn_c(
-                # The core phase reads only the full-space planes: skip the
-                # reduced build on these re-gathered rows.
-                _put_chunk(_gather_rows(pts_np, idx, b, empty_row), mesh, d,
-                           full=True, red=False),
-                budget,
-                _to_device(_pad_rows(steps[idx], b), mesh),
-                _to_device(np.arange(b) < idx.size, mesh),
-            ))
+        # Few UNSAT lanes: giant problems route to the host spec engine
+        # (HOST_CORE_NCONS — kept-member probes are full SAT searches the
+        # serial host resolves faster, and long device programs endanger
+        # the tunneled worker); the rest compact into (usually) one small
+        # device dispatch — only those rows transfer again (and only
+        # their compact tensors — the core phase's full-space planes are
+        # derived on device).
+        host_idx = unsat_idx[
+            [problems[i].n_cons > HOST_CORE_NCONS for i in unsat_idx]
+        ]
+        dev_idx = unsat_idx[
+            [problems[i].n_cons <= HOST_CORE_NCONS for i in unsat_idx]
+        ]
+        b = 0
+        if dev_idx.size:
+            fn_c = core.batched_core(d.V, d.NCON, d.NV)
+            b = min(_pad_group(dev_idx.size, mesh), CH)
+            for idx in [dev_idx[i: i + b]
+                        for i in range(0, dev_idx.size, b)]:
+                res_c.append(fn_c(
+                    # The core phase reads only the full-space planes: skip
+                    # the reduced build on these re-gathered rows.
+                    _put_chunk(_gather_rows(pts_np, idx, b, empty_row),
+                               mesh, d, full=True, red=False),
+                    budget,
+                    _to_device(_pad_rows(steps[idx], b), mesh),
+                    _to_device(np.arange(b) < idx.size, mesh),
+                ))
+        if host_idx.size:
+            # Runs on the host CPU while the device chews on the phase-2/3
+            # dispatches above — the final fetch below synchronizes both.
+            host_cores, host_steps = _host_core_rows(
+                problems, host_idx, d, budget, steps[host_idx]
+            )
 
     # Final batched fetch: all phase-2 and phase-3 results (and trace
     # buffers if compiled in) in one round trip.
@@ -597,13 +673,17 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
             cores[unsat_idx] = core_c[unsat_idx]
             steps[unsat_idx] = st_c[unsat_idx]
         else:
-            core_c = np.concatenate([r[0] for r in fetched["c"]])
-            st_c = np.concatenate([r[1] for r in fetched["c"]])
-            ks = [min(b, unsat_idx.size - j)
-                  for j in range(0, unsat_idx.size, b)]
-            keep = np.concatenate([np.arange(b) < k for k in ks])
-            cores[unsat_idx] = core_c[keep]
-            steps[unsat_idx] = st_c[keep]
+            if dev_idx.size:
+                core_c = np.concatenate([r[0] for r in fetched["c"]])
+                st_c = np.concatenate([r[1] for r in fetched["c"]])
+                ks = [min(b, dev_idx.size - j)
+                      for j in range(0, dev_idx.size, b)]
+                keep = np.concatenate([np.arange(b) < k for k in ks])
+                cores[dev_idx] = core_c[keep]
+                steps[dev_idx] = st_c[keep]
+            if host_idx.size:
+                cores[host_idx] = host_cores
+                steps[host_idx] = steps[host_idx].astype(np.int64) + host_steps
     if trace_cap > 0:
         trace_stack = np.concatenate(fetched["tr"])
     else:

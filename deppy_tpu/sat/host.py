@@ -425,16 +425,16 @@ class HostEngine:
 
     # ---------------------------------------------------------- unsat core
 
-    def _unsat_core(self) -> List[AppliedConstraint]:
-        """Minimal unsat core over applied constraints via deletion-based
-        minimization: start from all constraints active and drop any whose
-        removal keeps the remainder unsatisfiable.  Engine-agnostic analog
-        of gini's failed-assumption ``Why`` (lit_mapping.go:198-207); yields
-        the same (unique-minimal) cores the reference tests pin
-        (solve_test.go:111-123,178-197,209-229)."""
+    def unsat_core_mask(self) -> np.ndarray:
+        """Minimal unsat core as a boolean mask over applied-constraint
+        indices, via deletion-based minimization: start from all
+        constraints active and drop any whose removal keeps the remainder
+        unsatisfiable.  Engine-agnostic analog of gini's failed-assumption
+        ``Why`` (lit_mapping.go:198-207).  Public so the tensor driver can
+        host-route core extraction for giant single problems
+        (engine.driver.HOST_CORE_NCONS) with bit-identical results — this
+        loop is the spec the device's chunked deletion provably matches."""
         p = self.p
-        if p.n_cons == 0:
-            return []
         active = np.ones(p.n_cons, dtype=bool)
         for j in range(p.n_cons):
             if not active[j]:
@@ -444,6 +444,16 @@ class HostEngine:
             ok, _ = self._dpll(anchors_assumed=False, act_enabled=trial)
             if not ok:
                 active = trial
+        return active
+
+    def _unsat_core(self) -> List[AppliedConstraint]:
+        """The mask above decoded to ``AppliedConstraint``s — what
+        ``NotSatisfiable`` carries; yields the same (unique-minimal) cores
+        the reference tests pin (solve_test.go:111-123,178-197,209-229)."""
+        p = self.p
+        if p.n_cons == 0:
+            return []
+        active = self.unsat_core_mask()
         return [p.applied[j] for j in range(p.n_cons) if active[j]]
 
     # ------------------------------------------------------------- budget

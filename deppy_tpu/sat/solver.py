@@ -8,7 +8,10 @@ selected per solve:
   * ``"host"``  — the NumPy reference engine (semantic specification);
   * ``"tpu"``   — the batched tensor engine on the default JAX backend
     (one problem = batch of one);
-  * ``"auto"``  — tpu when a JAX accelerator is usable, else host.
+  * ``"auto"``  — host for this single-problem facade (a batch of one is
+    dispatch-latency-bound; the host engine wins every measured
+    single-problem workload — BASELINE.md config 1); the batch facade's
+    ``auto`` picks the tensor engine when a JAX backend is usable.
 
 Usage::
 
@@ -57,7 +60,7 @@ class Solver:
         self.steps: int = 0
 
     def solve(self) -> List[Variable]:
-        backend = resolve_backend(self.backend)
+        backend = resolve_backend(self.backend, batch=False)
         if backend == "host":
             engine = HostEngine(
                 self.problem, tracer=self.tracer, max_steps=self.max_steps
@@ -78,11 +81,20 @@ class Solver:
             self.steps = stats.get("steps", 0)
 
 
-def resolve_backend(backend: str) -> str:
+def resolve_backend(backend: str, *, batch: bool = True) -> str:
     """Resolve a backend name to ``"host"`` or ``"tpu"``: the single place
     the ``auto`` policy lives (shared by :class:`Solver` and the resolution
-    facade).  Raises on unknown names."""
+    facade).  Raises on unknown names.
+
+    ``batch=False`` marks a single-problem solve: ``auto`` picks the host
+    engine there — a batch of one is dispatch-latency-bound and the serial
+    host engine beats the device on every single-problem workload measured
+    (BASELINE.md config 1: 67/s host vs 11/s device on the tunneled TPU).
+    The tensor engine's win is batch parallelism; ``auto`` reserves it for
+    batches.  Explicit ``"tpu"`` still forces the device path."""
     if backend == "auto":
+        if not batch:
+            return "host"
         return "tpu" if _engine_usable() else "host"
     if backend in ("host", "tpu"):
         return backend
