@@ -752,6 +752,54 @@ def partition_buckets(problems: Sequence[Problem]) -> List[List[int]]:
     return buckets
 
 
+# Progressive budget escalation (SURVEY.md §7.3 item 4's "compaction of
+# unfinished problems"): under vmap every lane pays the slowest lane's
+# while_loop trip count, and real catalog batches are heavy-tailed
+# (config-2 distribution: median 47 steps, p99 213, max 338).  Stage 1
+# runs every lane with this small step budget; the few lanes still
+# unfinished re-dispatch compacted at the full budget.  0 disables.
+# Default OFF: on CPU XLA the re-dispatch overhead loses 4-13% at every
+# stage-1 size tried (64/96/128/256 on the 1024-problem config-2 batch) —
+# the bet only pays where per-iteration cost grows with lane width, so it
+# stays an opt-in to A/B on real TPU before becoming a default.
+STAGE1_STEPS = int(os.environ.get("DEPPY_TPU_STAGE1_STEPS", "0"))
+# Escalation only pays when stage 1 resolves the vast majority; if more
+# than this fraction straggle, the batch is uniformly hard and the whole
+# batch re-runs at full budget (stage 1 was mis-sized, bounded waste).
+STAGE1_MAX_STRAGGLERS = 0.25
+# Batches below this size aren't worth a two-stage dance.
+STAGE1_MIN_BATCH = 64
+
+
+def _solve_escalating(impl, problems, budget, mesh, trace_cap):
+    """Run ``impl`` in two budget stages when profitable; transparent
+    fallbacks otherwise.  Tracing disables escalation (stage-2 re-runs
+    would re-record trace buffers from scratch)."""
+    if (
+        STAGE1_STEPS <= 0
+        or trace_cap > 0
+        or len(problems) < STAGE1_MIN_BATCH
+        or int(budget) < 8 * STAGE1_STEPS
+    ):
+        return impl(problems, budget, mesh, trace_cap)
+    results = impl(problems, np.int32(STAGE1_STEPS), mesh, 0)
+    stragglers = [
+        i for i, r in enumerate(results) if r.outcome == core.RUNNING
+    ]
+    if not stragglers:
+        return results
+    if len(stragglers) > STAGE1_MAX_STRAGGLERS * len(problems):
+        return impl(problems, budget, mesh, trace_cap)
+    sub = impl([problems[i] for i in stragglers], budget, mesh, 0)
+    for i, r in zip(stragglers, sub):
+        # Each lane reports the steps of the run that produced its result
+        # (stage-1 work on a redone straggler is not added: both redo
+        # branches then agree, and a lane can never report steps > budget
+        # alongside a decided outcome — same invariant as single-stage).
+        results[i] = r
+    return results
+
+
 def solve_problems(
     problems: Sequence[Problem],
     max_steps: Optional[int] = None,
@@ -781,10 +829,12 @@ def solve_problems(
     impl = _solve_split if split_phases else _solve_monolith
     buckets = partition_buckets(problems) if (bucketing and n > 1) else [list(range(n))]
     if len(buckets) == 1:
-        return impl(list(problems), budget, mesh, trace_cap)
+        return _solve_escalating(impl, list(problems), budget, mesh,
+                                 trace_cap)
     results: List[Optional[core.SolveResult]] = [None] * n
     for idxs in buckets:
-        sub = impl([problems[i] for i in idxs], budget, mesh, trace_cap)
+        sub = _solve_escalating(impl, [problems[i] for i in idxs], budget,
+                                mesh, trace_cap)
         for i, r in zip(idxs, sub):
             results[i] = r
     return results  # type: ignore[return-value]

@@ -1,0 +1,77 @@
+"""Progressive budget escalation must be observably invisible.
+
+``driver._solve_escalating`` runs stage 1 at a small step budget and
+re-dispatches stragglers compacted at the full budget (or re-runs the
+whole batch when stage 1 was mis-sized).  Outcomes, solutions, and cores
+must match the single-stage path bit for bit on every route through the
+state machine.
+"""
+
+import numpy as np
+import pytest
+
+from deppy_tpu.engine import core, driver
+from deppy_tpu.models import random_instance
+from deppy_tpu.sat.encode import encode
+
+
+@pytest.fixture(scope="module")
+def batch():
+    # Enough problems to clear driver.STAGE1_MIN_BATCH, small enough to
+    # compile fast.  The distribution is heavy-tailed, so a mid-sized
+    # stage-1 budget leaves a few stragglers.
+    n = max(96, driver.STAGE1_MIN_BATCH + 32)
+    return [encode(random_instance(length=32, seed=s)) for s in range(n)]
+
+
+def _solve(batch, stage1, monkeypatch):
+    monkeypatch.setattr(driver, "STAGE1_STEPS", stage1)
+    return driver.solve_problems(batch)
+
+
+def _assert_parity(a_results, b_results):
+    for a, b in zip(a_results, b_results):
+        assert int(a.outcome) == int(b.outcome)
+        if int(a.outcome) == core.SAT:
+            np.testing.assert_array_equal(a.installed, b.installed)
+        elif int(a.outcome) == core.UNSAT:
+            np.testing.assert_array_equal(a.core, b.core)
+
+
+def test_escalation_path_parity(batch, monkeypatch):
+    base = _solve(batch, 0, monkeypatch)
+    assert any(int(r.steps) > 64 for r in base)  # tail exists
+    esc = _solve(batch, 64, monkeypatch)  # few stragglers -> compacted redo
+    _assert_parity(base, esc)
+
+
+def test_misized_stage1_falls_back(batch, monkeypatch):
+    base = _solve(batch, 0, monkeypatch)
+    # Stage 1 of 1 step strands (nearly) every lane: the >25% straggler
+    # guard must re-run the whole batch at full budget, same results.
+    esc = _solve(batch, 1, monkeypatch)
+    _assert_parity(base, esc)
+
+
+def test_steps_identical_to_single_stage(batch, monkeypatch):
+    # Escalation is result-invisible INCLUDING the steps field: redone
+    # stragglers rerun the same deterministic program, and lanes that
+    # finished in stage 1 took exactly the steps they always take.
+    esc = _solve(batch, 64, monkeypatch)
+    base = _solve(batch, 0, monkeypatch)
+    assert [int(a.steps) for a in esc] == [int(b.steps) for b in base]
+
+
+def test_tracing_disables_escalation(batch, monkeypatch):
+    calls = []
+    real = driver._solve_split
+
+    def spy(problems, budget, mesh, trace_cap):
+        calls.append((len(problems), int(budget)))
+        return real(problems, budget, mesh, trace_cap)
+
+    monkeypatch.setattr(driver, "STAGE1_STEPS", 64)
+    monkeypatch.setattr(driver, "_solve_split", spy)
+    driver.solve_problems(batch, trace_cap=4)
+    # One call, full budget: no stage-1 invocation with the small budget.
+    assert len(calls) == 1 and calls[0][1] > 64
