@@ -445,7 +445,14 @@ def _host_core_rows(problems, idx, d: _Dims, budget, spent) -> tuple:
     caller's ``steps > budget`` Incomplete check exactly like the device
     core phase, which continues counting from the search's total against
     the same budget — the routing stays outcome-invisible under tight
-    budgets, not just generous ones."""
+    budgets, not just generous ones.
+
+    This function is the single source of the routing's steps/outcome
+    convention (remaining-budget cap, one-tick-over on exhaustion); its
+    three callers — _solve_monolith, _solve_split, and
+    parallel.clause_shard.solve_sharded — each add the returned steps to
+    the lane's device count and flip the lane to RUNNING when the total
+    exceeds the budget.  Change all three together."""
     from ..sat.host import HostEngine
 
     cores = np.zeros((len(idx), d.NCON), bool)
@@ -780,6 +787,10 @@ def _solve_escalating(impl, problems, budget, mesh, trace_cap):
         or trace_cap > 0
         or len(problems) < STAGE1_MIN_BATCH
         or int(budget) < 8 * STAGE1_STEPS
+        # Giant problems host-route their core extraction, and a stage-1
+        # budget is too small for that serial sweep to finish — it would
+        # run (on the critical path), exhaust, and be redone in stage 2.
+        or any(p.n_cons > HOST_CORE_NCONS for p in problems)
     ):
         return impl(problems, budget, mesh, trace_cap)
     results = impl(problems, np.int32(STAGE1_STEPS), mesh, 0)

@@ -71,15 +71,18 @@ class _ShardDims(driver._Dims):
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_fn(mesh: Mesh, V: int, NCON: int, NV: int):
+def _sharded_fn(mesh: Mesh, V: int, NCON: int, NV: int,
+                with_core: bool = True):
     """Compiled clause-sharded solve for one (mesh, space) signature —
     memoized like the driver's batched_* entry points, so same-shaped
     giant problems compile once.  Input-shape variation within a
     signature retraces via jit's own cache; callers must hold
     :class:`core.clause_axis` around invocations so those retraces pick
-    up the collectives."""
+    up the collectives.  ``with_core=False`` compiles the deletion arm
+    out (host-routed core extraction, driver.HOST_CORE_NCONS)."""
     return jax.jit(jax.shard_map(
-        functools.partial(core.solve_full, V=V, NCON=NCON, NV=NV),
+        functools.partial(core.solve_full, V=V, NCON=NCON, NV=NV,
+                          with_core=with_core),
         mesh=mesh,
         in_specs=(_specs(CLAUSE_AXIS), P()),
         out_specs=core.SolveResult(*[P()] * len(core.SolveResult._fields)),
@@ -112,9 +115,28 @@ def solve_sharded(
     pts = driver.pad_problem(problem, d, pack=True)
     budget = driver._budget(max_steps)
 
+    # Giant problems (which clause sharding exists for) host-route their
+    # core extraction exactly like the batched driver: the deletion
+    # sweep's kept-member probes are full SAT searches a serial engine
+    # resolves faster, and a minutes-long device program endangers the
+    # tunneled worker (BASELINE.md round-3 notes).
+    host_core = problem.n_cons > driver.HOST_CORE_NCONS
     with core.clause_axis(CLAUSE_AXIS):
-        res = _sharded_fn(mesh, d.V, d.NCON, d.NV)(pts, budget)
-    return jax.device_get(core.SolveResult(*res))
+        res = _sharded_fn(mesh, d.V, d.NCON, d.NV,
+                          with_core=not host_core)(pts, budget)
+    res = jax.device_get(core.SolveResult(*res))
+    if host_core and int(res.outcome) == core.UNSAT:
+        cores_, steps_ = driver._host_core_rows(
+            [problem], [0], d, budget, np.asarray([int(res.steps)])
+        )
+        total = int(res.steps) + int(steps_[0])
+        res = res._replace(
+            core=cores_[0],
+            steps=np.int64(total),
+            outcome=np.int32(core.RUNNING if total > int(budget)
+                             else res.outcome),
+        )
+    return res
 
 
 def solve_one_sharded(

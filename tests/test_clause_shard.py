@@ -105,3 +105,23 @@ def test_large_catalog_spans_shards(mesh):
     res = solve_sharded(p, mesh=mesh)
     assert int(res.outcome) == 1
     assert _sharded(vs, mesh) == _host(vs)
+
+
+def test_giant_unsat_host_routed_core(mesh, monkeypatch):
+    # Above driver.HOST_CORE_NCONS the sharded path compiles the deletion
+    # arm out and host-routes core extraction; force the threshold down so
+    # a small instance takes that route, and pin it against the device
+    # route (threshold forced up) — identical error, identical core.
+    from deppy_tpu.engine import driver as _driver
+
+    vs = operatorhub_catalog(n_packages=8, versions_per_package=3, seed=2)
+    vs = list(vs) + [
+        sat.variable("pin-a", sat.mandatory(), sat.conflict("pin-b")),
+        sat.variable("pin-b", sat.mandatory()),
+    ]
+    monkeypatch.setattr(_driver, "HOST_CORE_NCONS", 1 << 30)
+    dev_msg = _sharded(vs, mesh)
+    monkeypatch.setattr(_driver, "HOST_CORE_NCONS", 0)
+    host_msg = _sharded(vs, mesh)
+    assert dev_msg == host_msg
+    assert "pin-a is mandatory" in host_msg
