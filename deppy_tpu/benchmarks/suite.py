@@ -18,6 +18,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..models import (
+    giant_pinned_conflict,
     gvk_conflict_catalog,
     operatorhub_catalog,
     pinned_tenant_catalog,
@@ -28,9 +29,10 @@ from .harness import log
 
 
 def _configs(quick: bool) -> List[Dict]:
-    """The five BASELINE.json configs plus the UNSAT-heavy extra.
-    ``quick`` shrinks batch sizes for
-    CI smoke runs; full sizes match the config descriptions."""
+    """The five BASELINE.json configs plus two extras (the UNSAT-heavy
+    fleet and the giant-UNSAT core-extraction case).  ``quick`` shrinks
+    batch sizes for CI smoke runs; full sizes match the config
+    descriptions."""
     scale = 8 if quick else 1
     return [
         {
@@ -73,6 +75,17 @@ def _configs(quick: bool) -> List[Dict]:
             "gen": lambda s: pinned_tenant_catalog(seed=s),
             "n": 2048 // scale,
             "mesh": True,
+        },
+        # ONE giant unsatisfiable catalog: a 3-constraint core buried in
+        # ~1.7k constraints — exercises host-routed core extraction
+        # (driver.HOST_CORE_NCONS).  Quick mode stays above the routing
+        # threshold with a lighter catalog.
+        {
+            "name": "giant catalog UNSAT: pinned conflict, core extraction",
+            "gen": (lambda s: giant_pinned_conflict(
+                n_packages=150, versions_per_package=6, seed=s
+            )) if quick else (lambda s: giant_pinned_conflict(seed=s)),
+            "n": 1,
         },
     ]
 
@@ -165,7 +178,7 @@ def main() -> None:
                     help="shrink batch sizes ~8x for smoke runs")
     ap.add_argument("--out", default=None, help="also write a JSON file")
     ap.add_argument("--only", type=int, default=None,
-                    help="run a single config by index (0-5)")
+                    help="run a single config by index (0-6)")
     args = ap.parse_args()
     run(quick=args.quick, out_path=args.out, only=args.only)
 

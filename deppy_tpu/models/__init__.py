@@ -10,6 +10,7 @@ conformance fuzzing, differential testing, and performance measurement.
 from .random_instance import random_instance
 from .catalog import (
     fleet_states,
+    giant_pinned_conflict,
     gvk_conflict_catalog,
     operatorhub_catalog,
     pinned_tenant_catalog,
@@ -18,6 +19,7 @@ from .catalog import (
 
 __all__ = [
     "fleet_states",
+    "giant_pinned_conflict",
     "gvk_conflict_catalog",
     "operatorhub_catalog",
     "pinned_tenant_catalog",

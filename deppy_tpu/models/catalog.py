@@ -13,6 +13,9 @@ GVK uniqueness), sized per /root/repo/BASELINE.json configs:
    (tenants pinning incompatible providers of a shared GVK).
 5. :func:`fleet_states` — N independent cluster states over a shared
    catalog: the fleet-scale batched workload.
+6. :func:`giant_pinned_conflict` — ONE giant unsatisfiable catalog (a
+   3-constraint core buried in ~1.7k constraints): the host-routed
+   core-extraction workload.
 """
 
 from __future__ import annotations
@@ -150,6 +153,26 @@ def pinned_tenant_catalog(
         out.append(
             Variable(f"tenant{t}", (mandatory(), dependency(f"g{g}.op{p}")))
         )
+    return out
+
+
+def giant_pinned_conflict(
+    n_packages: int = 250,
+    versions_per_package: int = 8,
+    seed: int = 0,
+) -> List[Variable]:
+    """ONE giant unsatisfiable catalog: an :func:`operatorhub_catalog`
+    (~``n_packages * versions_per_package`` bundles, ~1.7k applied
+    constraints at the defaults) plus two mandatory pins that conflict —
+    the cluster-wide "two mandatory operators are incompatible" failure
+    at full catalog scale.  The answer is a 3-constraint core buried in
+    thousands of irrelevant constraints: the workload that exercises
+    host-routed core extraction (engine.driver.HOST_CORE_NCONS) and,
+    historically, the long-device-program worker crash it guards against
+    (BASELINE.md round-3 notes)."""
+    out = list(operatorhub_catalog(n_packages, versions_per_package, seed))
+    out.append(Variable("pin-a", (mandatory(), conflict("pin-b"))))
+    out.append(Variable("pin-b", (mandatory(),)))
     return out
 
 
