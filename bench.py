@@ -108,6 +108,16 @@ def _run_workload(platform: str | None, timeout_s: int) -> dict | None:
     if platform == "cpu":
         env = _cpu_env()
         cmd += ["--platform", "cpu"]
+    else:
+        # Accelerator run: opt into the persistent compilation cache so
+        # repeat invocations skip the 15-40s warm-up compile (the
+        # platform env is unset here, so enable_compile_cache's
+        # conservative default would leave it off).
+        env.setdefault(
+            "DEPPY_TPU_COMPILE_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "deppy_tpu",
+                         "xla"),
+        )
     try:
         out = subprocess.run(
             cmd,

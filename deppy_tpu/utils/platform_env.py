@@ -24,12 +24,60 @@ def apply_platform_env() -> None:
     before the first backend query restores the standard semantics.  Called
     by every process entry point (CLI, service, benchmarks) so
     ``JAX_PLATFORMS=cpu python -m deppy_tpu ...`` behaves as documented —
-    in particular it cannot hang on a crashed/restarting TPU worker."""
+    in particular it cannot hang on a crashed/restarting TPU worker.
+
+    Also enables the persistent compilation cache (see
+    :func:`enable_compile_cache`)."""
     platforms = os.environ.get("JAX_PLATFORMS")
     if platforms:
         import jax
 
         jax.config.update("jax_platforms", platforms)
+    enable_compile_cache()
+
+
+def enable_compile_cache() -> None:
+    """Point XLA's persistent compilation cache at a stable directory.
+
+    The engine compiles one executable per padded shape bucket; a cold
+    process pays 10-40s of warm-up for the first solve of each shape.
+    With the persistent cache, any shape ever compiled on this machine
+    (per backend) loads from disk in milliseconds — cutting service
+    cold-start and benchmark warm-up after the first run.
+
+    ``DEPPY_TPU_COMPILE_CACHE`` overrides the directory; ``off`` (or
+    ``0``, any case) disables.  Never fails: a read-only home or an old
+    JAX just leaves caching off.
+
+    Default-on only when ``JAX_PLATFORMS`` names a non-CPU platform:
+    XLA:CPU's AOT cache loader warns about compile-vs-host
+    machine-feature mismatches ("could lead to SIGILL"), so CPU-backed
+    processes — forced-CPU tests/bench fallback AND machines where the
+    platform is simply unset and resolves to CPU — skip it unless the
+    env var explicitly opts in.  ``bench.py`` opts its accelerator
+    subprocess in explicitly (the platform env is unset there so the
+    PJRT plugin resolves)."""
+    path = os.environ.get("DEPPY_TPU_COMPILE_CACHE")
+    if path is not None and path.strip().lower() in ("off", "0", ""):
+        return
+    if path is None:
+        platforms = (os.environ.get("JAX_PLATFORMS") or "").strip()
+        if not platforms or platforms == "cpu":
+            return
+        path = os.path.join(
+            os.path.expanduser("~"), ".cache", "deppy_tpu", "xla"
+        )
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Default thresholds skip small/fast programs; the engine's many
+        # per-shape executables are exactly what we want cached.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
 
 
 def force_cpu_env(environ: Mapping[str, str], n_devices: int = 1) -> dict:

@@ -1,0 +1,81 @@
+"""Platform/env provisioning: forced-platform recipe + compile cache."""
+
+import jax
+import pytest
+
+from deppy_tpu.utils import platform_env
+
+
+_CACHE_KEYS = (
+    "jax_compilation_cache_dir",
+    "jax_persistent_cache_min_compile_time_secs",
+    "jax_persistent_cache_min_entry_size_bytes",
+)
+
+
+@pytest.fixture
+def reset_cache_config():
+    prev = {k: getattr(jax.config, k) for k in _CACHE_KEYS}
+    yield
+    for k, v in prev.items():
+        jax.config.update(k, v)
+
+
+def test_cpu_platform_skips_cache_by_default(monkeypatch, reset_cache_config):
+    # The suite runs under JAX_PLATFORMS=cpu (conftest): the XLA:CPU AOT
+    # loader's machine-feature mismatch makes a persistent cache unsafe
+    # as a default there.
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("DEPPY_TPU_COMPILE_CACHE", raising=False)
+    jax.config.update("jax_compilation_cache_dir", None)
+    platform_env.enable_compile_cache()
+    assert jax.config.jax_compilation_cache_dir is None
+
+
+def test_explicit_cache_dir_wins(monkeypatch, tmp_path, reset_cache_config):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("DEPPY_TPU_COMPILE_CACHE", str(tmp_path / "xla"))
+    platform_env.enable_compile_cache()
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xla")
+    assert (tmp_path / "xla").is_dir()
+
+
+@pytest.mark.parametrize("value", ["off", "OFF", "0", ""])
+def test_off_disables(monkeypatch, reset_cache_config, value):
+    monkeypatch.setenv("DEPPY_TPU_COMPILE_CACHE", value)
+    jax.config.update("jax_compilation_cache_dir", None)
+    platform_env.enable_compile_cache()
+    assert jax.config.jax_compilation_cache_dir is None
+
+
+def test_unset_platform_skips_cache(monkeypatch, reset_cache_config):
+    # A machine with no JAX_PLATFORMS set may resolve to XLA:CPU, where
+    # the AOT cache is unsafe — the default must stay off there.
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("DEPPY_TPU_COMPILE_CACHE", raising=False)
+    jax.config.update("jax_compilation_cache_dir", None)
+    platform_env.enable_compile_cache()
+    assert jax.config.jax_compilation_cache_dir is None
+
+
+def test_accelerator_platform_enables_cache(monkeypatch, tmp_path,
+                                            reset_cache_config):
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.delenv("DEPPY_TPU_COMPILE_CACHE", raising=False)
+    monkeypatch.setenv("HOME", str(tmp_path))
+    jax.config.update("jax_compilation_cache_dir", None)
+    platform_env.enable_compile_cache()
+    assert jax.config.jax_compilation_cache_dir == str(
+        tmp_path / ".cache" / "deppy_tpu" / "xla"
+    )
+
+
+def test_force_cpu_env_replaces_device_count(monkeypatch):
+    env = platform_env.force_cpu_env(
+        {"XLA_FLAGS": "--xla_force_host_platform_device_count=8 --foo"},
+        n_devices=2,
+    )
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=2" in env["XLA_FLAGS"]
+    assert "--foo" in env["XLA_FLAGS"]
+    assert "=8" not in env["XLA_FLAGS"]
