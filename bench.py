@@ -112,12 +112,9 @@ def _run_workload(platform: str | None, timeout_s: int) -> dict | None:
         # Accelerator run: opt into the persistent compilation cache so
         # repeat invocations skip the 15-40s warm-up compile (the
         # platform env is unset here, so enable_compile_cache's
-        # conservative default would leave it off).
-        env.setdefault(
-            "DEPPY_TPU_COMPILE_CACHE",
-            os.path.join(os.path.expanduser("~"), ".cache", "deppy_tpu",
-                         "xla"),
-        )
+        # conservative default would leave it off).  "on" resolves to
+        # platform_env.default_cache_dir inside the subprocess.
+        env.setdefault("DEPPY_TPU_COMPILE_CACHE", "on")
     try:
         out = subprocess.run(
             cmd,

@@ -532,7 +532,7 @@ def _bcp_gather(pt: ProblemTensors, assign: jax.Array,
 
 def bcp(pt: ProblemTensors, assign: jax.Array,
         min_mask: jax.Array, min_w: jax.Array,
-        enabled: jax.Array = jnp.bool_(True)) -> Tuple[jax.Array, jax.Array]:
+        enabled: "jax.Array | bool" = True) -> Tuple[jax.Array, jax.Array]:
     """Propagate to fixpoint (the analog of gini ``Test`` propagation;
     host reference: HostEngine._bcp).  Returns (conflict, assignment).
     Dispatches to the implementation chosen by :func:`set_bcp_impl` /
@@ -663,7 +663,7 @@ def test_outcome(conflict: jax.Array, t: jax.Array, f: jax.Array,
 def dpll(pt: ProblemTensors, t_init: jax.Array, f_init: jax.Array,
          min_bits: jax.Array, min_w: jax.Array, budget: jax.Array,
          steps: jax.Array, NV: int, V: int,
-         enabled: jax.Array = jnp.bool_(True), red: bool = False
+         enabled: "jax.Array | bool" = True, red: bool = False
          ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Complete search under the fixed partial assignment given as packed
     ``(t_init, f_init)`` planes — the analog of gini ``Solve()``
@@ -784,7 +784,7 @@ def dpll(pt: ProblemTensors, t_init: jax.Array, f_init: jax.Array,
 def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
            outcome0: jax.Array, budget: jax.Array, steps: jax.Array,
            V: int, NCON: int, NV: int, T: int = 0,
-           enabled: jax.Array = jnp.bool_(True), red: bool = False
+           enabled: "jax.Array | bool" = True, red: bool = False
            ) -> Tuple[jax.Array, ...]:
     """The reference guess search (search.go:158-203; host: _search).
 
@@ -1024,7 +1024,7 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
 
 
 def search_phase(pt: ProblemTensors, budget: jax.Array,
-                 en: jax.Array = jnp.bool_(True),
+                 en: "jax.Array | bool" = True,
                  *, V: int, NCON: int, NV: int, T: int = 0, red: bool = False
                  ) -> Tuple[jax.Array, ...]:
     """Phase 1: baseline Test + preference-ordered guess search
@@ -1074,7 +1074,7 @@ def search_phase(pt: ProblemTensors, budget: jax.Array,
 
 def minimize_phase(pt: ProblemTensors, model: jax.Array, guessed: jax.Array,
                    budget: jax.Array, steps: jax.Array,
-                   en: jax.Array = jnp.bool_(True),
+                   en: "jax.Array | bool" = True,
                    *, V: int, NCON: int, NV: int, red: bool = False
                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Phase 2 (SAT lanes): extras-only cardinality minimization
@@ -1172,7 +1172,7 @@ CORE_CHUNK = 8
 
 
 def core_phase(pt: ProblemTensors, budget: jax.Array, steps: jax.Array,
-               en: jax.Array = jnp.bool_(True),
+               en: "jax.Array | bool" = True,
                *, V: int, NCON: int, NV: int
                ) -> Tuple[jax.Array, jax.Array]:
     """Phase 3 (UNSAT lanes): deletion-based unsat-core minimization.

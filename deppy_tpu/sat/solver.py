@@ -101,16 +101,68 @@ def resolve_backend(backend: str, *, batch: bool = True) -> str:
     raise InternalSolverError([f"unknown backend {backend!r}"])
 
 
+_ENGINE_USABLE: Optional[bool] = None
+# A healthy TPU PJRT init takes ~8s on this machine; a crashed worker can
+# hang init for minutes-to-hours (BASELINE.md round-3 notes), so the probe
+# must be killable.
+_PROBE_TIMEOUT_S = 45
+
+
 def _engine_usable() -> bool:
-    """True when the tensor engine and a JAX backend are both importable.
-    ``auto`` degrades to the host engine rather than failing, so the library
-    stays usable on machines without a working accelerator runtime."""
+    """True when the tensor engine and a JAX backend are both usable.
+    ``auto`` degrades to the host engine rather than failing, so the
+    library stays usable on machines without a working accelerator
+    runtime.
+
+    When the platform is not pinned to CPU, the backend query runs in a
+    killable SUBPROCESS with a timeout: a crashed TPU worker hangs PJRT
+    init indefinitely, and an in-process ``jax.devices()`` would hang
+    every ``auto`` caller with it (the long-running service's failure
+    mode during a worker outage).  The verdict is cached for the process
+    lifetime — ``auto`` is a routing policy, not a health monitor."""
+    global _ENGINE_USABLE
+    if _ENGINE_USABLE is not None:
+        return _ENGINE_USABLE
     try:
-        import jax
-
-        jax.devices()
         from ..engine import driver  # noqa: F401
-
-        return True
     except Exception:
+        _ENGINE_USABLE = False
         return False
+    import os
+
+    if (os.environ.get("JAX_PLATFORMS") or "").strip() == "cpu":
+        # Forced-CPU never touches the accelerator plugin: safe in-process.
+        try:
+            import jax
+
+            jax.devices()
+            _ENGINE_USABLE = True
+        except Exception:
+            _ENGINE_USABLE = False
+        return _ENGINE_USABLE
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_root, env.get("PYTHONPATH")) if p
+    )
+    try:
+        # DEVNULL, not capture: with captured pipes a TimeoutExpired kills
+        # only the direct child and then blocks on pipe EOF — a wedged
+        # runtime helper process holding the pipe would re-hang the
+        # parent, the exact failure this probe exists to bound.
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); import deppy_tpu.engine.driver"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            timeout=_PROBE_TIMEOUT_S,
+            env=env,
+        )
+        _ENGINE_USABLE = probe.returncode == 0
+    except Exception:  # TimeoutExpired (hung init) or spawn failure
+        _ENGINE_USABLE = False
+    return _ENGINE_USABLE

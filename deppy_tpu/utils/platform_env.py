@@ -36,6 +36,14 @@ def apply_platform_env() -> None:
     enable_compile_cache()
 
 
+def default_cache_dir() -> str:
+    """The persistent compilation cache's default location — single
+    source for :func:`enable_compile_cache` and opt-in callers (e.g.
+    ``bench.py``'s accelerator subprocess)."""
+    return os.path.join(os.path.expanduser("~"), ".cache", "deppy_tpu",
+                        "xla")
+
+
 def enable_compile_cache() -> None:
     """Point XLA's persistent compilation cache at a stable directory.
 
@@ -58,15 +66,17 @@ def enable_compile_cache() -> None:
     subprocess in explicitly (the platform env is unset there so the
     PJRT plugin resolves)."""
     path = os.environ.get("DEPPY_TPU_COMPILE_CACHE")
-    if path is not None and path.strip().lower() in ("off", "0", ""):
-        return
+    if path is not None:
+        token = path.strip().lower()
+        if token in ("off", "0", ""):
+            return
+        if token in ("on", "1", "true"):
+            path = default_cache_dir()
     if path is None:
         platforms = (os.environ.get("JAX_PLATFORMS") or "").strip()
         if not platforms or platforms == "cpu":
             return
-        path = os.path.join(
-            os.path.expanduser("~"), ".cache", "deppy_tpu", "xla"
-        )
+        path = default_cache_dir()
     try:
         import jax
 

@@ -79,3 +79,13 @@ def test_force_cpu_env_replaces_device_count(monkeypatch):
     assert "--xla_force_host_platform_device_count=2" in env["XLA_FLAGS"]
     assert "--foo" in env["XLA_FLAGS"]
     assert "=8" not in env["XLA_FLAGS"]
+
+
+def test_on_token_maps_to_default_dir(monkeypatch, tmp_path,
+                                      reset_cache_config):
+    monkeypatch.setenv("HOME", str(tmp_path))
+    monkeypatch.setenv("DEPPY_TPU_COMPILE_CACHE", "on")
+    jax.config.update("jax_compilation_cache_dir", None)
+    platform_env.enable_compile_cache()
+    assert jax.config.jax_compilation_cache_dir == platform_env.default_cache_dir()
+    assert jax.config.jax_compilation_cache_dir.startswith(str(tmp_path))

@@ -168,3 +168,39 @@ def test_pinned_tenant_catalog_unsat_core_shape():
     assert "is mandatory" in msg and "conflicts with" in msg
     # Small human-readable core, not the whole catalog.
     assert msg.count(",") <= 6
+
+
+def test_auto_probe_survives_hung_accelerator(monkeypatch):
+    """A crashed TPU worker hangs PJRT init; the 'auto' usability probe
+    must time out in its subprocess and fall back to host instead of
+    hanging the caller (the service's failure mode during an outage)."""
+    import subprocess
+
+    from deppy_tpu.sat import solver as solver_mod
+
+    monkeypatch.setattr(solver_mod, "_ENGINE_USABLE", None)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    def hung(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(subprocess, "run", hung)
+    assert solver_mod.resolve_backend("auto") == "host"
+    # Verdict is cached: later calls never re-probe (run stays patched).
+    assert solver_mod.resolve_backend("auto") == "host"
+
+
+def test_auto_probe_forced_cpu_stays_in_process(monkeypatch):
+    """Forced-CPU never spawns a probe subprocess (tests, bench fallback)."""
+    import subprocess
+
+    from deppy_tpu.sat import solver as solver_mod
+
+    monkeypatch.setattr(solver_mod, "_ENGINE_USABLE", None)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    def boom(*a, **k):
+        raise AssertionError("subprocess probe must not run under forced CPU")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    assert solver_mod.resolve_backend("auto") == "tpu"
