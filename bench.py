@@ -52,22 +52,22 @@ def _log(msg: str) -> None:
 
 def _probe_once() -> str | None:
     """One probe attempt in a subprocess (a hang cannot propagate)."""
+    from deppy_tpu.utils.platform_env import run_captured
+
     try:
-        out = subprocess.run(
+        rc, stdout, stderr = run_captured(
             [sys.executable, "-c", _PROBE_SRC],
-            capture_output=True,
-            text=True,
-            timeout=PROBE_TIMEOUT_S,
+            timeout_s=PROBE_TIMEOUT_S,
             cwd=REPO,
         )
     except subprocess.TimeoutExpired:
         _log(f"backend probe timed out after {PROBE_TIMEOUT_S}s")
         return None
-    if out.returncode != 0:
-        tail = (out.stderr or "").strip().splitlines()[-1:]
-        _log(f"backend probe failed rc={out.returncode}: {tail}")
+    if rc != 0:
+        tail = (stderr or "").strip().splitlines()[-1:]
+        _log(f"backend probe failed rc={rc}: {tail}")
         return None
-    backend = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    backend = stdout.strip().splitlines()[-1] if stdout.strip() else ""
     _log(f"backend probe ok: {backend}")
     return backend or None
 
@@ -115,23 +115,27 @@ def _run_workload(platform: str | None, timeout_s: int) -> dict | None:
         # conservative default would leave it off).  "on" resolves to
         # platform_env.default_cache_dir inside the subprocess.
         env.setdefault("DEPPY_TPU_COMPILE_CACHE", "on")
+    from deppy_tpu.utils.platform_env import run_captured
+
     try:
-        out = subprocess.run(
-            cmd,
-            stdout=subprocess.PIPE,
-            stderr=sys.stderr,
-            text=True,
-            timeout=timeout_s,
-            cwd=REPO,
-            env=env,
+        # run_captured kills the whole process group on timeout, so a
+        # wedged runtime helper can't re-hang the driver past it; the
+        # workload's stderr is relayed after the fact instead of streamed.
+        rc, stdout, stderr = run_captured(
+            cmd, timeout_s=timeout_s, cwd=REPO, env=env,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr or "").strip().splitlines()[-20:]
+        if tail:
+            print("\n".join(tail), file=sys.stderr, flush=True)
         _log(f"workload timed out after {timeout_s}s (platform={platform})")
         return None
-    if out.returncode != 0:
-        _log(f"workload failed rc={out.returncode} (platform={platform})")
+    if stderr:
+        print(stderr, file=sys.stderr, end="", flush=True)
+    if rc != 0:
+        _log(f"workload failed rc={rc} (platform={platform})")
         return None
-    for line in reversed((out.stdout or "").strip().splitlines()):
+    for line in reversed((stdout or "").strip().splitlines()):
         try:
             rec = json.loads(line)
         except (json.JSONDecodeError, ValueError):

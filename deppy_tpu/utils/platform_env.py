@@ -36,6 +36,51 @@ def apply_platform_env() -> None:
     enable_compile_cache()
 
 
+def run_captured(cmd, timeout_s, env=None, cwd=None):
+    """``subprocess.run(capture_output=True, timeout=...)`` that cannot
+    re-hang after the timeout.
+
+    Plain ``subprocess.run`` with captured pipes handles TimeoutExpired by
+    killing only the direct child and then blocking until pipe EOF — a
+    wedged runtime helper process (e.g. a libtpu child stuck on a crashed
+    worker) that inherited the pipes keeps them open and re-hangs the
+    parent indefinitely.  This variant starts the child in its own
+    session and kills the whole process group on timeout, so EOF is
+    guaranteed.  Returns ``(returncode, stdout, stderr)`` or raises
+    ``subprocess.TimeoutExpired``."""
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=cwd,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()  # at least the direct child dies
+        try:
+            # Group normally dead -> EOF immediate; the bound covers an
+            # unsignalable group member still holding the pipes.
+            out, err = proc.communicate(timeout=5)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+        # Mirror subprocess.run: the partial output rides the exception
+        # so callers can log what the child was doing when it hung.
+        raise subprocess.TimeoutExpired(
+            cmd, timeout_s, output=out, stderr=err
+        ) from None
+    return proc.returncode, out, err
+
+
 def default_cache_dir() -> str:
     """The persistent compilation cache's default location — single
     source for :func:`enable_compile_cache` and opt-in callers (e.g.

@@ -4,7 +4,9 @@ Rounds 1-2 of this build lost every TPU measurement to an "init hang" no
 one could explain.  Round 3 root-caused it (see BASELINE.md TPU notes):
 
   * programs with too many vmap lanes reproducibly crash the tunneled
-    worker (the engine now chunks dispatches, driver.MAX_LANES);
+    worker (the engine now chunks dispatches, driver.MAX_LANES), and so
+    do minutes-long single program executions (the engine now host-routes
+    giant-problem core extraction, driver.HOST_CORE_NCONS);
   * a crashed worker then makes PJRT init HANG for minutes while it
     restarts — so "init hangs" is usually "worker is restarting", and the
     right response is a bounded wait + retry, not a fast fallback;
@@ -42,26 +44,30 @@ PROBE_SRC = (
 
 
 def _probe(timeout_s: int) -> dict:
-    """One subprocess probe.  Returns {status, backend?, init_s?, detail}."""
+    """One subprocess probe.  Returns {status, backend?, init_s?, detail}.
+
+    Uses :func:`platform_env.run_captured` so a wedged runtime helper
+    holding the pipes cannot re-hang the doctor past its own timeout."""
+    from .platform_env import run_captured
+
     t0 = time.time()
     try:
-        out = subprocess.run(
-            [sys.executable, "-c", PROBE_SRC],
-            capture_output=True, text=True, timeout=timeout_s,
+        rc, stdout, stderr = run_captured(
+            [sys.executable, "-c", PROBE_SRC], timeout_s=timeout_s,
         )
     except subprocess.TimeoutExpired:
         return {"status": "hang", "detail": f"init exceeded {timeout_s}s"}
     wall = time.time() - t0
-    if out.returncode != 0:
-        tail = (out.stderr or "").strip().splitlines()[-3:]
+    if rc != 0:
+        tail = (stderr or "").strip().splitlines()[-3:]
         return {"status": "error", "detail": " | ".join(tail)}
-    parts = (out.stdout or "").strip().split()
+    parts = (stdout or "").strip().split()
     backend = parts[0] if parts else "?"
     return {
         "status": "ok" if backend not in ("cpu", "?") else "cpu-only",
         "backend": backend,
         "init_s": round(wall, 1),
-        "detail": out.stdout.strip(),
+        "detail": stdout.strip(),
     }
 
 
