@@ -102,9 +102,15 @@ def resolve_backend(backend: str, *, batch: bool = True) -> str:
 
 
 _ENGINE_USABLE: Optional[bool] = None
+# Serializes the probe: concurrent auto callers (e.g. requests hitting a
+# service while its startup pre-warm is still probing) share one probe
+# subprocess and its verdict instead of each spawning their own.
+_ENGINE_USABLE_LOCK = __import__("threading").Lock()
 # A healthy TPU PJRT init takes ~8s on this machine; a crashed worker can
 # hang init for minutes-to-hours (BASELINE.md round-3 notes), so the probe
-# must be killable.
+# must be killable.  The probe child is bounded by this timeout even if
+# the parent exits mid-probe (worst case: one ≤45s orphan with DEVNULL
+# pipes holding nothing but the runtime handle).
 _PROBE_TIMEOUT_S = 45
 
 
@@ -122,6 +128,14 @@ def _engine_usable() -> bool:
     lifetime — ``auto`` is a routing policy, not a health monitor."""
     global _ENGINE_USABLE
     if _ENGINE_USABLE is not None:
+        return _ENGINE_USABLE
+    with _ENGINE_USABLE_LOCK:
+        return _engine_usable_locked()
+
+
+def _engine_usable_locked() -> bool:
+    global _ENGINE_USABLE
+    if _ENGINE_USABLE is not None:  # a concurrent caller probed first
         return _ENGINE_USABLE
     try:
         from ..engine import driver  # noqa: F401

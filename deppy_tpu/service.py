@@ -190,6 +190,23 @@ class Server:
             t = threading.Thread(target=srv.serve_forever, daemon=True)
             t.start()
             self._threads.append(t)
+        if self.backend == "auto":
+            # Pre-warm the auto-backend usability verdict: against a
+            # crashed TPU worker the probe takes its full timeout (45s)
+            # before falling back to host.  The verdict is process-cached
+            # and probing is serialized (solver._ENGINE_USABLE_LOCK), so a
+            # request landing mid-probe waits on the SHARED probe — worst
+            # case the remaining probe window, never a duplicate one —
+            # and every request after the verdict routes instantly.
+            def _prewarm():
+                from .sat.solver import resolve_backend
+
+                try:
+                    resolve_backend("auto")
+                except Exception:
+                    pass  # request-path resolution will surface errors
+
+            threading.Thread(target=_prewarm, daemon=True).start()
         self.ready.set()
 
     def shutdown(self) -> None:

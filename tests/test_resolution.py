@@ -204,3 +204,42 @@ def test_auto_probe_forced_cpu_stays_in_process(monkeypatch):
 
     monkeypatch.setattr(subprocess, "run", boom)
     assert solver_mod.resolve_backend("auto") == "tpu"
+
+
+def test_auto_probe_is_shared_across_concurrent_callers(monkeypatch):
+    """Concurrent 'auto' callers during a slow probe (e.g. requests hitting
+    a service while its startup pre-warm is probing) must share ONE probe
+    subprocess, not spawn one each."""
+    import subprocess
+    import threading
+    import time
+
+    from deppy_tpu.sat import solver as solver_mod
+
+    monkeypatch.setattr(solver_mod, "_ENGINE_USABLE", None)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    calls = []
+
+    def slow_probe(*a, **k):
+        calls.append(1)
+        time.sleep(0.5)
+
+        class R:
+            returncode = 1
+
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", slow_probe)
+    results = []
+    threads = [
+        threading.Thread(
+            target=lambda: results.append(solver_mod.resolve_backend("auto"))
+        )
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) == 1
+    assert results == ["host"] * 4
