@@ -100,6 +100,15 @@ def _build_parser() -> argparse.ArgumentParser:
         "controller_manager_config.yaml:1-11); explicitly passed flags "
         "override file values",
     )
+    p_doctor = sub.add_parser(
+        "doctor",
+        help="diagnose the accelerator backend (probe in a killable "
+        "subprocess, classify healthy / worker-restarting / plugin "
+        "failure / no accelerator; exits 0 only on healthy)",
+    )
+    from .utils.tpu_doctor import add_doctor_args
+
+    add_doctor_args(p_doctor)
     return parser
 
 
@@ -252,6 +261,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_bench(args)
     if args.command == "serve":
         return _cmd_serve(args)
+    if args.command == "doctor":
+        from .utils.tpu_doctor import diagnose
+
+        return diagnose(args.probe_timeout, args.retries, args.retry_delay)
     parser.error(f"unknown command {args.command!r}")
     return 2
 

@@ -22,6 +22,7 @@ Usage::
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Sequence
 
 from .constraints import Variable
@@ -105,13 +106,23 @@ _ENGINE_USABLE: Optional[bool] = None
 # Serializes the probe: concurrent auto callers (e.g. requests hitting a
 # service while its startup pre-warm is still probing) share one probe
 # subprocess and its verdict instead of each spawning their own.
-_ENGINE_USABLE_LOCK = __import__("threading").Lock()
+_ENGINE_USABLE_LOCK = threading.Lock()
 # A healthy TPU PJRT init takes ~8s on this machine; a crashed worker can
 # hang init for minutes-to-hours (BASELINE.md round-3 notes), so the probe
-# must be killable.  The probe child is bounded by this timeout even if
-# the parent exits mid-probe (worst case: one ≤45s orphan with DEVNULL
-# pipes holding nothing but the runtime handle).
+# must be killable.
 _PROBE_TIMEOUT_S = 45
+# The child also self-destructs shortly after the parent's timeout, so an
+# orphan (parent died mid-probe — e.g. a service restart while the
+# pre-warm thread was probing) cannot hang in PJRT init for hours holding
+# the runtime handle.
+_PROBE_SELF_DESTRUCT_S = _PROBE_TIMEOUT_S + 5
+_PROBE_SRC = (
+    "import threading, os; "
+    f"t = threading.Timer({_PROBE_SELF_DESTRUCT_S}, os._exit, (9,)); "
+    "t.daemon = True; t.start(); "
+    "import jax; jax.devices(); import deppy_tpu.engine.driver; "
+    "os._exit(0)"
+)
 
 
 def _engine_usable() -> bool:
@@ -169,8 +180,7 @@ def _engine_usable_locked() -> bool:
         # runtime helper process holding the pipe would re-hang the
         # parent, the exact failure this probe exists to bound.
         probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); import deppy_tpu.engine.driver"],
+            [sys.executable, "-c", _PROBE_SRC],
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
             timeout=_PROBE_TIMEOUT_S,

@@ -88,7 +88,12 @@ def _chip_holders() -> list:
             if pid_s.isdigit() and int(pid_s) != me and (
                 "jax" in cmd or "deppy" in cmd or "bench" in cmd
             ):
-                holders.append(line.strip())
+                # Truncate: agent/driver wrappers can carry multi-KB
+                # command lines, and the report only needs the gist.
+                cmd = cmd.strip()
+                if len(cmd) > 160:
+                    cmd = cmd[:160] + " ...[truncated]"
+                holders.append(f"{pid_s} {cmd}")
     except (OSError, subprocess.TimeoutExpired):
         pass
     return holders
@@ -138,11 +143,24 @@ def diagnose(probe_timeout: int = 120, retries: int = 3,
     return 1
 
 
+def add_doctor_args(ap: argparse.ArgumentParser) -> None:
+    """The doctor's flags, shared by this module's CLI and ``deppy
+    doctor`` (cli.py) so defaults live in exactly one place — the
+    :func:`diagnose` signature."""
+    import inspect
+
+    d = {
+        k: p.default
+        for k, p in inspect.signature(diagnose).parameters.items()
+    }
+    ap.add_argument("--probe-timeout", type=int, default=d["probe_timeout"])
+    ap.add_argument("--retries", type=int, default=d["retries"])
+    ap.add_argument("--retry-delay", type=int, default=d["retry_delay"])
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--probe-timeout", type=int, default=120)
-    ap.add_argument("--retries", type=int, default=3)
-    ap.add_argument("--retry-delay", type=int, default=90)
+    add_doctor_args(ap)
     args = ap.parse_args()
     sys.exit(diagnose(args.probe_timeout, args.retries, args.retry_delay))
 

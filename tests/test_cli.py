@@ -267,3 +267,21 @@ class TestServeConfig:
         rc = main(["serve", "--config", "/nonexistent/cfg.yaml"])
         assert rc == 2
         assert "no such file" in capsys.readouterr().err
+
+
+def test_doctor_subcommand_wiring(monkeypatch, capsys):
+    """`deppy doctor` routes to tpu_doctor.diagnose with the shared flag
+    defaults; the probe itself is stubbed (no jax subprocess) so this
+    stays fast and jax-independent."""
+    from deppy_tpu import cli
+    from deppy_tpu.utils import tpu_doctor
+
+    monkeypatch.setattr(
+        tpu_doctor, "_probe",
+        lambda timeout_s: {"status": "cpu-only", "backend": "cpu",
+                           "init_s": 0.0, "detail": "cpu 1 0.0"},
+    )
+    rc = cli.main(["doctor", "--retries", "1"])
+    out = capsys.readouterr()
+    assert rc != 0  # 0 is reserved for a healthy accelerator
+    assert "NO ACCELERATOR" in out.out + out.err
