@@ -1334,6 +1334,71 @@ def batched_core(V: int, NCON: int, NV: int):
     return jax.jit(jax.vmap(fn, in_axes=(0, None, 0, 0)))
 
 
+# --------------------------------------------------------------------------
+# speculative deletion probes (driver._speculative_core_mask)
+#
+# One GIANT problem's deletion sweep turned inside out: instead of one lane
+# probing its n_cons activation subsets sequentially (core_phase), ALL
+# single-drop probes of one shared problem run as vmap lanes of one
+# program — the problem planes broadcast (in_axes=None), only the [NCON]
+# activation masks are per-lane.  Stage 1 settles most probes with a
+# search-free propagation fixpoint; stage 2 finishes the stragglers with
+# full DPLL lanes.
+
+
+def probe_fixpoint_phase(pt: ProblemTensors, drop_j: jax.Array,
+                         *, V: int, NCON: int) -> jax.Array:
+    """Stage-1 probe: propagate the single-drop probe's base assignment
+    (all applied constraints active except ``drop_j``, anchors NOT
+    assumed — host unsat_core_mask's probe convention) to fixpoint.
+    Returns the conflict flag: True proves the probe UNSAT outright; False
+    means undetermined (finish with :func:`probe_phase`).  Uses the
+    full-space planes (activations are live variables here, exactly like
+    core_phase's probes).  Lanes carry only an int32 index — the driver
+    ships [P] indices, not [P, NCON] masks."""
+    Wv = pt.pos_bits.shape[1]
+    idx = jnp.arange(NCON, dtype=jnp.int32)
+    act_enabled = (idx < pt.n_cons) & (idx != drop_j)
+    init = _base_assignment(pt, V, NCON, act_enabled=act_enabled)
+    no_min = jnp.zeros((1, Wv), jnp.int32)
+    conflict, _, _ = planes_fixpoint(
+        pt, pack_mask(init == TRUE, Wv), pack_mask(init == FALSE, Wv),
+        no_min, jnp.int32(0), jnp.bool_(True), V,
+    )
+    return conflict
+
+
+@functools.lru_cache(maxsize=128)
+def batched_probe_fixpoint(V: int, NCON: int):
+    """Jitted stage-1 probe batch: problem broadcast, drop indices
+    vmapped."""
+    fn = functools.partial(probe_fixpoint_phase, V=V, NCON=NCON)
+    return jax.jit(jax.vmap(fn, in_axes=(None, 0)))
+
+
+def probe_phase(pt: ProblemTensors, act_enabled: jax.Array,
+                budget: jax.Array, *, V: int, NCON: int, NV: int
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Stage-2 probe: complete DPLL under the activation subset — the
+    exact probe core_phase runs per trial, one vmap lane per subset.
+    Returns (status, steps)."""
+    Wv = pt.pos_bits.shape[1]
+    init = _base_assignment(pt, V, NCON, act_enabled=act_enabled)
+    no_min = jnp.zeros((1, Wv), jnp.int32)
+    status, _, _, steps = dpll(
+        pt, pack_mask(init == TRUE, Wv), pack_mask(init == FALSE, Wv),
+        no_min, jnp.int32(0), budget, jnp.int32(0), NV, V,
+    )
+    return status, steps
+
+
+@functools.lru_cache(maxsize=128)
+def batched_probe(V: int, NCON: int, NV: int):
+    """Jitted stage-2 probe batch: problem broadcast, act masks vmapped."""
+    fn = functools.partial(probe_phase, V=V, NCON=NCON, NV=NV)
+    return jax.jit(jax.vmap(fn, in_axes=(None, 0, None)))
+
+
 def _minimize_gated(pt, result, model, guessed, budget, steps, en_lanes,
                     *, V, NCON, NV, red):
     return minimize_phase(

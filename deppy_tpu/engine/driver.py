@@ -437,7 +437,122 @@ def _pad_rows(a: np.ndarray, B: int, fill=0) -> np.ndarray:
 HOST_CORE_NCONS = int(os.environ.get("DEPPY_TPU_HOST_CORE_NCONS", "768"))
 
 
-def _host_core_rows(problems, idx, d: _Dims, budget, spent) -> tuple:
+# Lane width of one speculative-probe dispatch (stage 1 below).  Bounded
+# like MAX_LANES: oversized programs are what crash the tunneled worker.
+PROBE_LANES = int(os.environ.get("DEPPY_TPU_PROBE_LANES", "512"))
+
+# Speculative-core policy: "auto" enables it on accelerator backends only.
+# Measured on CPU XLA it LOSES to the host spec sweep (27.6s vs 2.1s on
+# the 1.7k-constraint giant catalog): the vmapped probe fixpoint runs
+# max-over-lanes propagation rounds, and one deep-chain lane drags 512
+# lanes × full clause planes through ~dozens of rounds on one core.  The
+# accelerator bet is bandwidth: the same traffic is a few hundred MB of
+# HBM reads.  "1"/"0" force it on/off (tests force "1" on CPU).
+SPEC_CORE = os.environ.get("DEPPY_TPU_SPEC_CORE", "auto")
+
+
+def _spec_core_enabled() -> bool:
+    if SPEC_CORE == "1":
+        return True
+    if SPEC_CORE == "0":
+        return False
+    return jax.default_backend() != "cpu"
+
+
+def _speculative_core_mask(problem, remaining: int):
+    """Deletion-sweep shortcut for ONE giant problem: run all n_cons
+    single-drop probes as vmap lanes of a batched device program instead
+    of n_cons sequential host solves, then certify the result with one
+    probe.  Returns (core_mask[n_cons] or None, steps_spent) — on None
+    the caller falls back to the host spec sweep (with the leftover
+    budget), so correctness never depends on this path succeeding.
+
+    Exactness (trust-but-verify): let K = {j : SAT without j} over the
+    INITIAL full active set.  SAT(all\\{j}) implies SAT of every subset,
+    so the spec's in-order sweep keeps each j in K at its turn, whatever
+    was dropped before — K is a subset of the spec's final core.  If the
+    verification probe shows K itself UNSAT, then at every j outside K
+    the spec's remaining active set contains K, hence stays UNSAT without
+    j, hence the spec drops j — its final core is exactly K.  If K probes
+    SAT (overlapping/disjoint cores: order decides), this shortcut proves
+    nothing and returns None.  Probes here and in the spec agree
+    literally: same base assignment, anchors not assumed
+    (core.probe_phase is core_phase's own trial probe).
+
+    Steps: 1 per stage-1 fixpoint probe (the host's near-free probes also
+    count ~1) plus the DPLL steps of stage-2 and verification lanes."""
+    n = int(problem.n_cons)
+    if n == 0 or remaining <= 0:
+        return None, 0
+    d = _Dims([problem], 1)
+    pts1 = _put_compact(pad_stack([problem], d, 1, pack=False))
+    pts1 = _derive_planes(pts1, d, full=True, red=False)
+    pt = jax.tree_util.tree_map(lambda a: a[0], pts1)
+    steps = 0
+
+    # Stage 1: one propagation fixpoint per single-drop probe; a conflict
+    # proves that probe UNSAT with zero search (the common case on an
+    # overconstrained catalog).
+    fp = core.batched_probe_fixpoint(d.V, d.NCON)
+    P = min(PROBE_LANES, _bucket(n))
+    conflicts = []
+    for lo in range(0, n, P):
+        drop = np.arange(lo, lo + P, dtype=np.int32)  # tail lanes: j >= n
+        conflicts.append(fp(pt, drop))
+    conflict = np.concatenate(jax.device_get(conflicts))[:n]
+    steps += n
+
+    # Stage 2: finish undetermined probes (core members' SAT probes plus
+    # any UNSAT that needs actual search) with full DPLL lanes.
+    pend = np.nonzero(~conflict)[0]
+    status = np.full(n, core.UNSAT, np.int32)
+    if pend.size:
+        if pend.size > max(n // 2, PROBE_LANES):
+            return None, steps  # propagation settled little: wrong case
+        pb = core.batched_probe(d.V, d.NCON, d.NV)
+        Q = min(_bucket(min(pend.size, PROBE_LANES)), PROBE_LANES)
+        idx32 = np.arange(d.NCON, dtype=np.int32)
+        for lo in range(0, pend.size, Q):
+            rows = pend[lo: lo + Q]
+            trials = (idx32[None, :] < n) & (idx32[None, :] != rows[:, None])
+            # Pad lanes probe the EMPTY active set (immediately SAT) — an
+            # all-active pad would re-prove the whole problem UNSAT under
+            # lockstep, stalling the real lanes.
+            trials = np.concatenate(
+                [trials, np.zeros((Q - len(rows), d.NCON), bool)])
+            st, sp = jax.device_get(
+                pb(pt, trials, np.int32(remaining)))
+            status[rows] = st[: len(rows)]
+            steps += int(sp[: len(rows)].sum())
+            if steps > remaining:
+                # Budget already blown: don't dispatch chunks whose
+                # results the post-loop check would discard anyway.
+                return None, steps
+        if (status[pend] == core.RUNNING).any():
+            # Budget pressure: let the spec sweep own the Incomplete call.
+            return None, steps
+    else:
+        Q = 1
+
+    keep = status == core.SAT
+    if not keep.any():
+        return None, steps  # every single drop stays UNSAT: order decides
+
+    # Verification: K UNSAT ⇒ the spec sweep's core is exactly K.  Padded
+    # to stage 2's lane width so the same compiled program is reused (pad
+    # lanes probe the empty set, like stage 2's).
+    pb = core.batched_probe(d.V, d.NCON, d.NV)
+    vt = np.zeros((Q, d.NCON), bool)
+    vt[0, :n] = keep
+    st, sp = jax.device_get(pb(pt, vt, np.int32(remaining)))
+    steps += int(sp[0])
+    if int(st[0]) != core.UNSAT or steps > remaining:
+        return None, steps
+    return keep, steps
+
+
+def _host_core_rows(problems, idx, d: _Dims, budget, spent,
+                    allow_device: bool = False) -> tuple:
     """Host-engine core extraction for the given batch rows.  Returns
     (cores [len(idx), NCON] bool, steps [len(idx)]) — steps to ADD to the
     lane's device count.  Each lane's engine gets only the budget left
@@ -452,7 +567,15 @@ def _host_core_rows(problems, idx, d: _Dims, budget, spent) -> tuple:
     three callers — _solve_monolith, _solve_split, and
     parallel.clause_shard.solve_sharded — each add the returned steps to
     the lane's device count and flip the lane to RUNNING when the total
-    exceeds the budget.  Change all three together."""
+    exceeds the budget.  Change all three together.
+
+    ``allow_device`` (only the monolith caller, single-device runs — the
+    split path keeps the host sweep that overlaps its in-flight device
+    dispatches) first tries :func:`_speculative_core_mask` — the whole
+    sweep as one batched device program plus a certifying probe,
+    bit-identical when it succeeds — and falls back to the host spec
+    sweep on any ambiguity, with the speculative attempt's steps charged
+    against the budget."""
     from ..sat.host import HostEngine
 
     cores = np.zeros((len(idx), d.NCON), bool)
@@ -462,10 +585,20 @@ def _host_core_rows(problems, idx, d: _Dims, budget, spent) -> tuple:
         if remaining <= 0:
             steps[r] = 1  # already over: one tick keeps the lane RUNNING
             continue
-        eng = HostEngine(problems[i], max_steps=remaining)
+        spec_steps = 0
+        if allow_device and _spec_core_enabled():
+            mask, spec_steps = _speculative_core_mask(problems[i], remaining)
+            if mask is not None:
+                cores[r, : problems[i].n_cons] = mask
+                steps[r] = spec_steps
+                continue
+            if spec_steps >= remaining:
+                steps[r] = remaining + 1
+                continue
+        eng = HostEngine(problems[i], max_steps=remaining - spec_steps)
         try:
             cores[r, : problems[i].n_cons] = eng.unsat_core_mask()
-            steps[r] = eng.steps
+            steps[r] = spec_steps + eng.steps
         except Incomplete:
             # Budget exhausted mid-sweep: mirror the device contract —
             # steps past the budget mark the lane Incomplete on decode.
@@ -505,7 +638,8 @@ def _solve_monolith(problems, budget, mesh, trace_cap) -> List[core.SolveResult]
         unsat_idx = np.nonzero(outcome[:n] == core.UNSAT)[0]
         if unsat_idx.size:
             hc, hs = _host_core_rows(problems, unsat_idx, d, budget,
-                                     steps[unsat_idx])
+                                     steps[unsat_idx],
+                                     allow_device=mesh is None)
             cores = cores.copy()
             cores[unsat_idx] = hc
             steps[unsat_idx] += hs
@@ -654,6 +788,12 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
         if host_idx.size:
             # Runs on the host CPU while the device chews on the phase-2/3
             # dispatches above — the final fetch below synchronizes both.
+            # allow_device stays False here: these rows overlap with the
+            # in-flight phase-2/3 dispatches (the comment below), and a
+            # speculative device attempt would queue behind them and
+            # block — serializing exactly what this path parallelizes.
+            # The monolith path, where the device is idle by core time,
+            # is where the speculative probes run.
             host_cores, host_steps = _host_core_rows(
                 problems, host_idx, d, budget, steps[host_idx]
             )

@@ -101,6 +101,46 @@ def test_host_routed_core_decodes_to_reference_error(monkeypatch):
     assert "a is mandatory" in str(ei.value)
 
 
+def test_speculative_core_matches_spec(instances, monkeypatch):
+    # The batched-probe shortcut (trust-but-verify) must be observably
+    # identical to the spec sweep on every instance — including the
+    # disjoint-cores one, where its verification probe fails and it falls
+    # back.  Forced on (it defaults off on CPU backends, where it loses).
+    # One problem per solve: only the monolith path (device idle by core
+    # time) attempts speculative probes; the split path deliberately
+    # keeps the overlapped host sweep.
+    monkeypatch.setattr(driver, "HOST_CORE_NCONS", 0)
+    for p in instances:
+        monkeypatch.setattr(driver, "SPEC_CORE", "1")
+        (a,) = driver.solve_problems([p])
+        monkeypatch.setattr(driver, "SPEC_CORE", "0")
+        (b,) = driver.solve_problems([p])
+        assert int(a.outcome) == int(b.outcome) == core.UNSAT
+        np.testing.assert_array_equal(a.core, b.core)
+
+
+def test_speculative_core_falls_back_on_order_dependence(monkeypatch):
+    # Two disjoint cores: K (constraints critical against the FULL set) is
+    # empty, so the shortcut must return None rather than guess.
+    p = encode([
+        sat.variable("a", sat.mandatory(), sat.prohibited()),
+        sat.variable("b", sat.mandatory(), sat.conflict("c")),
+        sat.variable("c", sat.mandatory()),
+        sat.variable("d", sat.dependency("c")),
+    ])
+    mask, steps = driver._speculative_core_mask(p, 1 << 24)
+    assert mask is None
+    assert steps > 0
+
+
+def test_speculative_core_exhausted_budget(monkeypatch):
+    p = encode([
+        sat.variable("a", sat.mandatory(), sat.prohibited()),
+        sat.variable("b"),
+    ])
+    assert driver._speculative_core_mask(p, 0) == (None, 0)
+
+
 def test_gvk_conflict_core_parity(monkeypatch):
     # A conflict-heavy catalog (the UNSAT-prone workload family) with the
     # threshold at 0: every UNSAT lane host-routes; results must match the
