@@ -36,9 +36,6 @@ RUN_TIMEOUT_S = int(os.environ.get("DEPPY_BENCH_RUN_TIMEOUT", "1500"))
 PROBE_RETRIES = int(os.environ.get("DEPPY_BENCH_PROBE_RETRIES", "4"))
 PROBE_RETRY_DELAY_S = int(os.environ.get("DEPPY_BENCH_PROBE_RETRY_DELAY", "60"))
 
-_PROBE_SRC = "import jax; d = jax.devices(); print(jax.default_backend())"
-
-
 def _cpu_env() -> dict:
     """Environment forcing the single-device virtual-CPU platform."""
     from deppy_tpu.utils.platform_env import force_cpu_env
@@ -51,24 +48,32 @@ def _log(msg: str) -> None:
 
 
 def _probe_once() -> str | None:
-    """One probe attempt in a subprocess (a hang cannot propagate)."""
-    from deppy_tpu.utils.platform_env import run_captured
+    """One probe attempt in a subprocess (a hang cannot propagate).  The
+    probe COMPUTES, not just inits — an init-only probe once declared a
+    worker healthy that then hung the workload's first compile for its
+    entire timeout (see platform_env.probe_src)."""
+    from deppy_tpu.utils.platform_env import (
+        parse_probe_stages, probe_src, run_captured)
 
     try:
         rc, stdout, stderr = run_captured(
-            [sys.executable, "-c", _PROBE_SRC],
+            [sys.executable, "-c", probe_src(PROBE_TIMEOUT_S + 10)],
             timeout_s=PROBE_TIMEOUT_S,
             cwd=REPO,
         )
-    except subprocess.TimeoutExpired:
-        _log(f"backend probe timed out after {PROBE_TIMEOUT_S}s")
+    except subprocess.TimeoutExpired as e:
+        stage = "compute" if "INIT" in (e.output or "") else "init"
+        _log(f"backend probe timed out after {PROBE_TIMEOUT_S}s "
+             f"(hung in {stage})")
         return None
     if rc != 0:
         tail = (stderr or "").strip().splitlines()[-1:]
         _log(f"backend probe failed rc={rc}: {tail}")
         return None
-    backend = stdout.strip().splitlines()[-1] if stdout.strip() else ""
-    _log(f"backend probe ok: {backend}")
+    stages = parse_probe_stages(stdout)
+    backend = stages.get("backend", "")
+    _log(f"backend probe ok: {backend} (init {stages.get('init_s')}s, "
+         f"compute {stages.get('compute_s')}s)")
     return backend or None
 
 
@@ -115,6 +120,12 @@ def _run_workload(platform: str | None, timeout_s: int) -> dict | None:
         # conservative default would leave it off).  "on" resolves to
         # platform_env.default_cache_dir inside the subprocess.
         env.setdefault("DEPPY_TPU_COMPILE_CACHE", "on")
+    # Orphan guard (set AFTER the platform branch — _cpu_env rebuilds the
+    # dict): if THIS process is killed mid-run, the workload (own
+    # session) would outlive it wedged on the worker; headline.main arms
+    # a SIGALRM from this variable so it dies on its own shortly after
+    # the watchdog would have fired.
+    env.setdefault("DEPPY_BENCH_SELF_DESTRUCT", str(timeout_s + 60))
     from deppy_tpu.utils.platform_env import run_captured
 
     try:

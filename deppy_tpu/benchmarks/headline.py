@@ -51,8 +51,18 @@ def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
 
 def main() -> None:
     import argparse
+    import os
+    import signal
 
     from ..utils.platform_env import apply_platform_env
+
+    # Armed by bench.py: self-destruct shortly after the caller's
+    # watchdog, so an orphaned run (caller killed) cannot sit wedged on
+    # the accelerator worker for hours.  SIGALRM's default disposition
+    # kills the process even while blocked inside PJRT C code.
+    sd = os.environ.get("DEPPY_BENCH_SELF_DESTRUCT")
+    if sd and sd.isdigit() and int(sd) > 0:
+        signal.alarm(int(sd))
 
     apply_platform_env()
 

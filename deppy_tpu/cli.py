@@ -262,9 +262,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command == "serve":
         return _cmd_serve(args)
     if args.command == "doctor":
-        from .utils.tpu_doctor import diagnose
+        from .utils.tpu_doctor import run_from_args
 
-        return diagnose(args.probe_timeout, args.retries, args.retry_delay)
+        return run_from_args(args)
     parser.error(f"unknown command {args.command!r}")
     return 2
 

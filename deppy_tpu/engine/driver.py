@@ -30,7 +30,17 @@ import numpy as np
 from ..sat.constraints import Variable
 from ..sat.encode import Problem, encode
 from ..sat.errors import Incomplete, InternalSolverError, NotSatisfiable
+from ..utils.platform_env import assert_env_platform
 from . import core
+
+# Library-level platform guard: importing the tensor engine is the first
+# step of every device code path (Solver(backend="tpu"), BatchResolver,
+# clause sharding), and a ``JAX_PLATFORMS=cpu`` user process must never
+# initialize the accelerator plugin — discovery-time init of the axon
+# PJRT plugin hangs for hours when its worker is wedged (see
+# platform_env.assert_env_platform).  Process entry points also call
+# this via apply_platform_env(); this covers plain library imports.
+assert_env_platform()
 
 # Default step budget when the caller sets none: generous enough for any
 # realistic catalog problem, small enough that a pathological instance

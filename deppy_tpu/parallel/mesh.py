@@ -18,6 +18,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..utils.platform_env import assert_env_platform
+
+# ``default_mesh()``/``clause_mesh()`` are often a user process's first
+# backend query; make ``JAX_PLATFORMS=cpu`` limit plugin discovery before
+# it happens (a wedged accelerator plugin hangs init otherwise — see
+# platform_env.assert_env_platform).
+assert_env_platform()
+
 BATCH_AXIS = "batch"
 
 

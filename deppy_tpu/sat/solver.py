@@ -107,22 +107,29 @@ _ENGINE_USABLE: Optional[bool] = None
 # service while its startup pre-warm is still probing) share one probe
 # subprocess and its verdict instead of each spawning their own.
 _ENGINE_USABLE_LOCK = threading.Lock()
-# A healthy TPU PJRT init takes ~8s on this machine; a crashed worker can
-# hang init for minutes-to-hours (BASELINE.md round-3 notes), so the probe
+# A healthy TPU PJRT init takes ~8s on this machine and the tiny probe
+# compile a few more seconds over the tunnel; a crashed worker can hang
+# init for minutes-to-hours (BASELINE.md round-3 notes), so the probe
 # must be killable.
-_PROBE_TIMEOUT_S = 45
+_PROBE_TIMEOUT_S = 75
 # The child also self-destructs shortly after the parent's timeout, so an
 # orphan (parent died mid-probe — e.g. a service restart while the
 # pre-warm thread was probing) cannot hang in PJRT init for hours holding
 # the runtime handle.
 _PROBE_SELF_DESTRUCT_S = _PROBE_TIMEOUT_S + 5
-_PROBE_SRC = (
-    "import threading, os; "
-    f"t = threading.Timer({_PROBE_SELF_DESTRUCT_S}, os._exit, (9,)); "
-    "t.daemon = True; t.start(); "
-    "import jax; jax.devices(); import deppy_tpu.engine.driver; "
-    "os._exit(0)"
-)
+# The probe must COMPUTE, not just init: a wedged worker can answer
+# ``jax.devices()`` and then hang the first compile for 20+ minutes
+# (observed 2026-07-31), which would wedge every auto-routed solve
+# behind it.  platform_env.probe_src provides the shared init+compute
+# source (SIGALRM self-destruct, os._exit to skip hangable PJRT
+# teardown); the epilogue additionally proves the tensor engine imports.
+def _probe_cmd_src() -> str:
+    from ..utils.platform_env import probe_src
+
+    return probe_src(
+        _PROBE_SELF_DESTRUCT_S,
+        epilogue="; import deppy_tpu.engine.driver",
+    )
 
 
 def _engine_usable() -> bool:
@@ -180,7 +187,7 @@ def _engine_usable_locked() -> bool:
         # runtime helper process holding the pipe would re-hang the
         # parent, the exact failure this probe exists to bound.
         probe = subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC],
+            [sys.executable, "-c", _probe_cmd_src()],
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
             timeout=_PROBE_TIMEOUT_S,

@@ -15,24 +15,32 @@ import os
 from typing import Mapping
 
 
-def apply_platform_env() -> None:
+def assert_env_platform() -> None:
     """Make ``JAX_PLATFORMS`` from the environment actually stick.
 
-    The baked sitecustomize registers the axon TPU plugin at interpreter
-    start and pins the platform selection, so the env var alone is ignored
-    by the time user code runs; re-asserting it through ``jax.config``
-    before the first backend query restores the standard semantics.  Called
-    by every process entry point (CLI, service, benchmarks) so
-    ``JAX_PLATFORMS=cpu python -m deppy_tpu ...`` behaves as documented —
-    in particular it cannot hang on a crashed/restarting TPU worker.
-
-    Also enables the persistent compilation cache (see
-    :func:`enable_compile_cache`)."""
+    The env var alone only steers backend *selection*; jax still
+    *initializes* every registered PJRT plugin during discovery — and on
+    this machine the sitecustomize-registered axon TPU plugin's init
+    hangs whenever the tunneled worker is down (observed 2026-07-31: a
+    ``JAX_PLATFORMS=cpu`` process hung in ``jax.default_backend()``
+    while the worker was wedged).  Setting ``jax.config`` limits
+    discovery itself to the named platforms, so a forced-CPU process
+    never touches the plugin.  Must run before the first backend query;
+    harmlessly idempotent with tests/conftest.py's identical update."""
     platforms = os.environ.get("JAX_PLATFORMS")
     if platforms:
         import jax
 
         jax.config.update("jax_platforms", platforms)
+
+
+def apply_platform_env() -> None:
+    """Process-entry-point provisioning: :func:`assert_env_platform` plus
+    the persistent compilation cache (see :func:`enable_compile_cache`).
+    Called by every process entry point (CLI, service, benchmarks) so
+    ``JAX_PLATFORMS=cpu python -m deppy_tpu ...`` behaves as documented —
+    in particular it cannot hang on a crashed/restarting TPU worker."""
+    assert_env_platform()
     enable_compile_cache()
 
 
@@ -79,6 +87,76 @@ def run_captured(cmd, timeout_s, env=None, cwd=None):
             cmd, timeout_s, output=out, stderr=err
         ) from None
     return proc.returncode, out, err
+
+
+# One probe source for every backend-health check in the tree
+# (tpu_doctor, bench.py, sat/solver.py's auto-routing): PJRT init and a
+# tiny compile+execute+readback, each stage marked on stdout.  Init
+# alone is NOT health — a wedged worker can answer ``jax.devices()`` and
+# then hang the first compile for 20+ minutes (observed 2026-07-31).
+# JAX_PLATFORMS is re-asserted because this machine's sitecustomize
+# imports jax at interpreter startup and pins the plugin otherwise.
+_PROBE_SRC_TEMPLATE = (
+    "import signal; signal.alarm({alarm}); "
+    "import os, time, jax; "
+    "p = os.environ.get('JAX_PLATFORMS'); "
+    "p and jax.config.update('jax_platforms', p); "
+    "t0 = time.time(); d = jax.devices(); "
+    "print('INIT', jax.default_backend(), len(d), round(time.time()-t0, 1),"
+    " flush=True); "
+    "import jax.numpy as jnp; "
+    "t1 = time.time(); x = jnp.ones((8, 8), jnp.float32); "
+    "v = float((x @ x).sum()); "
+    "print('COMPUTE', v, round(time.time()-t1, 1), flush=True)"
+    "{epilogue}; os._exit(0)"
+)
+
+
+def probe_src(alarm_s: int, epilogue: str = "") -> str:
+    """Source for a disposable backend-health probe subprocess.
+
+    ``alarm_s`` arms a SIGALRM self-destruct (default disposition kills
+    the process even while blocked inside PJRT C code) so an ORPHANED
+    probe — its caller killed mid-probe; probes run in their own session
+    — cannot hang in init for hours holding the worker connection (an
+    orphan exactly like that was found alive after a timed-out bench run
+    on 2026-07-31).  ``epilogue`` is inserted verbatim after the COMPUTE
+    stage (e.g. ``"; import deppy_tpu.engine.driver"``); the probe then
+    always ``os._exit(0)``s so PJRT teardown — which can itself hang on
+    a sick worker — never runs inside the caller's timed window and a
+    healthy backend cannot be misread as a compute hang.
+
+    Stdout carries one line per completed stage (``INIT <backend>
+    <n_devices> <s>``, then ``COMPUTE <checksum> <s>``), so a caller
+    catching a timeout can tell which stage hung from the partial output
+    that rides :func:`run_captured`'s ``TimeoutExpired``.  Parse with
+    :func:`parse_probe_stages`."""
+    return _PROBE_SRC_TEMPLATE.format(alarm=alarm_s, epilogue=epilogue)
+
+
+def parse_probe_stages(stdout: str) -> dict:
+    """Parse :func:`probe_src` stage lines (full or partial output).
+
+    Returns a dict with any of ``backend``/``n_devices``/``init_s``
+    (from the INIT line) and ``compute_s`` (from the COMPUTE line) that
+    were present — the single parser for the single format, shared by
+    tpu_doctor and bench.py so the two cannot drift."""
+    out: dict = {}
+    for line in (stdout or "").splitlines():
+        parts = line.split()
+        if parts[:1] == ["INIT"] and len(parts) >= 4:
+            out["backend"] = parts[1]
+            try:
+                out["n_devices"] = int(parts[2])
+                out["init_s"] = float(parts[3])
+            except ValueError:
+                pass
+        elif parts[:1] == ["COMPUTE"] and len(parts) >= 3:
+            try:
+                out["compute_s"] = float(parts[2])
+            except ValueError:
+                pass
+    return out
 
 
 def default_cache_dir() -> str:

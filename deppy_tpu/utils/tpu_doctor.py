@@ -30,44 +30,55 @@ import subprocess
 import sys
 import time
 
-# The probe re-asserts JAX_PLATFORMS from the environment (the baked
-# sitecustomize pins the platform selection otherwise — see
-# utils/platform_env.py), so `JAX_PLATFORMS=cpu` correctly diagnoses
-# "no accelerator" instead of hanging on the pinned TPU plugin.
-PROBE_SRC = (
-    "import os, time, jax; "
-    "p = os.environ.get('JAX_PLATFORMS'); "
-    "p and jax.config.update('jax_platforms', p); "
-    "t0=time.time(); d=jax.devices(); "
-    "print(jax.default_backend(), len(d), round(time.time()-t0, 1))"
-)
+# The probe source lives in platform_env.probe_src (shared with bench.py
+# and sat/solver.py's auto-routing): SIGALRM self-destruct, PJRT init,
+# then a tiny compile+execute — init alone is NOT health, a wedged
+# worker can answer ``jax.devices()`` and then hang the first compile
+# for 20+ minutes (observed 2026-07-31; that probe-then-hang gap cost a
+# full benchmark timeout).  Stage markers on stdout (INIT / COMPUTE)
+# ride the TimeoutExpired so _probe can tell WHICH stage hung.
 
 
 def _probe(timeout_s: int) -> dict:
     """One subprocess probe.  Returns {status, backend?, init_s?, detail}.
+    status: ok / cpu-only / error / hang (PJRT init never answered) /
+    compute-hang (init answered, first compile+execute wedged — a sicker
+    worker than a restarting one: init hangs clear in minutes, observed
+    compute wedges have lasted hours).
 
     Uses :func:`platform_env.run_captured` so a wedged runtime helper
     holding the pipes cannot re-hang the doctor past its own timeout."""
-    from .platform_env import run_captured
+    from .platform_env import parse_probe_stages, probe_src, run_captured
 
-    t0 = time.time()
     try:
         rc, stdout, stderr = run_captured(
-            [sys.executable, "-c", PROBE_SRC], timeout_s=timeout_s,
+            [sys.executable, "-c", probe_src(timeout_s + 10)],
+            timeout_s=timeout_s,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        partial = (e.output or "").strip()
+        if "INIT" in partial:
+            return {
+                "status": "compute-hang",
+                "detail": (
+                    f"init ok ({partial.splitlines()[0]}) but a tiny "
+                    f"compile+execute exceeded {timeout_s}s"
+                ),
+            }
         return {"status": "hang", "detail": f"init exceeded {timeout_s}s"}
-    wall = time.time() - t0
     if rc != 0:
         tail = (stderr or "").strip().splitlines()[-3:]
         return {"status": "error", "detail": " | ".join(tail)}
-    parts = (stdout or "").strip().split()
-    backend = parts[0] if parts else "?"
+    stages = parse_probe_stages(stdout)
+    backend = stages.get("backend", "?")
     return {
         "status": "ok" if backend not in ("cpu", "?") else "cpu-only",
         "backend": backend,
-        "init_s": round(wall, 1),
-        "detail": stdout.strip(),
+        # True per-stage timings from the probe's own clock (wall time
+        # here would also count interpreter start + jax import).
+        "init_s": stages.get("init_s"),
+        "compute_s": stages.get("compute_s"),
+        "detail": "; ".join((stdout or "").strip().splitlines()),
     }
 
 
@@ -102,8 +113,11 @@ def _chip_holders() -> list:
 def diagnose(probe_timeout: int = 120, retries: int = 3,
              retry_delay: int = 90) -> int:
     """Run the diagnosis; prints a human report to stderr, returns an exit
-    code: 0 healthy accelerator, 1 worker-restart suspected (retry later),
-    2 plugin/config failure, 3 no accelerator configured."""
+    code: 0 healthy accelerator, 1 worker-restart suspected (retry in
+    minutes), 2 plugin/config failure, 3 no accelerator configured,
+    4 worker compute-wedged (init answers, compute hangs — observed
+    recoveries take hours; no point retrying on a minutes scale, so this
+    verdict short-circuits the retry loop)."""
     log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
     plat = os.environ.get("JAX_PLATFORMS", "(unset)")
     log(f"JAX_PLATFORMS={plat}")
@@ -113,7 +127,7 @@ def diagnose(probe_timeout: int = 120, retries: int = 3,
         r = _probe(probe_timeout)
         if r["status"] == "ok":
             log(f"HEALTHY: backend={r['backend']} init={r['init_s']}s "
-                f"({r['detail']})")
+                f"compute={r.get('compute_s')}s ({r['detail']})")
             return 0
         if r["status"] == "cpu-only":
             log("NO ACCELERATOR: jax resolved to the CPU backend — either "
@@ -125,6 +139,14 @@ def diagnose(probe_timeout: int = 120, retries: int = 3,
                 "plugin before retrying.")
             return 2
         hangs += 1
+        if r["status"] == "compute-hang":
+            log(f"probe COMPUTE stage hung ({r['detail']}).")
+            log("WORKER COMPUTE-WEDGED: the worker answers PJRT init but "
+                "wedges on the first compile/execute — observed "
+                "recoveries take hours, not minutes; treat the "
+                "accelerator as down and use the CPU fallback until a "
+                "probe goes fully healthy (`deppy doctor --watch`).")
+            return 4
         log(f"probe hung ({r['detail']}).")
         holders = _chip_holders()
         if holders:
@@ -143,6 +165,37 @@ def diagnose(probe_timeout: int = 120, retries: int = 3,
     return 1
 
 
+def watch(interval: int = 600, probe_timeout: int = 120,
+          log_path: str = "", until_healthy: bool = False) -> int:
+    """Periodic health monitor: one compute probe per tick, one JSON line
+    per result appended to ``log_path`` (and echoed to stderr).  With
+    ``until_healthy`` the loop exits 0 at the first fully healthy probe —
+    the building block for scripts that wait out a worker outage before
+    launching accelerator work (`deppy doctor --watch --until-healthy &&
+    make bench`) — and exits immediately with :func:`diagnose`'s code on
+    a status waiting cannot heal (no accelerator configured: 3,
+    plugin/config failure: 2).  Hang statuses keep waiting; outlasting
+    them is the point of the mode."""
+    import json
+
+    while True:
+        r = _probe(probe_timeout)
+        rec = {"ts": round(time.time(), 1), **r}
+        line = json.dumps(rec)
+        print(line, file=sys.stderr, flush=True)
+        if log_path:
+            with open(log_path, "a") as f:
+                f.write(line + "\n")
+        if until_healthy:
+            if r["status"] == "ok":
+                return 0
+            if r["status"] == "cpu-only":
+                return 3  # no accelerator will ever appear: fail fast
+            if r["status"] == "error":
+                return 2  # plugin/config failure: waiting cannot heal it
+        time.sleep(interval)
+
+
 def add_doctor_args(ap: argparse.ArgumentParser) -> None:
     """The doctor's flags, shared by this module's CLI and ``deppy
     doctor`` (cli.py) so defaults live in exactly one place — the
@@ -156,13 +209,32 @@ def add_doctor_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--probe-timeout", type=int, default=d["probe_timeout"])
     ap.add_argument("--retries", type=int, default=d["retries"])
     ap.add_argument("--retry-delay", type=int, default=d["retry_delay"])
+    w = {
+        k: p.default for k, p in inspect.signature(watch).parameters.items()
+    }
+    ap.add_argument("--watch", action="store_true",
+                    help="loop forever (or until --until-healthy) probing "
+                    "every --interval seconds, one JSON line per probe")
+    ap.add_argument("--interval", type=int, default=w["interval"])
+    ap.add_argument("--log", default=w["log_path"],
+                    help="append watch-mode JSON lines to this file")
+    ap.add_argument("--until-healthy", action="store_true",
+                    help="watch mode exits 0 at the first healthy probe")
+
+
+def run_from_args(args) -> int:
+    """Dispatch parsed doctor args (shared by ``deppy doctor`` and the
+    module CLI)."""
+    if getattr(args, "watch", False):
+        return watch(args.interval, args.probe_timeout, args.log,
+                     args.until_healthy)
+    return diagnose(args.probe_timeout, args.retries, args.retry_delay)
 
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     add_doctor_args(ap)
-    args = ap.parse_args()
-    sys.exit(diagnose(args.probe_timeout, args.retries, args.retry_delay))
+    sys.exit(run_from_args(ap.parse_args()))
 
 
 if __name__ == "__main__":

@@ -285,3 +285,49 @@ def test_doctor_subcommand_wiring(monkeypatch, capsys):
     out = capsys.readouterr()
     assert rc != 0  # 0 is reserved for a healthy accelerator
     assert "NO ACCELERATOR" in out.out + out.err
+
+
+def test_doctor_probe_distinguishes_compute_hang(monkeypatch):
+    """A worker that answers PJRT init but wedges on the first compile
+    must classify as compute-hang, not a plain init hang — the two have
+    very different recovery horizons (minutes vs hours).  The probe's
+    partial stdout rides the TimeoutExpired from run_captured."""
+    import subprocess
+
+    from deppy_tpu.utils import platform_env, tpu_doctor
+
+    def fake_run(cmd, timeout_s, env=None, cwd=None):
+        raise subprocess.TimeoutExpired(
+            cmd, timeout_s, output="INIT tpu 1 8.0\n", stderr="")
+
+    monkeypatch.setattr(platform_env, "run_captured", fake_run)
+    r = tpu_doctor._probe(5)
+    assert r["status"] == "compute-hang"
+    assert "INIT tpu" in r["detail"]
+
+    def fake_run_no_init(cmd, timeout_s, env=None, cwd=None):
+        raise subprocess.TimeoutExpired(cmd, timeout_s, output="", stderr="")
+
+    monkeypatch.setattr(platform_env, "run_captured", fake_run_no_init)
+    assert tpu_doctor._probe(5)["status"] == "hang"
+
+
+def test_doctor_watch_until_healthy_logs_json(monkeypatch, tmp_path):
+    """Watch mode appends one JSON line per probe and exits 0 at the
+    first healthy result."""
+    import json
+
+    from deppy_tpu.utils import tpu_doctor
+
+    results = iter([
+        {"status": "compute-hang", "detail": "wedged"},
+        {"status": "ok", "backend": "tpu", "init_s": 1.0, "detail": "x"},
+    ])
+    monkeypatch.setattr(tpu_doctor, "_probe", lambda t: next(results))
+    log = tmp_path / "health.jsonl"
+    rc = tpu_doctor.watch(interval=0, probe_timeout=1,
+                          log_path=str(log), until_healthy=True)
+    assert rc == 0
+    lines = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [l["status"] for l in lines] == ["compute-hang", "ok"]
+    assert all("ts" in l for l in lines)

@@ -89,3 +89,30 @@ def test_on_token_maps_to_default_dir(monkeypatch, tmp_path,
     platform_env.enable_compile_cache()
     assert jax.config.jax_compilation_cache_dir == platform_env.default_cache_dir()
     assert jax.config.jax_compilation_cache_dir.startswith(str(tmp_path))
+
+
+def test_engine_import_asserts_env_platform():
+    """Importing the tensor engine in a ``JAX_PLATFORMS=cpu`` process must
+    limit plugin DISCOVERY to cpu via jax.config, not just selection —
+    otherwise jax initializes every registered PJRT plugin and a wedged
+    accelerator plugin hangs the import-adjacent first backend query for
+    hours (observed 2026-07-31).  Runs in a subprocess so this process's
+    conftest config cannot mask a regression; on the axon machine with a
+    wedged worker, a regression makes the subprocess TIME OUT rather
+    than merely fail an assert."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    src = (
+        "import deppy_tpu.engine.driver, jax; "
+        "assert jax.config.jax_platforms == 'cpu', jax.config.jax_platforms; "
+        "print(jax.default_backend())"
+    )
+    rc, out, err = platform_env.run_captured(
+        [sys.executable, "-c", src], timeout_s=120, env=env,
+    )
+    assert rc == 0, err[-800:]
+    assert out.strip().splitlines()[-1] == "cpu"
