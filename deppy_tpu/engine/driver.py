@@ -460,6 +460,17 @@ PROBE_LANES = int(os.environ.get("DEPPY_TPU_PROBE_LANES", "512"))
 # HBM reads.  "1"/"0" force it on/off (tests force "1" on CPU).
 SPEC_CORE = os.environ.get("DEPPY_TPU_SPEC_CORE", "auto")
 
+# Per-dispatch step budget for the speculative sweep's SEARCH stages
+# (stage-2 DPLL lanes and the certifying probe).  The caller's remaining
+# budget can be millions of steps, and a 512-lane lockstep program
+# running a deep SAT search that long is exactly the
+# minutes-long-single-execution class that crashes the tunneled worker
+# (BASELINE.md round-3 notes, crash 2).  Exceeding the cap is harmless
+# for correctness: capped-out lanes read as RUNNING and the sweep
+# returns None, falling back to the host spec sweep with the steps
+# spent charged against the budget.
+SPEC_CORE_CAP = int(os.environ.get("DEPPY_TPU_SPEC_CORE_CAP", str(1 << 15)))
+
 
 def _spec_core_enabled() -> bool:
     if SPEC_CORE == "1":
@@ -531,7 +542,7 @@ def _speculative_core_mask(problem, remaining: int):
             trials = np.concatenate(
                 [trials, np.zeros((Q - len(rows), d.NCON), bool)])
             st, sp = jax.device_get(
-                pb(pt, trials, np.int32(remaining)))
+                pb(pt, trials, np.int32(min(remaining, SPEC_CORE_CAP))))
             status[rows] = st[: len(rows)]
             steps += int(sp[: len(rows)].sum())
             if steps > remaining:
@@ -554,7 +565,8 @@ def _speculative_core_mask(problem, remaining: int):
     pb = core.batched_probe(d.V, d.NCON, d.NV)
     vt = np.zeros((Q, d.NCON), bool)
     vt[0, :n] = keep
-    st, sp = jax.device_get(pb(pt, vt, np.int32(remaining)))
+    st, sp = jax.device_get(
+        pb(pt, vt, np.int32(min(remaining, SPEC_CORE_CAP))))
     steps += int(sp[0])
     if int(st[0]) != core.UNSAT or steps > remaining:
         return None, steps

@@ -1,0 +1,123 @@
+"""Find the tunneled worker's safe dispatch-width boundary.
+
+Round-3 root-causing (BASELINE.md TPU notes) showed ≥1024-lane programs
+crash the axon worker, so the engine caps dispatches at
+DEPPY_TPU_MAX_LANES=512.  But the observed crashes ran headline-shape
+problems; whether the limit is the LANE COUNT or the total program size
+(bytes/execution time) was never separated.  This probe escalates the
+lane width on two instance sizes — headline (length 48) and half-size
+(length 24, ~half the clause planes) — so the two hypotheses give
+different outcomes:
+
+  * both shapes fail at 1024  -> lane-count bound: keep 512.
+  * half-size passes 1024+ where headline fails -> bytes/time bound:
+    the cap should scale with per-lane plane bytes
+    (DEPPY_TPU_MAX_LANES can rise for small-problem fleets).
+
+Each step runs in a DISPOSABLE subprocess (run_captured + SIGALRM
+self-destruct env) so a worker wedge kills the step, not this process,
+and the worker's health is re-probed between steps; the sweep aborts on
+the first unhealthy probe since results after a crash measure the
+restarting worker, not the policy.  One JSON line per step on stdout.
+
+CAUTION: expected to crash the worker at the boundary, after which PJRT
+init can hang for hours.  Run it when a crash is affordable (hours
+before the next scheduled benchmark), not right before one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+STEP_SRC = """
+import os, signal, time
+signal.alarm({alarm})
+import jax
+from deppy_tpu.engine import driver
+from deppy_tpu.models import random_instance
+from deppy_tpu.sat.encode import encode
+problems = [encode(random_instance(length={length}, seed=s))
+            for s in range({width})]
+t0 = time.perf_counter()
+driver.solve_problems(problems)
+warm = time.perf_counter() - t0
+t0 = time.perf_counter()
+res = driver.solve_problems(problems)
+run = time.perf_counter() - t0
+print("STEP", jax.default_backend(), round(warm, 2), round(run, 3),
+      round({width} / run, 1), flush=True)
+os._exit(0)
+"""
+
+
+def _healthy(timeout_s: int) -> bool:
+    from deppy_tpu.utils.tpu_doctor import _probe
+
+    # cpu-only counts: a forced-CPU run of this sweep (smoke tests, lane
+    # policy on CPU XLA) has no worker to wedge.
+    return _probe(timeout_s)["status"] in ("ok", "cpu-only")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--widths", default="512,1024,2048,4096")
+    ap.add_argument("--lengths", default="24,48")
+    ap.add_argument("--step-timeout", type=int, default=420)
+    ap.add_argument("--probe-timeout", type=int, default=120)
+    a = ap.parse_args()
+
+    import os
+
+    from deppy_tpu.utils.platform_env import run_captured
+
+    widths = [int(w) for w in a.widths.split(",")]
+    lengths = [int(s) for s in a.lengths.split(",")]
+    for width in widths:           # escalate width, small shape first
+        for length in sorted(lengths):
+            if not _healthy(a.probe_timeout):
+                print(json.dumps({"abort": "worker unhealthy", "before":
+                                  {"width": width, "length": length}}),
+                      flush=True)
+                return
+            env = dict(os.environ)
+            env["DEPPY_TPU_MAX_LANES"] = str(width)
+            rec = {"width": width, "length": length}
+            t0 = time.time()
+            try:
+                rc, out, err = run_captured(
+                    [sys.executable, "-c",
+                     STEP_SRC.format(alarm=a.step_timeout + 30,
+                                     length=length, width=width)],
+                    timeout_s=a.step_timeout, env=env, cwd=".",
+                )
+                line = next((l for l in (out or "").splitlines()
+                             if l.startswith("STEP")), "")
+                parts = line.split()
+                rec.update(
+                    ok=rc == 0 and len(parts) == 5,
+                    backend=parts[1] if len(parts) > 1 else None,
+                    warm_s=float(parts[2]) if len(parts) > 2 else None,
+                    run_s=float(parts[3]) if len(parts) > 3 else None,
+                    rate=float(parts[4]) if len(parts) > 4 else None,
+                )
+                if rc != 0:
+                    rec["stderr_tail"] = (err or "").strip()[-300:]
+            except subprocess.TimeoutExpired:
+                rec.update(ok=False, timeout_s=a.step_timeout)
+            rec["wall_s"] = round(time.time() - t0, 1)
+            print(json.dumps(rec), flush=True)
+            if not rec["ok"]:
+                print(json.dumps({"abort": "step failed; stopping sweep "
+                                  "before burying the worker deeper"}),
+                      flush=True)
+                return
+
+
+if __name__ == "__main__":
+    main()

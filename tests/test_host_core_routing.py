@@ -141,6 +141,38 @@ def test_speculative_core_exhausted_budget(monkeypatch):
     assert driver._speculative_core_mask(p, 0) == (None, 0)
 
 
+def test_speculative_search_dispatches_are_budget_capped(monkeypatch):
+    """Stage-2/verification probes must never ship the caller's whole
+    (potentially multi-million-step) budget into one device program —
+    minutes-long single executions are a known worker-crash trigger; the
+    dispatch budget is clamped to SPEC_CORE_CAP and a capped-out lane
+    falls back to the host sweep."""
+    from deppy_tpu.engine import core
+
+    seen = []
+    orig = core.batched_probe
+
+    def capture(V, NCON, NV):
+        fn = orig(V, NCON, NV)
+
+        def wrapped(pt, trials, budget):
+            seen.append(int(budget))
+            return fn(pt, trials, budget)
+
+        return wrapped
+
+    monkeypatch.setattr(core, "batched_probe", capture)
+    p = encode([
+        sat.variable("a", sat.mandatory(), sat.dependency("b", "c")),
+        sat.variable("b", sat.conflict("c")),
+        sat.variable("c", sat.mandatory()),
+        sat.variable("d", sat.mandatory(), sat.prohibited()),
+    ])
+    driver._speculative_core_mask(p, 1 << 24)
+    assert seen, "expected at least one search-stage dispatch"
+    assert all(b <= driver.SPEC_CORE_CAP for b in seen)
+
+
 def test_gvk_conflict_core_parity(monkeypatch):
     # A conflict-heavy catalog (the UNSAT-prone workload family) with the
     # threshold at 0: every UNSAT lane host-routes; results must match the
