@@ -21,6 +21,10 @@ def test_bench_emits_json_and_exits_zero_without_accelerator():
     on a machine whose TPU tunnel hangs)."""
     env = dict(os.environ)
     env["DEPPY_BENCH_PROBE_TIMEOUT"] = "1"
+    # One probe attempt: the waiting-out-a-worker-restart retry loop is
+    # production behavior, but 3 x 60s retry delays would be ~90% of this
+    # test's runtime and the contract under test is the JSON line.
+    env["DEPPY_BENCH_PROBE_RETRIES"] = "1"
     env["DEPPY_BENCH_N"] = "8"
     env["DEPPY_BENCH_HOST_SAMPLE"] = "2"
     # The test process env forces cpu already (conftest mutates XLA_FLAGS /
