@@ -132,6 +132,30 @@ def _probe_cmd_src() -> str:
     )
 
 
+def reprobe_engine() -> bool:
+    """Probe engine usability again and replace the cached verdict.
+
+    The cached verdict makes ``auto`` a routing policy, not a health
+    monitor — right for short-lived processes, wrong for a long-lived
+    service that booted during an accelerator outage and would otherwise
+    route to the host engine forever after the worker recovers.  The
+    service's pre-warm loop calls this on an interval while the verdict
+    is negative (see service.Service.start); anyone else running a
+    long-lived auto-routed process can do the same.  Returns the fresh
+    verdict.  Downgrades work too: a probe failing after a positive
+    verdict flips routing back to host for subsequent solves.
+
+    The stale verdict stays in place (and readable lock-free by
+    ``_engine_usable``'s fast path) while the probe runs, so concurrent
+    auto solves keep routing instantly instead of blocking up to the
+    probe timeout; the fresh verdict swaps in atomically afterwards."""
+    global _ENGINE_USABLE
+    with _ENGINE_USABLE_LOCK:
+        fresh = _probe_verdict()
+        _ENGINE_USABLE = fresh
+        return fresh
+
+
 def _engine_usable() -> bool:
     """True when the tensor engine and a JAX backend are both usable.
     ``auto`` degrades to the host engine rather than failing, so the
@@ -155,10 +179,16 @@ def _engine_usable_locked() -> bool:
     global _ENGINE_USABLE
     if _ENGINE_USABLE is not None:  # a concurrent caller probed first
         return _ENGINE_USABLE
+    _ENGINE_USABLE = _probe_verdict()
+    return _ENGINE_USABLE
+
+
+def _probe_verdict() -> bool:
+    """One engine-usability probe, no cache interaction (callers manage
+    the ``_ENGINE_USABLE`` cache and its lock)."""
     try:
         from ..engine import driver  # noqa: F401
     except Exception:
-        _ENGINE_USABLE = False
         return False
     import os
 
@@ -168,10 +198,9 @@ def _engine_usable_locked() -> bool:
             import jax
 
             jax.devices()
-            _ENGINE_USABLE = True
+            return True
         except Exception:
-            _ENGINE_USABLE = False
-        return _ENGINE_USABLE
+            return False
     import subprocess
     import sys
 
@@ -193,7 +222,6 @@ def _engine_usable_locked() -> bool:
             timeout=_PROBE_TIMEOUT_S,
             env=env,
         )
-        _ENGINE_USABLE = probe.returncode == 0
+        return probe.returncode == 0
     except Exception:  # TimeoutExpired (hung init) or spawn failure
-        _ENGINE_USABLE = False
-    return _ENGINE_USABLE
+        return False

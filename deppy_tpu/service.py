@@ -24,6 +24,7 @@ iterations as counted by the engine's step budget).
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
@@ -136,6 +137,8 @@ class Server:
         self.max_body_bytes = max_body_bytes
         self.metrics = Metrics()
         self.ready = threading.Event()
+        self._stop = threading.Event()
+        self._reprobe_s = float(os.environ.get("DEPPY_TPU_REPROBE", "600"))
         self._api = _make_http_server(
             _parse_addr(bind_address), _api_handler(self)
         )
@@ -192,25 +195,40 @@ class Server:
             self._threads.append(t)
         if self.backend == "auto":
             # Pre-warm the auto-backend usability verdict: against a
-            # crashed TPU worker the probe takes its full timeout (45s)
+            # crashed TPU worker the probe takes its full timeout (75s)
             # before falling back to host.  The verdict is process-cached
             # and probing is serialized (solver._ENGINE_USABLE_LOCK), so a
             # request landing mid-probe waits on the SHARED probe — worst
             # case the remaining probe window, never a duplicate one —
             # and every request after the verdict routes instantly.
+            #
+            # If the verdict comes back negative, keep re-probing on an
+            # interval (DEPPY_TPU_REPROBE seconds, 0 disables): a service
+            # that boots during a worker outage upgrades auto routing to
+            # the tensor engine when the worker recovers, instead of
+            # serving from the host engine for the rest of its life.
             def _prewarm():
-                from .sat.solver import resolve_backend
+                from .sat import solver as sat_solver
 
                 try:
-                    resolve_backend("auto")
+                    if sat_solver.resolve_backend("auto") == "tpu":
+                        return
                 except Exception:
                     pass  # request-path resolution will surface errors
+                while self._reprobe_s > 0 and not self._stop.wait(
+                        self._reprobe_s):
+                    try:
+                        if sat_solver.reprobe_engine():
+                            return
+                    except Exception:
+                        continue  # transient; keep trying next tick
 
             threading.Thread(target=_prewarm, daemon=True).start()
         self.ready.set()
 
     def shutdown(self) -> None:
         self.ready.clear()
+        self._stop.set()
         for srv in (self._api, self._probe):
             if self._threads:
                 # BaseServer.shutdown blocks forever unless serve_forever is

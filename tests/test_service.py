@@ -316,3 +316,75 @@ def test_engine_steps_metric_advances(server):
     steps = [l for l in data.decode().splitlines()
              if l.startswith("deppy_engine_steps_total")]
     assert steps and int(steps[0].split()[-1]) > 0
+
+
+def test_auto_routing_upgrades_when_worker_recovers(monkeypatch):
+    """A service that boots during an accelerator outage must not route
+    auto solves to the host engine forever: the pre-warm loop re-probes
+    on DEPPY_TPU_REPROBE seconds and flips the cached verdict when the
+    backend comes back (deppy_tpu.sat.solver.reprobe_engine)."""
+    import time as _time
+
+    from deppy_tpu.sat import solver as sat_solver
+
+    verdicts = iter([False, False, True])
+    monkeypatch.setattr(sat_solver, "_probe_verdict",
+                        lambda: next(verdicts))
+    monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", None)
+    monkeypatch.setenv("DEPPY_TPU_REPROBE", "0.05")
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="auto")
+    srv.start()
+    try:
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            # reprobe_engine replaces the module global; read it fresh.
+            if sat_solver._ENGINE_USABLE:
+                break
+            _time.sleep(0.05)
+        assert sat_solver._ENGINE_USABLE is True
+    finally:
+        srv.shutdown()
+        monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", None)
+
+
+def test_reprobe_engine_replaces_cached_verdict(monkeypatch):
+    from deppy_tpu.sat import solver as sat_solver
+
+    monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", False)
+    monkeypatch.setattr(sat_solver, "_probe_verdict", lambda: True)
+    assert sat_solver.reprobe_engine() is True
+    assert sat_solver._ENGINE_USABLE is True
+    monkeypatch.setattr(sat_solver, "_probe_verdict", lambda: False)
+    assert sat_solver.reprobe_engine() is False
+    assert sat_solver._ENGINE_USABLE is False
+    monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", None)
+
+
+def test_stale_verdict_readable_during_reprobe(monkeypatch):
+    """Concurrent auto routing must NOT block while a re-probe is in
+    flight: the stale verdict stays readable lock-free until the fresh
+    one swaps in."""
+    import threading as _threading
+
+    from deppy_tpu.sat import solver as sat_solver
+
+    probing = _threading.Event()
+    release = _threading.Event()
+
+    def slow_probe():
+        probing.set()
+        assert release.wait(10)
+        return True
+
+    monkeypatch.setattr(sat_solver, "_probe_verdict", slow_probe)
+    monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", False)
+    t = _threading.Thread(target=sat_solver.reprobe_engine, daemon=True)
+    t.start()
+    assert probing.wait(10)
+    # Probe in flight and lock held: the cached False must still answer.
+    assert sat_solver._engine_usable() is False
+    release.set()
+    t.join(10)
+    assert sat_solver._ENGINE_USABLE is True
+    monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", None)
