@@ -170,7 +170,16 @@ def run(quick: bool = False, out_path: Optional[str] = None,
 
 
 def main() -> None:
+    import os
+    import signal
+
     from ..utils.platform_env import apply_platform_env
+
+    # Same orphan guard as headline.main: a caller that dies mid-suite
+    # must not leave this process wedged on the accelerator worker.
+    sd = os.environ.get("DEPPY_BENCH_SELF_DESTRUCT")
+    if sd and sd.isdigit() and int(sd) > 0:
+        signal.alarm(int(sd))
 
     apply_platform_env()
     ap = argparse.ArgumentParser(description=__doc__)

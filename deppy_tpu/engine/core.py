@@ -406,6 +406,16 @@ def bcp_round(pt: ProblemTensors, assign: jax.Array,
 # planes near VMEM capacity), so it stays opt-in.
 _BCP_IMPL = os.environ.get("DEPPY_TPU_BCP", "auto")
 
+# Propagation rounds applied per fixpoint while_loop trip (the "bits"
+# path only).  >1 trades redundant work on converged lanes for fewer
+# loop trips — a bet on per-trip scheduling overhead, i.e. a TPU knob;
+# exit states are bit-identical at any setting (see planes_fixpoint).
+# Measured on CPU XLA it LOSES outright (deep-chain config: 7552/s at
+# 1 vs 6563/s at 2 vs 2631/s at 3; random catalog the same shape) —
+# per-trip overhead is negligible there and the redundant gated round
+# dominates.  Default 1; A/B on a real TPU before ever raising it.
+_BCP_UNROLL = max(1, int(os.environ.get("DEPPY_TPU_BCP_UNROLL", "1")))
+
 
 def _batch_planes(clauses: jax.Array, W: int) -> Tuple[jax.Array, jax.Array]:
     """Batched signed clause matrices [B, C, K] → (pos, neg) packed int32
@@ -612,9 +622,27 @@ def planes_fixpoint(pt: ProblemTensors, t: jax.Array, f: jax.Array,
 
     def body(state):
         _, t, f, _ = state
-        return round_planes(
+        c, t, f, ch = round_planes(
             pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f,
         )
+        # Optional unroll: more propagation rounds per loop trip (deep
+        # implication chains advance one link per round, and each
+        # while_loop trip has fixed scheduling overhead — a TPU lever).
+        # Exit state stays bit-identical to the 1-round loop: extra
+        # applications are gated on the trip's flags so a conflicted or
+        # converged state passes through unchanged (confluence would
+        # make any interleaving equivalent anyway; gating keeps even the
+        # intermediate states aligned).
+        for _ in range(_BCP_UNROLL - 1):
+            c2, t2, f2, ch2 = round_planes(
+                pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f,
+            )
+            keep = ~c & ch
+            t = jnp.where(keep, t2, t)
+            f = jnp.where(keep, f2, f)
+            ch = jnp.where(keep, ch2, ch)
+            c = c | (keep & c2)
+        return c, t, f, ch
 
     conflict, t, f, _ = lax.while_loop(cond, body, (jnp.bool_(False), t, f, run))
     return conflict | pre_conflict, t, f

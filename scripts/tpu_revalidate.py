@@ -1,0 +1,190 @@
+"""Staged TPU revalidation after a worker outage.
+
+Waits for the worker to answer a full compute probe (`deppy doctor
+--watch --until-healthy` semantics), then walks an escalating stage
+ladder, each stage in a disposable subprocess with a hard timeout and a
+health re-probe between stages:
+
+  A. tiny batch (64 problems), persistent compile cache OFF
+  B. tiny batch, compile cache ON      — isolates the cache as a wedge
+     trigger: the 2026-07-31 outage began at the first compile of a
+     cache-enabled run, and A-passes-B-fails would convict it
+  C. headline shape at 1024 problems (cache per B's verdict)
+  D. full benchmark suite (``deppy_tpu.benchmarks.suite``)
+  E. the driver contract: ``bench.py`` end to end
+
+Aborts at the first failed stage, and whenever the probed backend is no
+longer the one stage A ran on — results taken after a crash (or on a
+silent CPU fallback) would measure the wrong thing.  One JSON line per
+stage on stdout (and appended to --log); run it detached and poll the
+log:
+
+  setsid nohup python scripts/tpu_revalidate.py --log /tmp/reval.jsonl &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# One solve-stage template; apply_platform_env() makes the stage honor
+# DEPPY_TPU_COMPILE_CACHE (enable_compile_cache runs only at process
+# entry points — a bare driver import never touches the cache config,
+# which would make the A/B cache differential vacuous).
+STAGE_SRC = """
+import os, signal, time
+signal.alarm({alarm})
+from deppy_tpu.utils.platform_env import apply_platform_env
+apply_platform_env()
+import jax
+from deppy_tpu.engine import driver
+from deppy_tpu.models import random_instance
+from deppy_tpu.sat.encode import encode
+problems = [encode(random_instance(length={length}, seed=s))
+            for s in range({count})]
+t0 = time.perf_counter(); driver.solve_problems(problems)
+warm = time.perf_counter() - t0
+t0 = time.perf_counter(); driver.solve_problems(problems)
+run = time.perf_counter() - t0
+print("STAGE", jax.default_backend(), round(warm, 2), round(run, 3),
+      round({count} / run, 1), flush=True)
+os._exit(0)
+"""
+
+
+def _emit(rec: dict, log_path: str) -> None:
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if log_path:
+        with open(log_path, "a") as f:
+            f.write(line + "\n")
+
+
+def _run_stage(name: str, cmd, env, timeout_s: int, log_path: str) -> dict:
+    from deppy_tpu.utils.platform_env import run_captured
+
+    env = dict(env)
+    # Orphan guard for stages whose entry point honors it (suite,
+    # bench.py's workload): if THIS script dies mid-stage, the child
+    # self-destructs shortly after the watchdog would have fired.
+    env.setdefault("DEPPY_BENCH_SELF_DESTRUCT", str(timeout_s + 60))
+    rec = {"stage": name, "ts": round(time.time(), 1)}
+    t0 = time.time()
+    try:
+        rc, out, err = run_captured(cmd, timeout_s=timeout_s, env=env,
+                                    cwd=ROOT)
+        line = next((l for l in (out or "").splitlines()
+                     if l.startswith("STAGE")), "")
+        parts = line.split()
+        rec.update(ok=rc == 0,
+                   backend=parts[1] if len(parts) > 1 else None,
+                   warm_s=float(parts[2]) if len(parts) > 2 else None,
+                   run_s=float(parts[3]) if len(parts) > 3 else None,
+                   rate=float(parts[4]) if len(parts) > 4 else None)
+        if rc != 0:
+            rec["tail"] = ((err or "") + (out or "")).strip()[-400:]
+    except subprocess.TimeoutExpired as e:
+        # The partial output rides the exception precisely so the record
+        # can say WHICH phase hung (run_captured's contract).
+        rec.update(ok=False, timeout_s=timeout_s,
+                   tail=((e.stderr or "") + (e.output or "")).strip()[-400:])
+    rec["wall_s"] = round(time.time() - t0, 1)
+    _emit(rec, log_path)
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--log", default="")
+    ap.add_argument("--wait-interval", type=int, default=600)
+    ap.add_argument("--probe-timeout", type=int, default=120)
+    ap.add_argument("--skip-wait", action="store_true",
+                    help="assume the worker is healthy right now")
+    a = ap.parse_args()
+
+    from deppy_tpu.utils.tpu_doctor import _probe, watch
+
+    if not a.skip_wait:
+        _emit({"stage": "wait", "ts": round(time.time(), 1)}, a.log)
+        rc = watch(interval=a.wait_interval, probe_timeout=a.probe_timeout,
+                   log_path=a.log, until_healthy=True)
+        if rc != 0:  # terminal: no accelerator / plugin failure
+            _emit({"stage": "abort", "reason": f"watch rc={rc}",
+                   "ts": round(time.time(), 1)}, a.log)
+            return
+    _emit({"stage": "healthy", "ts": round(time.time(), 1)}, a.log)
+
+    ladder_backend: list = [None]  # set by stage A, enforced after
+
+    def healthy() -> bool:
+        r = _probe(a.probe_timeout)
+        # The backend must still be the one the ladder started on: a
+        # worker dying mid-ladder can flip probes to "cpu-only", and
+        # continuing would record CPU numbers as if they were device
+        # results.  (A forced-CPU smoke run sets ladder_backend to
+        # "cpu" at stage A, so cpu-only stays healthy there.)
+        ok = (r["status"] in ("ok", "cpu-only")
+              and r.get("backend") == ladder_backend[0])
+        if not ok:
+            _emit({"stage": "abort", "reason": "worker unhealthy or "
+                   f"backend changed ({r.get('backend')}, "
+                   f"expected {ladder_backend[0]})",
+                   "ts": round(time.time(), 1)}, a.log)
+        return ok
+
+    env_off = dict(os.environ)
+    env_off["DEPPY_TPU_COMPILE_CACHE"] = "off"
+    env_on = dict(os.environ)
+    env_on["DEPPY_TPU_COMPILE_CACHE"] = "on"
+    py = sys.executable
+    tiny = STAGE_SRC.format(alarm=330, length=24, count=64)
+
+    # A: tiny, cache off.
+    rec = _run_stage("A:tiny-cache-off", [py, "-c", tiny], env_off, 300,
+                     a.log)
+    if not rec["ok"]:
+        return
+    ladder_backend[0] = rec["backend"]
+    if not healthy():
+        return
+    # B: tiny, cache on (same shapes — a pure cache-path test).
+    cache_ok = _run_stage("B:tiny-cache-on", [py, "-c", tiny], env_on,
+                          300, a.log)["ok"]
+    if not cache_ok:
+        _emit({"stage": "note", "msg": "compile cache implicated; "
+               "continuing with cache off"}, a.log)
+        if not healthy():
+            return
+    env_rest = env_on if cache_ok else env_off
+    # C: headline shape.
+    if not _run_stage(
+            "C:headline-1024",
+            [py, "-c", STAGE_SRC.format(alarm=630, length=48, count=1024)],
+            env_rest, 600, a.log)["ok"]:
+        return
+    if not healthy():
+        return
+    # D: full suite; the per-config JSON lines land in the stage log and
+    # the aggregate in /tmp for a human to inspect and commit under
+    # benchmarks/results/ with a backend-correct name.
+    if not _run_stage("D:suite",
+                      [py, "-m", "deppy_tpu.benchmarks.suite",
+                       "--out", "/tmp/reval_suite.json"],
+                      env_rest, 2400, a.log)["ok"]:
+        return
+    if not healthy():
+        return
+    # E: the driver contract end to end.
+    _run_stage("E:bench.py", [py, os.path.join(ROOT, "bench.py")],
+               env_rest, 1800, a.log)
+
+
+if __name__ == "__main__":
+    main()
