@@ -21,7 +21,7 @@ unit-fast: ## Tests minus the slow randomized-equivalence suites.
 
 .PHONY: verify
 verify: ## Sanity: everything compiles and collects (reference `make verify` analog).
-	$(PYTHON) -m compileall -q deppy_tpu tests bench.py __graft_entry__.py
+	$(PYTHON) -m compileall -q deppy_tpu tests scripts bench.py __graft_entry__.py
 	$(PYTHON) -m pytest tests/ -q --collect-only >/dev/null
 
 .PHONY: e2e
