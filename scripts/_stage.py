@@ -1,0 +1,101 @@
+"""Shared stage-runner for the TPU operational scripts.
+
+`scripts/tpu_revalidate.py` and `scripts/tpu_ab.py` both run engine
+workloads in disposable subprocesses and parse one `STAGE <backend>
+<warm_s> <run_s> <rate>` line back; this module keeps the snippet
+template and the run/parse/timeout handling in one place so the two
+harnesses cannot drift (hang-tail capture and stage parsing are the
+highest-churn logic in this tree).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# {alarm}: SIGALRM self-destruct; {length}/{count}: workload shape;
+# {reps}: timed repetitions (best-of).  apply_platform_env() makes the
+# snippet honor DEPPY_TPU_COMPILE_CACHE and JAX_PLATFORMS (neither
+# engages on a bare driver import).
+STAGE_SRC = """
+import os, signal, time
+signal.alarm({alarm})
+from deppy_tpu.utils.platform_env import apply_platform_env
+apply_platform_env()
+import jax
+from deppy_tpu.engine import driver
+from deppy_tpu.models import random_instance
+from deppy_tpu.sat.encode import encode
+problems = [encode(random_instance(length={length}, seed=s))
+            for s in range({count})]
+t0 = time.perf_counter(); driver.solve_problems(problems)
+warm = time.perf_counter() - t0
+best = None
+for _ in range({reps}):
+    t0 = time.perf_counter(); driver.solve_problems(problems)
+    run = time.perf_counter() - t0
+    best = run if best is None or run < best else best
+print("STAGE", jax.default_backend(), round(warm, 2), round(best, 3),
+      round({count} / best, 1), flush=True)
+os._exit(0)
+"""
+
+
+def solve_stage_src(*, alarm: int, length: int, count: int,
+                    reps: int = 1) -> str:
+    return STAGE_SRC.format(alarm=alarm, length=length, count=count,
+                            reps=reps)
+
+
+def emit(rec: dict, log_path: str) -> None:
+    """One JSON line to stdout, mirrored to ``log_path`` when set."""
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if log_path:
+        with open(log_path, "a") as f:
+            f.write(line + "\n")
+
+
+def run_stage(rec: dict, cmd, env, timeout_s: int, log_path: str) -> dict:
+    """Run one subprocess stage; parse its STAGE line into ``rec``; emit
+    and return the record.  A timed-out stage records the partial output
+    tail — the line that says WHICH phase hung (run_captured attaches it
+    to the TimeoutExpired for exactly this)."""
+    from deppy_tpu.utils.platform_env import run_captured
+
+    env = dict(env)
+    # Orphan guard for entry points that honor it (suite, bench.py's
+    # workload); inline snippets arm their own SIGALRM via {alarm}.
+    env.setdefault("DEPPY_BENCH_SELF_DESTRUCT", str(timeout_s + 60))
+    t0 = time.time()
+    try:
+        rc, out, err = run_captured(cmd, timeout_s=timeout_s, env=env,
+                                    cwd=ROOT)
+        line = next((l for l in (out or "").splitlines()
+                     if l.startswith("STAGE")), "")
+        parts = line.split()
+        rec.update(ok=rc == 0,
+                   backend=parts[1] if len(parts) > 1 else None,
+                   warm_s=float(parts[2]) if len(parts) > 2 else None,
+                   run_s=float(parts[3]) if len(parts) > 3 else None,
+                   rate=float(parts[4]) if len(parts) > 4 else None)
+        if rc != 0:
+            rec["tail"] = ((err or "") + (out or "")).strip()[-400:]
+    except subprocess.TimeoutExpired as e:
+        rec.update(ok=False, timeout_s=timeout_s,
+                   tail=((e.stderr or "") + (e.output or "")).strip()[-400:])
+    rec["wall_s"] = round(time.time() - t0, 1)
+    emit(rec, log_path)
+    return rec
+
+
+def probe_status(probe_timeout: int) -> dict:
+    sys.path.insert(0, ROOT)
+    from deppy_tpu.utils.tpu_doctor import _probe
+
+    return _probe(probe_timeout)

@@ -1,0 +1,90 @@
+"""A/B the TPU-bet engine knobs on the headline workload.
+
+The trip-overhead model (BASELINE.md, 2026-07-31) predicts the tunneled
+chip's search phase is while-loop-trip-overhead-bound (~175µs/trip vs
+~10µs of in-trip compute), so knobs that cut trip count at the price of
+extra in-trip compute — measured losers on CPU XLA — should win on the
+device.  This script measures them: each variant solves the headline
+shape (1024 × length-48 catalog instances, best of 3 timed runs) in a
+disposable subprocess (SIGALRM self-destruct), with a health probe
+between variants and an abort on the first failure or backend flip.
+It refuses to start on a CPU-only backend unless ``--allow-cpu`` is
+passed — these knobs are measured losers there and a silent CPU run
+would produce a meaningless JSONL.
+
+Run after `scripts/tpu_revalidate.py` reports a green ladder:
+
+  python scripts/tpu_ab.py [--count 1024] [--log /tmp/ab.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._stage import emit, probe_status, run_stage, solve_stage_src
+
+KNOB_VARS = ("DEPPY_TPU_BCP_UNROLL", "DEPPY_TPU_STAGE1_STEPS")
+
+VARIANTS = [
+    ("baseline", {}),
+    ("unroll2", {"DEPPY_TPU_BCP_UNROLL": "2"}),
+    ("unroll4", {"DEPPY_TPU_BCP_UNROLL": "4"}),
+    ("stage1-96", {"DEPPY_TPU_STAGE1_STEPS": "96"}),
+    ("unroll2+stage1-96", {"DEPPY_TPU_BCP_UNROLL": "2",
+                           "DEPPY_TPU_STAGE1_STEPS": "96"}),
+]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--count", type=int, default=1024)
+    ap.add_argument("--log", default="")
+    ap.add_argument("--step-timeout", type=int, default=600)
+    ap.add_argument("--probe-timeout", type=int, default=120)
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="permit running the A/B on a CPU-only backend "
+                    "(smoke tests; the knobs are measured losers there)")
+    a = ap.parse_args()
+
+    expected = [None]
+
+    def healthy() -> bool:
+        r = probe_status(a.probe_timeout)
+        acceptable = ("ok", "cpu-only") if a.allow_cpu else ("ok",)
+        ok = (r["status"] in acceptable
+              and (expected[0] is None or r.get("backend") == expected[0]))
+        if not ok:
+            emit({"abort": "worker unhealthy, cpu-only without "
+                  "--allow-cpu, or backend changed",
+                  "probe": r, "expected": expected[0]}, a.log)
+        return ok
+
+    src = solve_stage_src(alarm=a.step_timeout + 30, length=48,
+                          count=a.count, reps=3)
+    for name, knobs in VARIANTS:
+        if not healthy():
+            return
+        env = dict(os.environ)
+        for k in KNOB_VARS:
+            # A leftover exported knob would contaminate every variant
+            # (both are read at engine import time in the subprocess).
+            env.pop(k, None)
+        env.update(knobs)
+        env.setdefault("DEPPY_TPU_COMPILE_CACHE", "on")
+        rec = run_stage({"variant": name, **knobs},
+                        [sys.executable, "-c", src], env,
+                        a.step_timeout, a.log)
+        if not rec["ok"]:
+            emit({"abort": "variant failed; stopping before burying the "
+                  "worker"}, a.log)
+            return
+        if expected[0] is None:
+            expected[0] = rec["backend"]
+
+
+if __name__ == "__main__":
+    main()

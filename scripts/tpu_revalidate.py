@@ -25,79 +25,24 @@ log:
 from __future__ import annotations
 
 import argparse
-import json
 import os
-import subprocess
 import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-# One solve-stage template; apply_platform_env() makes the stage honor
-# DEPPY_TPU_COMPILE_CACHE (enable_compile_cache runs only at process
-# entry points — a bare driver import never touches the cache config,
-# which would make the A/B cache differential vacuous).
-STAGE_SRC = """
-import os, signal, time
-signal.alarm({alarm})
-from deppy_tpu.utils.platform_env import apply_platform_env
-apply_platform_env()
-import jax
-from deppy_tpu.engine import driver
-from deppy_tpu.models import random_instance
-from deppy_tpu.sat.encode import encode
-problems = [encode(random_instance(length={length}, seed=s))
-            for s in range({count})]
-t0 = time.perf_counter(); driver.solve_problems(problems)
-warm = time.perf_counter() - t0
-t0 = time.perf_counter(); driver.solve_problems(problems)
-run = time.perf_counter() - t0
-print("STAGE", jax.default_backend(), round(warm, 2), round(run, 3),
-      round({count} / run, 1), flush=True)
-os._exit(0)
-"""
+from scripts._stage import (  # noqa: E402
+    emit as _emit_line, probe_status, run_stage, solve_stage_src)
 
 
 def _emit(rec: dict, log_path: str) -> None:
-    line = json.dumps(rec)
-    print(line, flush=True)
-    if log_path:
-        with open(log_path, "a") as f:
-            f.write(line + "\n")
+    _emit_line(rec, log_path)
 
 
 def _run_stage(name: str, cmd, env, timeout_s: int, log_path: str) -> dict:
-    from deppy_tpu.utils.platform_env import run_captured
-
-    env = dict(env)
-    # Orphan guard for stages whose entry point honors it (suite,
-    # bench.py's workload): if THIS script dies mid-stage, the child
-    # self-destructs shortly after the watchdog would have fired.
-    env.setdefault("DEPPY_BENCH_SELF_DESTRUCT", str(timeout_s + 60))
-    rec = {"stage": name, "ts": round(time.time(), 1)}
-    t0 = time.time()
-    try:
-        rc, out, err = run_captured(cmd, timeout_s=timeout_s, env=env,
-                                    cwd=ROOT)
-        line = next((l for l in (out or "").splitlines()
-                     if l.startswith("STAGE")), "")
-        parts = line.split()
-        rec.update(ok=rc == 0,
-                   backend=parts[1] if len(parts) > 1 else None,
-                   warm_s=float(parts[2]) if len(parts) > 2 else None,
-                   run_s=float(parts[3]) if len(parts) > 3 else None,
-                   rate=float(parts[4]) if len(parts) > 4 else None)
-        if rc != 0:
-            rec["tail"] = ((err or "") + (out or "")).strip()[-400:]
-    except subprocess.TimeoutExpired as e:
-        # The partial output rides the exception precisely so the record
-        # can say WHICH phase hung (run_captured's contract).
-        rec.update(ok=False, timeout_s=timeout_s,
-                   tail=((e.stderr or "") + (e.output or "")).strip()[-400:])
-    rec["wall_s"] = round(time.time() - t0, 1)
-    _emit(rec, log_path)
-    return rec
+    return run_stage({"stage": name, "ts": round(time.time(), 1)},
+                     cmd, env, timeout_s, log_path)
 
 
 def main() -> None:
@@ -109,7 +54,7 @@ def main() -> None:
                     help="assume the worker is healthy right now")
     a = ap.parse_args()
 
-    from deppy_tpu.utils.tpu_doctor import _probe, watch
+    from deppy_tpu.utils.tpu_doctor import watch
 
     if not a.skip_wait:
         _emit({"stage": "wait", "ts": round(time.time(), 1)}, a.log)
@@ -124,7 +69,7 @@ def main() -> None:
     ladder_backend: list = [None]  # set by stage A, enforced after
 
     def healthy() -> bool:
-        r = _probe(a.probe_timeout)
+        r = probe_status(a.probe_timeout)
         # The backend must still be the one the ladder started on: a
         # worker dying mid-ladder can flip probes to "cpu-only", and
         # continuing would record CPU numbers as if they were device
@@ -144,7 +89,7 @@ def main() -> None:
     env_on = dict(os.environ)
     env_on["DEPPY_TPU_COMPILE_CACHE"] = "on"
     py = sys.executable
-    tiny = STAGE_SRC.format(alarm=330, length=24, count=64)
+    tiny = solve_stage_src(alarm=330, length=24, count=64)
 
     # A: tiny, cache off.
     rec = _run_stage("A:tiny-cache-off", [py, "-c", tiny], env_off, 300,
@@ -166,7 +111,7 @@ def main() -> None:
     # C: headline shape.
     if not _run_stage(
             "C:headline-1024",
-            [py, "-c", STAGE_SRC.format(alarm=630, length=48, count=1024)],
+            [py, "-c", solve_stage_src(alarm=630, length=48, count=1024)],
             env_rest, 600, a.log)["ok"]:
         return
     if not healthy():
