@@ -1,0 +1,75 @@
+"""Unit tests for scripts/_stage.py — the shared stage-runner behind the
+TPU operational harnesses (tpu_revalidate, tpu_ab).  The parse and
+hang-tail logic is shared precisely so it can be pinned once, here."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scripts import _stage  # noqa: E402
+
+
+def test_run_stage_parses_stage_line(tmp_path):
+    log = tmp_path / "log.jsonl"
+    rec = _stage.run_stage(
+        {"stage": "t"},
+        [sys.executable, "-c", "print('noise'); print('STAGE cpu 1.5 0.25 256.0')"],
+        dict(os.environ), 30, str(log))
+    assert rec["ok"] is True
+    assert rec["backend"] == "cpu"
+    assert rec["warm_s"] == 1.5
+    assert rec["run_s"] == 0.25
+    assert rec["rate"] == 256.0
+    assert rec["wall_s"] >= 0
+    logged = json.loads(log.read_text().splitlines()[-1])
+    assert logged["stage"] == "t" and logged["ok"] is True
+
+
+def test_run_stage_records_failure_tail(tmp_path):
+    rec = _stage.run_stage(
+        {"stage": "t"},
+        [sys.executable, "-c",
+         "import sys; print('partial'); sys.exit(3)"],
+        dict(os.environ), 30, str(tmp_path / "log.jsonl"))
+    assert rec["ok"] is False
+    assert "partial" in rec["tail"]
+
+
+def test_run_stage_timeout_keeps_partial_output(tmp_path):
+    """A hung stage must record WHICH phase hung — the partial output
+    rides run_captured's TimeoutExpired."""
+    rec = _stage.run_stage(
+        {"stage": "t"},
+        [sys.executable, "-u", "-c",
+         "import time; print('REACHED-MARKER', flush=True); time.sleep(60)"],
+        dict(os.environ), 3, str(tmp_path / "log.jsonl"))
+    assert rec["ok"] is False
+    assert rec["timeout_s"] == 3
+    assert "REACHED-MARKER" in rec.get("tail", "")
+
+
+def test_solve_stage_src_is_runnable_python():
+    import ast
+
+    src = _stage.solve_stage_src(alarm=10, length=8, count=2, reps=2)
+    ast.parse(src)  # no stray template braces / syntax damage
+    assert "signal.alarm(10)" in src
+
+
+def test_run_stage_sets_orphan_guard_env(monkeypatch):
+    seen = {}
+
+    def fake_run(cmd, timeout_s, env=None, cwd=None):
+        seen.update(env or {})
+        return 0, "STAGE cpu 1 1 1\n", ""
+
+    from deppy_tpu.utils import platform_env
+
+    monkeypatch.setattr(platform_env, "run_captured", fake_run)
+    _stage.run_stage({"stage": "t"}, ["x"], {}, 100, "")
+    assert seen.get("DEPPY_BENCH_SELF_DESTRUCT") == "160"
