@@ -47,11 +47,14 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def _probe_once() -> str | None:
+def _probe_once() -> "tuple[str | None, str]":
     """One probe attempt in a subprocess (a hang cannot propagate).  The
     probe COMPUTES, not just inits — an init-only probe once declared a
     worker healthy that then hung the workload's first compile for its
-    entire timeout (see platform_env.probe_src)."""
+    entire timeout (see platform_env.probe_src).  Returns
+    (backend_or_None, status) where status distinguishes the hang stage:
+    "init-hang" looks like a minutes-scale worker restart, "compute-hang"
+    is the hours-scale wedge (init answers, first compile never does)."""
     from deppy_tpu.utils.platform_env import (
         parse_probe_stages, probe_src, run_captured)
 
@@ -62,19 +65,23 @@ def _probe_once() -> str | None:
             cwd=REPO,
         )
     except subprocess.TimeoutExpired as e:
+        # Empty partial output is ambiguous (init never printed, or the
+        # output was lost with the killed process group); it classifies
+        # as init-hang, which takes the RETRY path — the conservative
+        # default, costing at worst the old retry budget.
         stage = "compute" if "INIT" in (e.output or "") else "init"
         _log(f"backend probe timed out after {PROBE_TIMEOUT_S}s "
              f"(hung in {stage})")
-        return None
+        return None, f"{stage}-hang"
     if rc != 0:
         tail = (stderr or "").strip().splitlines()[-1:]
         _log(f"backend probe failed rc={rc}: {tail}")
-        return None
+        return None, "error"
     stages = parse_probe_stages(stdout)
     backend = stages.get("backend", "")
     _log(f"backend probe ok: {backend} (init {stages.get('init_s')}s, "
          f"compute {stages.get('compute_s')}s)")
-    return backend or None
+    return backend or None, "ok" if backend else "error"
 
 
 def _probe_accelerator() -> str | None:
@@ -83,14 +90,20 @@ def _probe_accelerator() -> str | None:
     is itself a failure mode worth retrying — a crashed worker makes the
     PJRT plugin fail init and JAX fall back to CPU — so only a non-CPU
     backend ends the loop early; "cpu" is returned only once retries are
-    exhausted."""
+    exhausted.  A COMPUTE-stage hang ends the loop immediately: that
+    wedge has only ever cleared on an hours scale (BASELINE.md round-3
+    notes), so minutes of retries would be pure waste — go straight to
+    the CPU fallback."""
     import time
 
     last = None
     for attempt in range(PROBE_RETRIES):
-        backend = _probe_once()
+        backend, status = _probe_once()
         if backend and backend != "cpu":
             return backend
+        if status == "compute-hang":
+            _log("compute-stage wedge is hours-scale; skipping retries")
+            return last
         last = backend or last
         if attempt < PROBE_RETRIES - 1:
             _log(
