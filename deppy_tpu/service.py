@@ -117,6 +117,20 @@ class Metrics:
                 "# TYPE deppy_engine_steps_total counter",
                 f"deppy_engine_steps_total {self.engine_steps}",
             ]
+            # Auto-routing verdict at scrape time: 1 tensor engine, 0
+            # host fallback (accelerator unusable), absent while no
+            # verdict exists yet.  Makes the outage→recovery routing
+            # upgrade (DEPPY_TPU_REPROBE) observable on a dashboard.
+            from .sat import solver as _solver
+
+            usable = _solver._ENGINE_USABLE
+            if usable is not None:
+                lines += [
+                    "# HELP deppy_auto_engine_usable Auto routing verdict:"
+                    " 1 = tensor engine, 0 = host fallback.",
+                    "# TYPE deppy_auto_engine_usable gauge",
+                    f"deppy_auto_engine_usable {int(usable)}",
+                ]
         return "\n".join(lines) + "\n"
 
 

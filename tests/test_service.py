@@ -388,3 +388,20 @@ def test_stale_verdict_readable_during_reprobe(monkeypatch):
     t.join(10)
     assert sat_solver._ENGINE_USABLE is True
     monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", None)
+
+
+def test_metrics_expose_auto_routing_verdict(monkeypatch, server):
+    from deppy_tpu.sat import solver as sat_solver
+
+    monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", None)
+    status, data = request(server.api_port, "GET", "/metrics")
+    assert status == 200
+    assert b"deppy_auto_engine_usable" not in data  # no verdict yet
+
+    monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", False)
+    _, data = request(server.api_port, "GET", "/metrics")
+    assert b"deppy_auto_engine_usable 0" in data
+
+    monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", True)
+    _, data = request(server.api_port, "GET", "/metrics")
+    assert b"deppy_auto_engine_usable 1" in data
