@@ -32,7 +32,7 @@ from .errors import (
     NotSatisfiable,
 )
 from .host import HostEngine
-from .solver import Solver
+from .solver import Solver, reprobe_engine, resolve_backend
 from .tracer import DefaultTracer, LoggingTracer, SearchPosition, StatsTracer, Tracer
 
 __all__ = [
@@ -55,6 +55,8 @@ __all__ = [
     "SearchPosition",
     "Solver",
     "StatsTracer",
+    "reprobe_engine",
+    "resolve_backend",
     "Tracer",
     "Variable",
     "at_most",
