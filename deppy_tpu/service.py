@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import os
 import socket
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -152,7 +153,18 @@ class Server:
         self.metrics = Metrics()
         self.ready = threading.Event()
         self._stop = threading.Event()
-        self._reprobe_s = float(os.environ.get("DEPPY_TPU_REPROBE", "600"))
+        try:
+            self._reprobe_s = float(
+                os.environ.get("DEPPY_TPU_REPROBE", "600")
+            )
+        except ValueError:
+            # A typo'd env var must degrade to the default, not kill the
+            # server at startup (matches DEPPY_BENCH_SELF_DESTRUCT's
+            # defensive parsing).
+            print("[service] ignoring non-numeric DEPPY_TPU_REPROBE="
+                  f"{os.environ.get('DEPPY_TPU_REPROBE')!r}; using 600",
+                  file=sys.stderr, flush=True)
+            self._reprobe_s = 600.0
         self._api = _make_http_server(
             _parse_addr(bind_address), _api_handler(self)
         )

@@ -71,8 +71,18 @@ def _probe(timeout_s: int) -> dict:
         return {"status": "error", "detail": " | ".join(tail)}
     stages = parse_probe_stages(stdout)
     backend = stages.get("backend", "?")
+    if backend == "?":
+        # rc==0 but no parseable INIT line: the probe ran but its output
+        # is garbage — that's a harness bug or output loss, not evidence
+        # of a CPU-only host.  Classifying it "cpu-only" once made
+        # diagnose() report "no accelerator" for a probe that succeeded.
+        return {
+            "status": "error",
+            "detail": ("probe exited 0 with unparseable output: "
+                       + repr((stdout or "").strip()[-200:])),
+        }
     return {
-        "status": "ok" if backend not in ("cpu", "?") else "cpu-only",
+        "status": "ok" if backend != "cpu" else "cpu-only",
         "backend": backend,
         # True per-stage timings from the probe's own clock (wall time
         # here would also count interpreter start + jax import).
@@ -166,18 +176,27 @@ def diagnose(probe_timeout: int = 120, retries: int = 3,
 
 
 def watch(interval: int = 600, probe_timeout: int = 120,
-          log_path: str = "", until_healthy: bool = False) -> int:
+          log_path: str = "", until_healthy: bool = False,
+          terminal_consecutive: int = 3) -> int:
     """Periodic health monitor: one compute probe per tick, one JSON line
     per result appended to ``log_path`` (and echoed to stderr).  With
     ``until_healthy`` the loop exits 0 at the first fully healthy probe —
     the building block for scripts that wait out a worker outage before
     launching accelerator work (`deppy doctor --watch --until-healthy &&
-    make bench`) — and exits immediately with :func:`diagnose`'s code on
-    a status waiting cannot heal (no accelerator configured: 3,
-    plugin/config failure: 2).  Hang statuses keep waiting; outlasting
-    them is the point of the mode."""
+    make bench`) — and exits with :func:`diagnose`'s code on a status
+    waiting cannot heal (no accelerator configured: 3, plugin/config
+    failure: 2).  Hang statuses keep waiting; outlasting them is the
+    point of the mode.
+
+    Terminal statuses must repeat ``terminal_consecutive`` times IN A ROW
+    before the loop gives up: during a worker flap a single probe can
+    crash (rc!=0 → "error") or catch jax mid-fallback-to-CPU
+    ("cpu-only"), and a mode whose whole purpose is outlasting
+    instability must not abort on one bad sample.  Any non-terminal
+    probe resets the streak."""
     import json
 
+    streak = {"status": None, "n": 0}
     while True:
         r = _probe(probe_timeout)
         rec = {"ts": round(time.time(), 1), **r}
@@ -189,10 +208,15 @@ def watch(interval: int = 600, probe_timeout: int = 120,
         if until_healthy:
             if r["status"] == "ok":
                 return 0
-            if r["status"] == "cpu-only":
-                return 3  # no accelerator will ever appear: fail fast
-            if r["status"] == "error":
-                return 2  # plugin/config failure: waiting cannot heal it
+            if r["status"] in ("cpu-only", "error"):
+                if streak["status"] == r["status"]:
+                    streak["n"] += 1
+                else:
+                    streak.update(status=r["status"], n=1)
+                if streak["n"] >= terminal_consecutive:
+                    return 3 if r["status"] == "cpu-only" else 2
+            else:
+                streak.update(status=None, n=0)
         time.sleep(interval)
 
 
@@ -220,6 +244,11 @@ def add_doctor_args(ap: argparse.ArgumentParser) -> None:
                     help="append watch-mode JSON lines to this file")
     ap.add_argument("--until-healthy", action="store_true",
                     help="watch mode exits 0 at the first healthy probe")
+    ap.add_argument("--terminal-consecutive", type=int,
+                    default=w["terminal_consecutive"],
+                    help="watch mode gives up on error/cpu-only only "
+                    "after this many consecutive probes agree (1 "
+                    "restores fail-fast)")
 
 
 def run_from_args(args) -> int:
@@ -227,7 +256,7 @@ def run_from_args(args) -> int:
     module CLI)."""
     if getattr(args, "watch", False):
         return watch(args.interval, args.probe_timeout, args.log,
-                     args.until_healthy)
+                     args.until_healthy, args.terminal_consecutive)
     return diagnose(args.probe_timeout, args.retries, args.retry_delay)
 
 

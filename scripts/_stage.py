@@ -61,11 +61,21 @@ def emit(rec: dict, log_path: str) -> None:
             f.write(line + "\n")
 
 
-def run_stage(rec: dict, cmd, env, timeout_s: int, log_path: str) -> dict:
+def run_stage(rec: dict, cmd, env, timeout_s: int, log_path: str, *,
+              require_stage_line: bool = True) -> dict:
     """Run one subprocess stage; parse its STAGE line into ``rec``; emit
     and return the record.  A timed-out stage records the partial output
     tail — the line that says WHICH phase hung (run_captured attaches it
-    to the TimeoutExpired for exactly this)."""
+    to the TimeoutExpired for exactly this).
+
+    ``require_stage_line``: with it (the default, for the inline
+    STAGE_SRC snippets) ok=True needs BOTH rc==0 and a fully parsed
+    ``STAGE <backend> <warm> <run> <rate>`` line — a rc==0 stage with no
+    parseable line would otherwise hand ``backend=None`` to callers that
+    pin it as the expected backend (tpu_ab) and poison every later
+    health check.  Stages whose entry points speak a different protocol
+    (the benchmark suite, bench.py) pass False to keep rc-only
+    semantics."""
     from deppy_tpu.utils.platform_env import run_captured
 
     env = dict(env)
@@ -79,12 +89,24 @@ def run_stage(rec: dict, cmd, env, timeout_s: int, log_path: str) -> dict:
         line = next((l for l in (out or "").splitlines()
                      if l.startswith("STAGE")), "")
         parts = line.split()
-        rec.update(ok=rc == 0,
-                   backend=parts[1] if len(parts) > 1 else None,
-                   warm_s=float(parts[2]) if len(parts) > 2 else None,
-                   run_s=float(parts[3]) if len(parts) > 3 else None,
-                   rate=float(parts[4]) if len(parts) > 4 else None)
-        if rc != 0:
+
+        def _num(i):
+            try:
+                return float(parts[i])
+            except (IndexError, ValueError):
+                return None
+
+        parsed = dict(backend=parts[1] if len(parts) > 1 else None,
+                      warm_s=_num(2), run_s=_num(3), rate=_num(4))
+        complete = (parsed["backend"] is not None
+                    and None not in (parsed["warm_s"], parsed["run_s"],
+                                     parsed["rate"]))
+        rec.update(ok=rc == 0 and (complete or not require_stage_line),
+                   **parsed)
+        if rc == 0 and require_stage_line and not complete:
+            rec["tail"] = ("no fully parseable STAGE line in: "
+                           + (out or "").strip()[-300:])
+        elif rc != 0:
             rec["tail"] = ((err or "") + (out or "")).strip()[-400:]
     except subprocess.TimeoutExpired as e:
         rec.update(ok=False, timeout_s=timeout_s,

@@ -40,9 +40,10 @@ def _emit(rec: dict, log_path: str) -> None:
     _emit_line(rec, log_path)
 
 
-def _run_stage(name: str, cmd, env, timeout_s: int, log_path: str) -> dict:
+def _run_stage(name: str, cmd, env, timeout_s: int, log_path: str,
+               **kwargs) -> dict:
     return run_stage({"stage": name, "ts": round(time.time(), 1)},
-                     cmd, env, timeout_s, log_path)
+                     cmd, env, timeout_s, log_path, **kwargs)
 
 
 def main() -> None:
@@ -122,13 +123,14 @@ def main() -> None:
     if not _run_stage("D:suite",
                       [py, "-m", "deppy_tpu.benchmarks.suite",
                        "--out", "/tmp/reval_suite.json"],
-                      env_rest, 2400, a.log)["ok"]:
+                      env_rest, 2400, a.log,
+                      require_stage_line=False)["ok"]:
         return
     if not healthy():
         return
     # E: the driver contract end to end.
     _run_stage("E:bench.py", [py, os.path.join(ROOT, "bench.py")],
-               env_rest, 1800, a.log)
+               env_rest, 1800, a.log, require_stage_line=False)
 
 
 if __name__ == "__main__":

@@ -331,3 +331,50 @@ def test_doctor_watch_until_healthy_logs_json(monkeypatch, tmp_path):
     lines = [json.loads(l) for l in log.read_text().splitlines()]
     assert [l["status"] for l in lines] == ["compute-hang", "ok"]
     assert all("ts" in l for l in lines)
+
+
+def test_doctor_watch_outlasts_transient_terminal_probes(monkeypatch,
+                                                         tmp_path):
+    """A single error/cpu-only probe during a worker flap must not kill
+    watch --until-healthy (its whole purpose is outlasting instability);
+    only N consecutive terminal results are terminal (advisor r3)."""
+    from deppy_tpu.utils import tpu_doctor
+
+    results = iter([
+        {"status": "error", "detail": "transient crash"},
+        {"status": "hang", "detail": "restarting"},     # resets streak
+        {"status": "error", "detail": "crash 1"},
+        {"status": "cpu-only", "backend": "cpu",        # resets streak
+         "init_s": 0.0, "detail": "fallback"},
+        {"status": "ok", "backend": "tpu", "init_s": 1.0, "detail": "x"},
+    ])
+    monkeypatch.setattr(tpu_doctor, "_probe", lambda t: next(results))
+    rc = tpu_doctor.watch(interval=0, probe_timeout=1,
+                          log_path=str(tmp_path / "h.jsonl"),
+                          until_healthy=True, terminal_consecutive=3)
+    assert rc == 0  # reached the healthy probe; never gave up early
+
+
+def test_doctor_watch_gives_up_after_consecutive_errors(monkeypatch):
+    from deppy_tpu.utils import tpu_doctor
+
+    monkeypatch.setattr(
+        tpu_doctor, "_probe",
+        lambda t: {"status": "error", "detail": "plugin broken"})
+    rc = tpu_doctor.watch(interval=0, probe_timeout=1, log_path="",
+                          until_healthy=True, terminal_consecutive=3)
+    assert rc == 2
+
+
+def test_doctor_probe_unparseable_success_is_error(monkeypatch):
+    """rc==0 with no INIT line means the probe harness lost its output —
+    that must read 'error', never 'cpu-only' (which diagnose() would
+    report as 'no accelerator' for a probe that actually succeeded)."""
+    from deppy_tpu.utils import platform_env, tpu_doctor
+
+    monkeypatch.setattr(
+        platform_env, "run_captured",
+        lambda cmd, timeout_s, env=None, cwd=None: (0, "garbage\n", ""))
+    r = tpu_doctor._probe(5)
+    assert r["status"] == "error"
+    assert "unparseable" in r["detail"]

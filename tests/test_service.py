@@ -405,3 +405,15 @@ def test_metrics_expose_auto_routing_verdict(monkeypatch, server):
     monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", True)
     _, data = request(server.api_port, "GET", "/metrics")
     assert b"deppy_auto_engine_usable 1" in data
+
+
+def test_non_numeric_reprobe_env_falls_back(monkeypatch, capsys):
+    """A typo'd DEPPY_TPU_REPROBE must not crash server startup; it
+    degrades to the 600s default with a warning (advisor r3)."""
+    monkeypatch.setenv("DEPPY_TPU_REPROBE", "ten-minutes")
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host")
+    try:
+        assert srv._reprobe_s == 600.0
+    finally:
+        srv.shutdown()
