@@ -451,13 +451,18 @@ HOST_CORE_NCONS = int(os.environ.get("DEPPY_TPU_HOST_CORE_NCONS", "768"))
 # like MAX_LANES: oversized programs are what crash the tunneled worker.
 PROBE_LANES = int(os.environ.get("DEPPY_TPU_PROBE_LANES", "512"))
 
-# Speculative-core policy: "auto" enables it on accelerator backends only.
-# Measured on CPU XLA it LOSES to the host spec sweep (27.6s vs 2.1s on
-# the 1.7k-constraint giant catalog): the vmapped probe fixpoint runs
-# max-over-lanes propagation rounds, and one deep-chain lane drags 512
-# lanes × full clause planes through ~dozens of rounds on one core.  The
-# accelerator bet is bandwidth: the same traffic is a few hundred MB of
-# HBM reads.  "1"/"0" force it on/off (tests force "1" on CPU).
+# Speculative-core policy.  Measured on CPU XLA it LOSES to the host
+# spec sweep (27.6s vs 2.1s on the 1.7k-constraint giant catalog): the
+# vmapped probe fixpoint runs max-over-lanes propagation rounds, and one
+# deep-chain lane drags 512 lanes × full clause planes through ~dozens
+# of rounds on one core.  The accelerator bet is bandwidth — the same
+# traffic is a few hundred MB of HBM reads — but that bet has ZERO
+# accelerator measurements (worker outage, rounds 3-4), and its failure
+# mode on the tunneled worker is the minutes-long-single-execution crash
+# class.  So "auto" resolves to OFF everywhere until a TPU measurement
+# exists (round-3 verdict weak #4): flip auto back to
+# accelerator-enabled only alongside a measured giant-catalog row in
+# BASELINE.md.  "1"/"0" force it on/off (tests force "1" on CPU).
 SPEC_CORE = os.environ.get("DEPPY_TPU_SPEC_CORE", "auto")
 
 # Per-dispatch step budget for the speculative sweep's SEARCH stages
@@ -475,9 +480,10 @@ SPEC_CORE_CAP = int(os.environ.get("DEPPY_TPU_SPEC_CORE_CAP", str(1 << 15)))
 def _spec_core_enabled() -> bool:
     if SPEC_CORE == "1":
         return True
-    if SPEC_CORE == "0":
-        return False
-    return jax.default_backend() != "cpu"
+    # "auto" is currently off on every backend: the accelerator upside is
+    # unmeasured while the downside is a known worker-crash class (see
+    # SPEC_CORE above).
+    return False
 
 
 def _speculative_core_mask(problem, remaining: int):

@@ -188,15 +188,18 @@ def watch(interval: int = 600, probe_timeout: int = 120,
     failure: 2).  Hang statuses keep waiting; outlasting them is the
     point of the mode.
 
-    Terminal statuses must repeat ``terminal_consecutive`` times IN A ROW
-    before the loop gives up: during a worker flap a single probe can
-    crash (rc!=0 → "error") or catch jax mid-fallback-to-CPU
-    ("cpu-only"), and a mode whose whole purpose is outlasting
-    instability must not abort on one bad sample.  Any non-terminal
-    probe resets the streak."""
+    Terminal statuses (error / cpu-only) must accumulate
+    ``terminal_consecutive`` probes IN A ROW before the loop gives up:
+    during a worker flap a single probe can crash (rc!=0 → "error") or
+    catch jax mid-fallback-to-CPU ("cpu-only"), and a mode whose whole
+    purpose is outlasting instability must not abort on one bad sample.
+    The streak is over terminal-ness, not the exact status — a broken
+    plugin that alternates error/cpu-only must still terminate (the
+    exit code follows the last probe) — and any non-terminal probe
+    (hang, compute-hang: the worker exists and may heal) resets it."""
     import json
 
-    streak = {"status": None, "n": 0}
+    terminal_streak = 0
     while True:
         r = _probe(probe_timeout)
         rec = {"ts": round(time.time(), 1), **r}
@@ -209,14 +212,11 @@ def watch(interval: int = 600, probe_timeout: int = 120,
             if r["status"] == "ok":
                 return 0
             if r["status"] in ("cpu-only", "error"):
-                if streak["status"] == r["status"]:
-                    streak["n"] += 1
-                else:
-                    streak.update(status=r["status"], n=1)
-                if streak["n"] >= terminal_consecutive:
+                terminal_streak += 1
+                if terminal_streak >= terminal_consecutive:
                     return 3 if r["status"] == "cpu-only" else 2
             else:
-                streak.update(status=None, n=0)
+                terminal_streak = 0
         time.sleep(interval)
 
 

@@ -10,8 +10,12 @@ health re-probe between stages:
      trigger: the 2026-07-31 outage began at the first compile of a
      cache-enabled run, and A-passes-B-fails would convict it
   C. headline shape at 1024 problems (cache per B's verdict)
-  D. full benchmark suite (``deppy_tpu.benchmarks.suite``)
-  E. the driver contract: ``bench.py`` end to end
+  D. the driver contract: ``bench.py`` end to end — BEFORE the long
+     suite, so a worker that recovers ~30 min before a driver bench
+     window still lands an accelerator bench record in the ladder log
+     (bench.py publishes it and prefers such records over its CPU
+     fallback; see bench.py LADDER_LOG)
+  E. full benchmark suite (``deppy_tpu.benchmarks.suite``)
 
 Aborts at the first failed stage, and whenever the probed backend is no
 longer the one stage A ran on — results taken after a crash (or on a
@@ -117,20 +121,35 @@ def main() -> None:
         return
     if not healthy():
         return
-    # D: full suite; the per-config JSON lines land in the stage log and
-    # the aggregate in /tmp for a human to inspect and commit under
-    # benchmarks/results/ with a backend-correct name.
-    if not _run_stage("D:suite",
-                      [py, "-m", "deppy_tpu.benchmarks.suite",
-                       "--out", "/tmp/reval_suite.json"],
-                      env_rest, 2400, a.log,
+    # D: the driver contract end to end — BEFORE the long suite so a
+    # recent recovery still lands an accelerator bench record quickly.
+    # The record is published into the SAME log this ladder writes
+    # (bench.py's _publish_record honors DEPPY_TPU_REVAL_LOG), which is
+    # the file later bench invocations scan; and bench.py must not arm
+    # a second ladder from inside this one.
+    env_bench = dict(env_rest)
+    if a.log:
+        env_bench["DEPPY_TPU_REVAL_LOG"] = os.path.abspath(a.log)
+    env_bench["DEPPY_BENCH_ARM_LADDER"] = "0"
+    # The ladder just probed healthy, so bench.py's worker-restart retry
+    # budget (4 probes x 150s) is dead weight here; one probe keeps its
+    # worst case (probe + run + re-probe + retry run ≈ 3200s) inside the
+    # stage timeout instead of overshooting it and aborting a healthy
+    # run mid-retry.
+    env_bench["DEPPY_BENCH_PROBE_RETRIES"] = "1"
+    if not _run_stage("D:bench.py", [py, os.path.join(ROOT, "bench.py")],
+                      env_bench, 3400, a.log,
                       require_stage_line=False)["ok"]:
         return
     if not healthy():
         return
-    # E: the driver contract end to end.
-    _run_stage("E:bench.py", [py, os.path.join(ROOT, "bench.py")],
-               env_rest, 1800, a.log, require_stage_line=False)
+    # E: full suite; the per-config JSON lines land in the stage log and
+    # the aggregate in /tmp for a human to inspect and commit under
+    # benchmarks/results/ with a backend-correct name.
+    _run_stage("E:suite",
+               [py, "-m", "deppy_tpu.benchmarks.suite",
+                "--out", "/tmp/reval_suite.json"],
+               env_rest, 2400, a.log, require_stage_line=False)
 
 
 if __name__ == "__main__":

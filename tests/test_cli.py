@@ -378,3 +378,21 @@ def test_doctor_probe_unparseable_success_is_error(monkeypatch):
     r = tpu_doctor._probe(5)
     assert r["status"] == "error"
     assert "unparseable" in r["detail"]
+
+
+def test_doctor_watch_terminates_on_alternating_terminal_statuses(
+        monkeypatch):
+    """A broken plugin that alternates error/cpu-only must still
+    terminate: the streak counts terminal-ness, not the exact status."""
+    from deppy_tpu.utils import tpu_doctor
+
+    results = iter([
+        {"status": "error", "detail": "crash"},
+        {"status": "cpu-only", "backend": "cpu", "init_s": 0.0,
+         "detail": "fallback"},
+        {"status": "error", "detail": "crash"},
+    ])
+    monkeypatch.setattr(tpu_doctor, "_probe", lambda t: next(results))
+    rc = tpu_doctor.watch(interval=0, probe_timeout=1, log_path="",
+                          until_healthy=True, terminal_consecutive=3)
+    assert rc == 2  # exit code follows the last probe's status

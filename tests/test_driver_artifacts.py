@@ -15,7 +15,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_emits_json_and_exits_zero_without_accelerator():
+def test_bench_emits_json_and_exits_zero_without_accelerator(tmp_path):
     """bench.py must print one parseable JSON record and exit 0 even when
     the backend probe fails instantly (simulated via a 1s probe timeout
     on a machine whose TPU tunnel hangs)."""
@@ -32,6 +32,12 @@ def test_bench_emits_json_and_exits_zero_without_accelerator():
     # is what provisions the platform.
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)
+    # Isolate the round-4 ladder plumbing: don't spawn a real detached
+    # revalidation ladder from a unit test, and don't let a machine-level
+    # ladder log's accelerator record replace the CPU fallback this test
+    # asserts on.
+    env["DEPPY_BENCH_ARM_LADDER"] = "0"
+    env["DEPPY_TPU_REVAL_LOG"] = str(tmp_path / "ladder.jsonl")
     out = subprocess.run(
         [sys.executable, "bench.py"],
         cwd=REPO,
@@ -61,3 +67,81 @@ def test_dryrun_multichip_self_provisions_devices():
         graft.dryrun_multichip(4)
     finally:
         sys.path.remove(REPO)
+
+
+def _import_bench():
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    return bench
+
+
+def test_ladder_record_selection(tmp_path, monkeypatch):
+    """_ladder_record returns the NEWEST fresh accelerator record, and
+    skips CPU records, stale records, and garbage lines."""
+    import time
+
+    bench = _import_bench()
+    log = tmp_path / "ladder.jsonl"
+    now = time.time()
+    lines = [
+        "not json at all",
+        json.dumps({"stage": "wait", "ts": now}),
+        json.dumps({"stage": "bench-record", "ts": now,
+                    "record": {"metric": "m", "value": 1.0,
+                               "backend": "cpu"}}),
+        json.dumps({"stage": "bench-record", "ts": now - 99999,
+                    "record": {"metric": "m", "value": 2.0,
+                               "backend": "tpu"}}),
+        json.dumps({"stage": "bench-record", "ts": now - 60,
+                    "record": {"metric": "m", "value": 3.0,
+                               "backend": "tpu"}}),
+    ]
+    log.write_text("\n".join(lines) + "\n")
+    monkeypatch.setattr(bench, "LADDER_LOG", str(log))
+    rec = bench._ladder_record()
+    assert rec is not None
+    assert rec["value"] == 3.0
+    assert rec["source"] == "revalidation-ladder"
+    assert rec["ladder_record_age_s"] >= 60
+
+
+def test_publish_record_roundtrip(tmp_path, monkeypatch):
+    bench = _import_bench()
+    log = tmp_path / "ladder.jsonl"
+    monkeypatch.setattr(bench, "LADDER_LOG", str(log))
+    bench._publish_record({"metric": "m", "value": 1.0, "backend": "cpu"})
+    assert not log.exists()  # CPU records are never published
+    bench._publish_record({"metric": "m", "value": 4.5, "backend": "tpu"})
+    rec = bench._ladder_record()
+    assert rec and rec["value"] == 4.5 and rec["backend"] == "tpu"
+
+
+def test_bench_prefers_fresh_ladder_record(tmp_path):
+    """End to end: with the accelerator down and a fresh ladder-produced
+    device record on disk, bench.py must report THAT record (honestly
+    tagged) instead of re-running on the CPU fallback (verdict r3 #2)."""
+    import time
+
+    log = tmp_path / "ladder.jsonl"
+    log.write_text(json.dumps({
+        "stage": "bench-record", "ts": round(time.time(), 1),
+        "record": {"metric": "catalog resolutions/sec", "value": 9999.0,
+                   "unit": "problems/s", "vs_baseline": 2.0,
+                   "backend": "tpu"}}) + "\n")
+    env = dict(os.environ)
+    env["DEPPY_BENCH_PROBE_TIMEOUT"] = "1"
+    env["DEPPY_BENCH_PROBE_RETRIES"] = "1"
+    env["DEPPY_BENCH_ARM_LADDER"] = "0"
+    env["DEPPY_TPU_REVAL_LOG"] = str(log)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["backend"] == "tpu"
+    assert rec["value"] == 9999.0
+    assert rec["source"] == "revalidation-ladder"

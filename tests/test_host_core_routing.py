@@ -189,3 +189,13 @@ def test_gvk_conflict_core_parity(monkeypatch):
         assert int(a.outcome) == int(b.outcome)
         if int(a.outcome) == core.UNSAT:
             np.testing.assert_array_equal(a.core, b.core)
+
+
+def test_spec_core_auto_defaults_off(monkeypatch):
+    """Round-4 policy pin: auto resolves OFF on every backend until a
+    real accelerator measurement exists (BASELINE.md spec-core note).
+    This must not silently revert to backend-sniffing."""
+    monkeypatch.setattr(driver, "SPEC_CORE", "auto")
+    assert driver._spec_core_enabled() is False
+    monkeypatch.setattr(driver, "SPEC_CORE", "1")
+    assert driver._spec_core_enabled() is True
