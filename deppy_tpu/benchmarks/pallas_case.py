@@ -64,7 +64,8 @@ def _measure(problem, impl: str, repeats: int) -> dict:
     return rec
 
 
-def run(n_packages: int, versions: int, repeats: int) -> list:
+def run(n_packages: int, versions: int, repeats: int,
+        impls: "list | None" = None) -> list:
     import jax
 
     backend = jax.default_backend()
@@ -80,24 +81,28 @@ def run(n_packages: int, versions: int, repeats: int) -> list:
     log(f"padded dims: C={d.C} V={d.V} Wv={d.Wv} -> clause planes "
         f"{vmem_mb:.1f} MiB in VMEM")
 
-    impls = ["bits", "pallas"] if backend == "tpu" else ["bits"]
-    if backend != "tpu":
-        log("pallas requires the TPU backend; measuring bits only")
+    if impls is None:
+        impls = ["bits", "pallas"] if backend == "tpu" else ["bits"]
+        if backend != "tpu":
+            log("pallas requires the TPU backend; measuring bits only")
     out = []
     for impl in impls:
         rec = _measure(problem, impl, repeats)
         print(json.dumps(rec), flush=True)
         out.append(rec)
-    if len(out) == 2:
-        cmp = {
-            "metric": "single giant catalog solve, pallas vs bits",
-            "bits_ms": out[0]["solve_ms"],
-            "pallas_ms": out[1]["solve_ms"],
-            "pallas_speedup": round(out[0]["solve_ms"] / out[1]["solve_ms"], 3),
-            "agree": out[0]["outcome"] == out[1]["outcome"],
-        }
-        print(json.dumps(cmp), flush=True)
-        out.append(cmp)
+    if len(out) >= 2:
+        base = out[0]
+        for rec in out[1:]:
+            cmp = {
+                "metric": (f"single giant catalog solve, {rec['impl']} "
+                           f"vs {base['impl']}"),
+                f"{base['impl']}_ms": base["solve_ms"],
+                f"{rec['impl']}_ms": rec["solve_ms"],
+                "speedup": round(base["solve_ms"] / rec["solve_ms"], 3),
+                "agree": rec["outcome"] == base["outcome"],
+            }
+            print(json.dumps(cmp), flush=True)
+            out.append(cmp)
     return out
 
 
@@ -109,8 +114,15 @@ def main() -> None:
     ap.add_argument("--packages", type=int, default=250)
     ap.add_argument("--versions", type=int, default=8)
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--impls", default="",
+                    help="comma-separated impl list (default: bits,pallas "
+                    "on TPU).  The over-VMEM case is 'bits,blockwise' at "
+                    "--packages 1000+ (clause planes 2-4x the fixpoint "
+                    "kernel's VMEM cap; engine/pallas_blockwise.py)")
     args = ap.parse_args()
-    run(args.packages, args.versions, args.repeats)
+    run(args.packages, args.versions, args.repeats,
+        impls=[s.strip() for s in args.impls.split(",") if s.strip()]
+        or None)
 
 
 if __name__ == "__main__":

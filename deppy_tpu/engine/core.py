@@ -509,13 +509,40 @@ def clear_batched_caches() -> None:
 
 
 def set_bcp_impl(name: str) -> None:
-    """Select the BCP implementation ('auto'|'gather'|'bits'|'pallas') and
-    invalidate compiled solves."""
+    """Select the BCP implementation ('auto'|'gather'|'bits'|'pallas'|
+    'blockwise') and invalidate compiled solves."""
     global _BCP_IMPL
-    if name not in ("auto", "gather", "bits", "pallas"):
+    if name not in ("auto", "gather", "bits", "pallas", "blockwise"):
         raise ValueError(f"unknown BCP impl {name!r}")
     _BCP_IMPL = name
     clear_batched_caches()
+
+
+# Phase-1 search substrate: "xla" = the vmapped lockstep program in this
+# module; "fused" = the whole phase in ONE Pallas kernel per problem
+# (engine/pallas_search.py) — the escalation against the tunneled chip's
+# ~175µs-per-while-trip overhead (BASELINE.md; round-3 verdict #1).
+# "auto" = "xla" until the fused kernel is measured on a real chip: its
+# grid serializes problems, a measured-class loser on CPU XLA, and every
+# device bet in this tree defaults off until a BASELINE.md row exists
+# (scripts/tpu_ab.py carries the A/B variant).
+_SEARCH_IMPL = os.environ.get("DEPPY_TPU_SEARCH", "auto")
+
+
+def set_search_impl(name: str) -> None:
+    """Select the phase-1 search substrate ('auto'|'xla'|'fused') and
+    invalidate compiled solves."""
+    global _SEARCH_IMPL
+    if name not in ("auto", "xla", "fused"):
+        raise ValueError(f"unknown search impl {name!r}")
+    _SEARCH_IMPL = name
+    clear_batched_caches()
+
+
+def _resolved_search_impl() -> str:
+    if _SEARCH_IMPL == "auto":
+        return "xla"
+    return _SEARCH_IMPL
 
 
 def _resolved_impl() -> str:
@@ -612,6 +639,13 @@ def planes_fixpoint(pt: ProblemTensors, t: jax.Array, f: jax.Array,
         from . import pallas_bcp
 
         conflict, t, f = pallas_bcp.bcp_fixpoint(
+            pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f, run,
+        )
+        return conflict | pre_conflict, t, f
+    if impl == "blockwise":
+        from . import pallas_blockwise
+
+        conflict, t, f = pallas_blockwise.bcp_fixpoint(
             pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f, run,
         )
         return conflict | pre_conflict, t, f
@@ -1348,11 +1382,29 @@ def batched_solve(V: int, NCON: int, NV: int, T: int = 0,
 @functools.lru_cache(maxsize=128)
 def batched_search(V: int, NCON: int, NV: int, T: int = 0):
     """Jitted, vmapped phase-1 program (baseline + search); per-lane
-    ``en`` mask gates padding lanes."""
+    ``en`` mask gates padding lanes.  Under ``DEPPY_TPU_SEARCH=fused``
+    (reduced planes, no trace buffer) the returned callable routes
+    supported shapes to the fused Pallas kernel instead, falling back to
+    the XLA program for shapes past the kernel's static-unroll caps."""
     red = phases_reduced()
     fn = functools.partial(search_phase, V=NV if red else V,
                            NCON=NCON, NV=NV, T=T, red=red)
-    return jax.jit(jax.vmap(fn, in_axes=(0, None, 0)))
+    xla_fn = jax.jit(jax.vmap(fn, in_axes=(0, None, 0)))
+    if T == 0 and red and _resolved_search_impl() == "fused":
+        from . import pallas_search
+
+        def dispatch(pts, budget, en):
+            # Mesh-sharded chunks stay on the XLA program: a pallas_call
+            # over a sharded batch would need shard_map plumbing the
+            # fused path doesn't have.
+            sharding = getattr(pts.pos_bits_r, "sharding", None)
+            multi = sharding is not None and len(sharding.device_set) > 1
+            if not multi and pallas_search.fused_supported(pts):
+                return pallas_search.batched_search_fused(pts, budget, en)
+            return xla_fn(pts, budget, en)
+
+        return dispatch
+    return xla_fn
 
 
 @functools.lru_cache(maxsize=128)
@@ -1440,11 +1492,28 @@ def batched_minimize_gated(V: int, NCON: int, NV: int):
     """Phase-2 program gated by the phase-1 ``result`` on device: runs over
     the SAME chunks (and device-resident tensors) as phase 1, so no
     host-side compaction round trip and no re-upload of problem tensors.
-    Non-SAT lanes trip zero loop iterations."""
+    Non-SAT lanes trip zero loop iterations.  Under
+    ``DEPPY_TPU_SEARCH=fused`` supported shapes route to the fused
+    Pallas minimize kernel (same dispatch rules as
+    :func:`batched_search`)."""
     red = phases_reduced()
     fn = functools.partial(_minimize_gated, V=NV if red else V,
                            NCON=NCON, NV=NV, red=red)
-    return jax.jit(jax.vmap(fn, in_axes=(0, 0, 0, 0, None, 0, 0)))
+    xla_fn = jax.jit(jax.vmap(fn, in_axes=(0, 0, 0, 0, None, 0, 0)))
+    if red and _resolved_search_impl() == "fused":
+        from . import pallas_search
+
+        def dispatch(pts, result, model, guessed, budget, steps, en):
+            sharding = getattr(pts.pos_bits_r, "sharding", None)
+            multi = (sharding is not None
+                     and len(sharding.device_set) > 1)
+            if not multi and pallas_search.fused_supported(pts):
+                return pallas_search.batched_minimize_fused(
+                    pts, result, model, guessed, budget, steps, en)
+            return xla_fn(pts, result, model, guessed, budget, steps, en)
+
+        return dispatch
+    return xla_fn
 
 
 def _core_gated(pt, result, budget, steps, en_lanes, *, V, NCON, NV):

@@ -27,7 +27,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from scripts._stage import emit, probe_status, run_stage, solve_stage_src
 
-KNOB_VARS = ("DEPPY_TPU_BCP_UNROLL", "DEPPY_TPU_STAGE1_STEPS")
+KNOB_VARS = ("DEPPY_TPU_BCP_UNROLL", "DEPPY_TPU_STAGE1_STEPS",
+             "DEPPY_TPU_SEARCH")
 
 VARIANTS = [
     ("baseline", {}),
@@ -36,6 +37,12 @@ VARIANTS = [
     ("stage1-96", {"DEPPY_TPU_STAGE1_STEPS": "96"}),
     ("unroll2+stage1-96", {"DEPPY_TPU_BCP_UNROLL": "2",
                            "DEPPY_TPU_STAGE1_STEPS": "96"}),
+    # The round-4 escalation: phase-1 search fused into one Pallas kernel
+    # per problem (engine/pallas_search.py) — eliminates per-while-trip
+    # dispatch overhead entirely at the price of grid-serializing the
+    # batch.  The trip-overhead model predicts a large win on the
+    # tunneled chip; measured-class loser on CPU XLA.
+    ("search-fused", {"DEPPY_TPU_SEARCH": "fused"}),
 ]
 
 

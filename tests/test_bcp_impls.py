@@ -15,7 +15,7 @@ from deppy_tpu.models import random_instance
 from deppy_tpu.sat import at_most, conflict, dependency, mandatory, variable
 from deppy_tpu.sat.encode import encode
 
-IMPLS = ["gather", "bits", "pallas"]
+IMPLS = ["gather", "bits", "pallas", "blockwise"]
 
 
 @pytest.fixture(autouse=True)

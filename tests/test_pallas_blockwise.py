@@ -1,0 +1,130 @@
+"""Blockwise clause-partitioned BCP (engine/pallas_blockwise.py):
+multi-block/multi-sweep behavior the shared impl-equivalence suite
+(test_bcp_impls.py, which covers 'blockwise' at natural block sizes)
+cannot see.  Tiny block_rows force real block partitioning on small
+problems; a cross-block dependency chain forces multiple sweeps."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import jax.numpy as jnp  # noqa: E402
+
+from deppy_tpu.engine import core, driver, pallas_blockwise  # noqa: E402
+from deppy_tpu.models import random_instance  # noqa: E402
+from deppy_tpu.sat import dependency, mandatory, variable  # noqa: E402
+from deppy_tpu.sat.encode import encode  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _restore_impl():
+    yield
+    core.set_bcp_impl("auto")
+
+
+def _planes(variables):
+    p = encode(variables)
+    d = driver._Dims([p], 1)
+    pt = core.ProblemTensors(
+        *[jnp.asarray(x) for x in driver.pad_problem(p, d)]
+    )
+    return p, pt, d
+
+
+def _fixpoint_both(pt, d, block_rows):
+    base = core._base_assignment(pt, d.V, d.NCON)
+    base = core._apply_anchors(pt, base, d.V)
+    t0 = core.pack_mask(base == core.TRUE, d.Wv)
+    f0 = core.pack_mask(base == core.FALSE, d.Wv)
+    card_active = ((pt.card_act_bits & t0) != 0).any(axis=1, keepdims=True)
+    card_n2 = pt.card_n[:, None]
+    no_min = jnp.zeros((1, d.Wv), jnp.int32)
+    args = (pt.pos_bits, pt.neg_bits, pt.card_member_bits, card_active,
+            card_n2, no_min, jnp.int32(0), t0, f0)
+
+    def bits():
+        def cond(s):
+            c, _, _, ch = s
+            return ~c & ch
+
+        def body(s):
+            _, t, f, _ = s
+            return core.round_planes(*args[:7], t, f)
+
+        c, t, f, _ = __import__("jax").lax.while_loop(
+            cond, body, (jnp.bool_(False), t0, f0, jnp.bool_(True)))
+        return bool(c), np.asarray(t), np.asarray(f)
+
+    cb, tb, fb = bits()
+    c2, t2, f2 = pallas_blockwise.bcp_fixpoint(
+        *args, enabled=True, block_rows=block_rows)
+    return (cb, tb, fb), (bool(c2), np.asarray(t2), np.asarray(f2))
+
+
+def test_cross_block_chain_needs_multiple_sweeps():
+    """A dependency chain a0→a1→...→a_k whose clauses land in DIFFERENT
+    blocks: one sweep cannot finish it when later links precede earlier
+    ones in row order, so the outer loop must iterate — and still reach
+    the bits fixpoint exactly."""
+    n = 24
+    vs = [variable("a0", mandatory(), dependency("a1"))]
+    vs += [variable(f"a{i}", dependency(f"a{i + 1}"))
+           for i in range(1, n - 1)]
+    vs += [variable(f"a{n - 1}")]
+    _, pt, d = _planes(vs)
+    for br in (1, 2, 8):
+        (cb, tb, fb), (c2, t2, f2) = _fixpoint_both(pt, d, br)
+        assert cb == c2 is False
+        np.testing.assert_array_equal(tb, t2)
+        np.testing.assert_array_equal(fb, f2)
+
+
+def test_conflict_flag_matches_bits_across_block_sizes():
+    from deppy_tpu.sat import conflict as conflict_c
+
+    vs = [
+        variable("a", mandatory(), dependency("b")),
+        variable("b", conflict_c("c")),
+        variable("c", mandatory()),
+    ]
+    _, pt, d = _planes(vs)
+    for br in (1, 4):
+        (cb, _, _), (c2, _, _) = _fixpoint_both(pt, d, br)
+        assert cb is True and c2 is True
+
+
+def test_full_solve_differential_small_blocks(monkeypatch):
+    """Whole solves through the driver with blockwise forced to tiny
+    blocks: outcomes, installed sets, and cores must equal the bits
+    impl on the benchmark distribution."""
+    monkeypatch.setattr(pallas_blockwise, "BLOCK_ROWS", 4)
+    problems = [encode(random_instance(length=16, seed=s))
+                for s in range(4)] + [
+        encode(random_instance(length=12, seed=s, p_mandatory=0.5,
+                               p_conflict=0.5, n_conflict=3))
+        for s in range(4)
+    ]
+    core.set_bcp_impl("bits")
+    ref = driver.solve_problems(problems)
+    core.set_bcp_impl("blockwise")
+    out = driver.solve_problems(problems)
+    for a, b in zip(ref, out):
+        assert int(a.outcome) == int(b.outcome)
+        np.testing.assert_array_equal(
+            np.asarray(a.installed), np.asarray(b.installed))
+        np.testing.assert_array_equal(
+            np.asarray(a.core), np.asarray(b.core))
+
+
+def test_row_padding_to_block_multiple():
+    """C not divisible by block_rows pads with zero rows (invalid
+    clauses) without changing the fixpoint."""
+    vs = [variable("a", mandatory(), dependency("b")), variable("b")]
+    _, pt, d = _planes(vs)
+    (cb, tb, fb), (c2, t2, f2) = _fixpoint_both(pt, d, 3)
+    assert cb == c2
+    np.testing.assert_array_equal(tb, t2)
+    np.testing.assert_array_equal(fb, f2)

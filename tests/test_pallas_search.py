@@ -1,0 +1,179 @@
+"""Differential tests: fused Pallas phase-1 search vs the XLA program.
+
+The fused kernel (engine/pallas_search.py) re-implements search_phase's
+episode control loop, inlined DPLL, and fixpoints with one-hot indexing
+inside one pallas_call.  Its contract is BIT-IDENTICAL behavior: same
+results, same models, same guessed sets, same step counts — pinned here
+against core.batched_search over the benchmark instance distribution
+(the same three-implementation strategy the BCP kernels use,
+tests/test_bcp_impls.py).  On the CPU mesh the kernel runs in interpret
+mode, so this validates semantics; on-device performance is scripts/
+tpu_ab.py's job.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import jax.numpy as jnp  # noqa: E402
+
+from deppy_tpu.engine import core, driver, pallas_search  # noqa: E402
+from deppy_tpu.models import random_instance  # noqa: E402
+from deppy_tpu.sat.encode import encode  # noqa: E402
+
+
+def _batch(problems):
+    B = len(problems)
+    d = driver._Dims(problems, B)
+    pts = driver.pad_stack(problems, d, d.B, pack=True)
+    en = jnp.asarray(np.arange(d.B) < B)
+    return d, core.ProblemTensors(*[jnp.asarray(x) for x in pts]), en
+
+
+def _xla_search(d, pts, en, budget=1 << 20):
+    fn = core.batched_search(d.V, d.NCON, d.NV, 0)
+    return fn(pts, jnp.int32(budget), en)
+
+
+def _fused_search(pts, en, budget=1 << 20):
+    return pallas_search.batched_search_fused(pts, jnp.int32(budget), en)
+
+
+def _assert_phase1_equal(a, b, n):
+    ra, ga, ma, sa, _, tna = a
+    rb, gb, mb, sb, _, tnb = b
+    np.testing.assert_array_equal(np.asarray(ra)[:n], np.asarray(rb)[:n])
+    np.testing.assert_array_equal(np.asarray(ga)[:n], np.asarray(gb)[:n])
+    np.testing.assert_array_equal(np.asarray(ma)[:n], np.asarray(mb)[:n])
+    np.testing.assert_array_equal(np.asarray(sa)[:n], np.asarray(sb)[:n])
+    np.testing.assert_array_equal(np.asarray(tna)[:n], np.asarray(tnb)[:n])
+
+
+def test_fused_matches_xla_on_benchmark_distribution():
+    problems = [
+        encode(random_instance(length=24, seed=s)) for s in range(8)
+    ] + [
+        encode(random_instance(length=16, seed=s, p_mandatory=0.5,
+                               p_conflict=0.5, n_conflict=4))
+        for s in range(8)
+    ]
+    d, pts, en = _batch(problems)
+    _assert_phase1_equal(
+        _xla_search(d, pts, en), _fused_search(pts, en), len(problems))
+
+
+def test_fused_matches_xla_deep_chains():
+    from deppy_tpu.models import version_pinned_chains
+
+    problems = [encode(version_pinned_chains(depth=6, width=3, seed=s))
+                for s in range(4)]
+    d, pts, en = _batch(problems)
+    _assert_phase1_equal(
+        _xla_search(d, pts, en), _fused_search(pts, en), len(problems))
+
+
+def test_fused_budget_exhaustion_parity():
+    """Identical step accounting implies identical RUNNING cutoffs at a
+    tight budget — the Incomplete contract must not drift between
+    substrates."""
+    problems = [encode(random_instance(length=24, seed=s))
+                for s in range(4)]
+    d, pts, en = _batch(problems)
+    for budget in (1, 3, 17):
+        _assert_phase1_equal(
+            _xla_search(d, pts, en, budget),
+            _fused_search(pts, en, budget), len(problems))
+
+
+def test_fused_padding_lanes_report_running():
+    problems = [encode(random_instance(length=16, seed=0))]
+    d, pts, en = _batch(problems)
+    res = _fused_search(pts, en)
+    outcome = np.asarray(res[0])
+    assert (outcome[1:] == core.RUNNING).all()
+
+
+def test_dispatcher_routes_and_falls_back(monkeypatch):
+    """batched_search returns the fused dispatcher under the knob and the
+    XLA program otherwise; unsupported shapes fall back inside the
+    dispatcher."""
+    problems = [encode(random_instance(length=16, seed=s))
+                for s in range(2)]
+    d, pts, en = _batch(problems)
+    try:
+        core.set_search_impl("fused")
+        fn = core.batched_search(d.V, d.NCON, d.NV, 0)
+        assert not hasattr(fn, "lower")  # python dispatcher, not jitted
+        out = fn(pts, jnp.int32(1 << 20), en)
+        monkeypatch.setattr(pallas_search, "MAX_W", 0)
+        out_fb = fn(pts, jnp.int32(1 << 20), en)
+        _assert_phase1_equal(out, out_fb, len(problems))
+    finally:
+        core.set_search_impl("auto")
+    fn = core.batched_search(d.V, d.NCON, d.NV, 0)
+    assert hasattr(fn, "lower")  # back to the jitted XLA program
+
+
+def _xla_minimize(d, pts, p1, en, budget=1 << 20):
+    fn = core.batched_minimize_gated(d.V, d.NCON, d.NV)
+    return fn(pts, p1[0], p1[2], p1[1], jnp.int32(budget), p1[3], en)
+
+
+def test_fused_minimize_matches_xla():
+    problems = [
+        encode(random_instance(length=24, seed=s)) for s in range(8)
+    ]
+    d, pts, en = _batch(problems)
+    p1 = _xla_search(d, pts, en)
+    a = _xla_minimize(d, pts, p1, en)
+    b = pallas_search.batched_minimize_fused(
+        pts, p1[0], p1[2], p1[1], jnp.int32(1 << 20), p1[3], en)
+    n = len(problems)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x)[:n], np.asarray(y)[:n])
+
+
+def test_fused_end_to_end_matches_host(monkeypatch):
+    """Full resolver stack with the fused substrate: outcomes and
+    installed sets must match the host reference engine exactly — the
+    same oracle the XLA path is held to (tests/test_differential.py)."""
+    from deppy_tpu import sat
+    from deppy_tpu.resolution import BatchResolver
+
+    problems = [random_instance(length=24, seed=s) for s in range(6)] + [
+        random_instance(length=16, seed=s, p_mandatory=0.5,
+                        p_conflict=0.5, n_conflict=4)
+        for s in range(6)
+    ]
+
+    def outcomes(results):
+        out = []
+        for r in results:
+            if isinstance(r, sat.NotSatisfiable):
+                out.append(("unsat", sorted(
+                    (ac.variable.identifier, str(ac))
+                    for ac in r.constraints)))
+            else:
+                out.append(("sat", sorted(
+                    k for k, v in r.items() if v)))
+        return out
+
+    try:
+        core.set_search_impl("fused")
+        fused = outcomes(BatchResolver(backend="tpu").solve(problems))
+    finally:
+        core.set_search_impl("auto")
+    xla = outcomes(BatchResolver(backend="tpu").solve(problems))
+
+    host = []
+    for variables in problems:
+        try:
+            installed = sat.Solver(variables, backend="host").solve()
+            host.append(("sat", sorted(v.identifier for v in installed)))
+        except sat.NotSatisfiable as e:
+            host.append(("unsat", sorted(
+                (ac.variable.identifier, str(ac)) for ac in e.constraints)))
+    assert fused == xla == host
