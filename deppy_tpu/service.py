@@ -80,6 +80,7 @@ class Metrics:
         self.errors = 0
         self.solve_seconds = 0.0
         self.engine_steps = 0
+        self.leader: Optional[bool] = None  # None = election disabled
 
     def observe_batch(self, outcomes: Dict[str, int], seconds: float,
                       steps: int = 0) -> None:
@@ -132,6 +133,13 @@ class Metrics:
                     "# TYPE deppy_auto_engine_usable gauge",
                     f"deppy_auto_engine_usable {int(usable)}",
                 ]
+            if self.leader is not None:
+                lines += [
+                    "# HELP deppy_leader HA election verdict: 1 = holding"
+                    " the lease (serving), 0 = standby.",
+                    "# TYPE deppy_leader gauge",
+                    f"deppy_leader {int(self.leader)}",
+                ]
         return "\n".join(lines) + "\n"
 
 
@@ -146,6 +154,7 @@ class Server:
         backend: str = "auto",
         max_steps: Optional[int] = None,
         max_body_bytes: int = 8 * 1024 * 1024,
+        elector=None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -153,6 +162,19 @@ class Server:
         self.metrics = Metrics()
         self.ready = threading.Event()
         self._stop = threading.Event()
+        # Optional active-passive HA (the reference manager's leader
+        # election, main.go:51,62-69): when DEPPY_HA_LEASE names a Lease,
+        # only the holder reports ready, so a hot-standby pair exposes
+        # exactly one pod through the Service.  Default off — the
+        # stateless resolve API scales active-active without election.
+        if elector is None:
+            from .utils.lease import elector_from_env
+
+            elector = elector_from_env()
+        self.elector = elector
+        if self.elector is not None:
+            self.metrics.leader = False
+            self.elector.on_change = self._on_leader_change
         try:
             self._reprobe_s = float(
                 os.environ.get("DEPPY_TPU_REPROBE", "600")
@@ -213,8 +235,23 @@ class Server:
                                    steps=resolver.last_steps)
         return 200, {"results": rendered}
 
+    def _on_leader_change(self, leading: bool) -> None:
+        self.metrics.leader = leading
+        print(f"[service] HA election: "
+              f"{'acquired lease, serving' if leading else 'standby'}",
+              file=sys.stderr, flush=True)
+
+    def serving(self) -> bool:
+        """Readiness verdict for /readyz: started, and — under HA
+        election — currently holding the lease."""
+        if not self.ready.is_set():
+            return False
+        return self.elector is None or self.elector.is_leader
+
     def start(self) -> None:
         """Start both listeners on daemon threads (non-blocking)."""
+        if self.elector is not None:
+            self.elector.start()
         for srv in (self._api, self._probe):
             t = threading.Thread(target=srv.serve_forever, daemon=True)
             t.start()
@@ -255,6 +292,11 @@ class Server:
     def shutdown(self) -> None:
         self.ready.clear()
         self._stop.set()
+        if self.elector is not None:
+            # Release the lease BEFORE closing the listeners: the standby
+            # flips to ready on its next tick, shrinking the failover
+            # window from lease-expiry to renew-interval.
+            self.elector.stop(release=True)
         for srv in (self._api, self._probe):
             if self._threads:
                 # BaseServer.shutdown blocks forever unless serve_forever is
@@ -363,7 +405,7 @@ def _probe_handler(server: Server):
 
         def do_GET(self):
             if self.path in ("/healthz", "/readyz"):
-                ok = self.path == "/healthz" or server.ready.is_set()
+                ok = self.path == "/healthz" or server.serving()
                 body = b"ok" if ok else b"not ready"
                 self.send_response(200 if ok else 503)
                 self.send_header("Content-Type", "text/plain")

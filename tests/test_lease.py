@@ -1,0 +1,270 @@
+"""Leader election over the coordination API (deppy_tpu/utils/lease.py).
+
+The fake API server below implements exactly the Lease subset the
+elector uses — GET/POST/PUT with resourceVersion optimistic concurrency
+(409 on mismatch, 409 on create-of-existing) — so these tests exercise
+the real protocol including lost races, takeover on expiry, and
+graceful release, without a cluster.  Analog of the reference's
+delegated guarantee: controller-runtime election, main.go:51,62-69.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from deppy_tpu.utils.lease import LeaseConfig, LeaseElector
+
+
+class FakeLeaseAPI:
+    """In-memory coordination.k8s.io/v1 lease store behind real HTTP."""
+
+    def __init__(self):
+        self.store = {}          # name -> lease doc
+        self.rv = 0
+        self.lock = threading.Lock()
+        self.fail = False        # simulate an unreachable/refusing API
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, status, doc=None):
+                body = json.dumps(doc).encode() if doc is not None else b""
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _name(self):
+                return self.path.rstrip("/").split("/")[-1]
+
+            def do_GET(self):
+                with api.lock:
+                    if api.fail:
+                        return self._send(500)
+                    doc = api.store.get(self._name())
+                    if doc is None:
+                        return self._send(404)
+                    return self._send(200, doc)
+
+            def do_POST(self):
+                body = json.loads(
+                    self.rfile.read(int(self.headers["Content-Length"])))
+                name = body["metadata"]["name"]
+                with api.lock:
+                    if api.fail:
+                        return self._send(500)
+                    if name in api.store:
+                        return self._send(409)
+                    api.rv += 1
+                    body["metadata"]["resourceVersion"] = str(api.rv)
+                    api.store[name] = body
+                    return self._send(201, body)
+
+            def do_PUT(self):
+                body = json.loads(
+                    self.rfile.read(int(self.headers["Content-Length"])))
+                name = self._name()
+                with api.lock:
+                    if api.fail:
+                        return self._send(500)
+                    cur = api.store.get(name)
+                    if cur is None:
+                        return self._send(404)
+                    sent_rv = body["metadata"].get("resourceVersion")
+                    cur_rv = cur["metadata"]["resourceVersion"]
+                    if sent_rv is not None and sent_rv != cur_rv:
+                        return self._send(409)
+                    api.rv += 1
+                    body["metadata"]["resourceVersion"] = str(api.rv)
+                    api.store[name] = body
+                    return self._send(200, body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def base(self):
+        return f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def holder(self, name):
+        with self.lock:
+            doc = self.store.get(name)
+            return (doc or {}).get("spec", {}).get("holderIdentity")
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def api():
+    srv = FakeLeaseAPI()
+    yield srv
+    srv.close()
+
+
+def _elector(api, ident, lease_seconds=15):
+    return LeaseElector(LeaseConfig(
+        name="resolver", namespace="ns", identity=ident,
+        api_base=api.base, lease_seconds=lease_seconds))
+
+
+def test_first_elector_acquires_second_stays_standby(api):
+    a = _elector(api, "pod-a")
+    b = _elector(api, "pod-b")
+    assert a.tick() is True
+    assert a.is_leader
+    assert b.tick() is False
+    assert not b.is_leader
+    assert api.holder("resolver") == "pod-a"
+    # Renewal keeps the lease and leadership.
+    assert a.tick() is True
+    assert api.holder("resolver") == "pod-a"
+
+
+def test_release_hands_over_without_waiting_for_expiry(api):
+    a = _elector(api, "pod-a")
+    b = _elector(api, "pod-b")
+    a.tick()
+    b.tick()
+    a.stop(release=True)  # blanks holderIdentity
+    assert not a.is_leader
+    assert api.holder("resolver") == ""
+    assert b.tick() is True  # takeover on the very next tick
+    assert api.holder("resolver") == "pod-b"
+
+
+def test_expired_lease_is_taken_over(api):
+    # 0-second duration: the holder is stale the moment it renews.
+    a = _elector(api, "pod-a", lease_seconds=0)
+    b = _elector(api, "pod-b", lease_seconds=0)
+    assert a.tick() is True
+    assert b.tick() is True  # expiry → takeover, transitions bumped
+    assert api.holder("resolver") == "pod-b"
+    doc = api.store["resolver"]
+    assert doc["spec"]["leaseTransitions"] == 1
+
+
+def test_create_race_loses_cleanly(api):
+    # b creates between a's GET(404) and POST: a's POST 409s → standby.
+    a = _elector(api, "pod-a")
+    b = _elector(api, "pod-b")
+    assert b.tick() is True
+    assert a.tick() is False
+    assert api.holder("resolver") == "pod-b"
+
+
+def test_drain_after_transient_failure_still_hands_over(api):
+    """A transient API error on the final tick clears the LOCAL leader
+    flag while the server-side lease still names us — stop(release=True)
+    must blank the holder anyway, or drains wait out full expiry."""
+    a = _elector(api, "pod-a")
+    b = _elector(api, "pod-b")
+    assert a.tick() is True
+    api.fail = True
+    assert a.tick() is False  # fail-closed: local flag drops
+    api.fail = False
+    a.stop(release=True)      # server still names pod-a; must hand over
+    assert api.holder("resolver") == ""
+    assert b.tick() is True
+
+
+def test_api_failure_fails_closed(api):
+    a = _elector(api, "pod-a")
+    assert a.tick() is True
+    api.fail = True
+    assert a.tick() is False  # cannot renew ⇒ drop leadership now
+    assert not a.is_leader
+    api.fail = False
+    assert a.tick() is True  # and recover on the next good tick
+
+
+def test_background_loop_and_failover(api):
+    a = _elector(api, "pod-a")
+    b = _elector(api, "pod-b")
+    a.config.renew_seconds = b.config.renew_seconds = 0.05
+    a.start()
+    b.start()
+    try:
+        deadline = threading.Event()
+        for _ in range(100):
+            if a.is_leader or b.is_leader:
+                break
+            deadline.wait(0.05)
+        assert a.is_leader != b.is_leader  # exactly one leader
+        leader, standby = (a, b) if a.is_leader else (b, a)
+        leader.stop(release=True)
+        for _ in range(100):
+            if standby.is_leader:
+                break
+            deadline.wait(0.05)
+        assert standby.is_leader
+    finally:
+        a.stop(release=False)
+        b.stop(release=False)
+
+
+def test_readyz_gated_on_leadership(api):
+    """Service integration: under election, only the lease holder serves
+    /readyz 200 — the hot-standby topology's whole contract."""
+    import urllib.request
+
+    from deppy_tpu.service import Server
+
+    a = _elector(api, "pod-a")
+    b = _elector(api, "pod-b")
+    a.config.renew_seconds = b.config.renew_seconds = 0.05
+    sa = Server(bind_address="127.0.0.1:0",
+                probe_address="127.0.0.1:0", elector=a)
+    sb = Server(bind_address="127.0.0.1:0",
+                probe_address="127.0.0.1:0", elector=b)
+
+    def readyz(srv):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.probe_port}/readyz",
+                    timeout=5) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    def metrics_leader(srv):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.api_port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        for line in text.splitlines():
+            if line.startswith("deppy_leader "):
+                return int(line.split()[1])
+        return None
+
+    try:
+        sa.start()
+        sb.start()
+        wait = threading.Event()
+        for _ in range(100):
+            if a.is_leader or b.is_leader:
+                break
+            wait.wait(0.05)
+        leader_srv, standby_srv = (sa, sb) if a.is_leader else (sb, sa)
+        assert readyz(leader_srv) == 200
+        assert readyz(standby_srv) == 503
+        assert metrics_leader(leader_srv) == 1
+        assert metrics_leader(standby_srv) == 0
+        # Drain the leader: the standby must take over.
+        leader_srv.shutdown()
+        for _ in range(100):
+            if standby_srv.serving():
+                break
+            wait.wait(0.05)
+        assert readyz(standby_srv) == 200
+    finally:
+        sa.shutdown() if sa._threads else None
+        sb.shutdown() if sb._threads else None
