@@ -20,9 +20,11 @@ Both scale to multi-host DCN fleets via ``jax.distributed`` initialization.
 """
 
 from .clause_shard import clause_mesh, solve_one_sharded, solve_sharded
-from .mesh import BATCH_AXIS, default_mesh, initialize_distributed, shard_batch
+from .mesh import (BATCH_AXIS, default_mesh, initialize_distributed,
+                   replicated_sharding, shard_batch)
 
 __all__ = [
-    "BATCH_AXIS", "default_mesh", "initialize_distributed", "shard_batch",
+    "BATCH_AXIS", "default_mesh", "initialize_distributed",
+    "replicated_sharding", "shard_batch",
     "clause_mesh", "solve_one_sharded", "solve_sharded",
 ]

@@ -12,6 +12,7 @@ the communication topology, and XLA inserts the transfers.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -46,12 +47,32 @@ def shard_batch(mesh: Mesh, tree):
     """Device-put every leaf of a stacked problem pytree with its batch axis
     sharded over the mesh.  Scalars-per-problem (rank-1 leaves) shard too;
     the batch size must divide evenly (the driver pads to a multiple of the
-    mesh size)."""
+    mesh size).
+
+    Works on multi-process meshes too: when the sharding spans devices
+    this process cannot address (a ``jax.distributed`` fleet),
+    ``device_put`` of a host array is illegal, so each process instead
+    contributes only its addressable shards via
+    ``make_array_from_callback`` — every process holds the same full
+    host-side batch (the deterministic build happens everywhere), and
+    the callback slices out the local pieces."""
     def put(leaf):
         arr = np.asarray(leaf)
-        return jax.device_put(arr, batch_sharding(mesh, arr.ndim))
+        sharding = batch_sharding(mesh, arr.ndim)
+        if sharding.is_fully_addressable:
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
 
     return jax.tree_util.tree_map(put, tree)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated output sharding: jitting a batched solve with this
+    as ``out_shardings`` makes XLA all-gather the (small) result tensors,
+    so every process of a multi-host fleet can ``device_get`` the global
+    outcome without a host-side gather step."""
+    return NamedSharding(mesh, PartitionSpec())
 
 
 def initialize_distributed(**kwargs) -> None:
@@ -74,4 +95,13 @@ def initialize_distributed(**kwargs) -> None:
             detected = False
         if not detected:
             return  # plain single-process launch: nothing to initialize
+    if (os.environ.get("JAX_PLATFORMS") or "").strip() == "cpu":
+        # Cross-process collectives on XLA:CPU need an explicit transport
+        # (TPU fleets ride ICI/DCN natively); without this the first
+        # collective hangs.  Gloo ships with jaxlib; config name guarded
+        # so a jax that drops the option degrades to its own default.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
     jax.distributed.initialize(**kwargs)
