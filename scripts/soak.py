@@ -86,6 +86,12 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--shard-every", type=int, default=10,
                     help="also run the clause-sharded path every N cases")
+    ap.add_argument("--fused-every", type=int, default=5,
+                    help="also run the fused Pallas search substrate "
+                    "(DEPPY_TPU_SEARCH=fused) on every Nth case, in one "
+                    "batched pass after the sweep (flipping the substrate "
+                    "per case would recompile everything each flip); 0 "
+                    "disables")
     args = ap.parse_args()
 
     _force_cpu()
@@ -96,6 +102,7 @@ def main() -> int:
     mesh = clause_mesh()
     t0 = time.time()
     counts = {"sat": 0, "unsat": 0, "incomplete": 0}
+    fused_queue = []  # (case, desc, vs, host outcome) for the fused pass
     for case in range(args.cases):
         desc, vs = _generate(rng)
         host = _outcome(lambda: sat.Solver(vs, backend="host").solve())
@@ -110,6 +117,8 @@ def main() -> int:
                 print(f"DIVERGENCE (host vs sharded) at case {case}: {desc}\n"
                       f"  host:    {host}\n  sharded: {sharded}", flush=True)
                 return 1
+        if args.fused_every and case % args.fused_every == 0:
+            fused_queue.append((case, desc, vs, host))
         counts[host[0]] += 1
         # Random shapes accumulate one executable per padded signature;
         # reset periodically so a long soak doesn't OOM the compiler
@@ -123,6 +132,27 @@ def main() -> int:
                   f"({counts['sat']} sat / {counts['unsat']} unsat / "
                   f"{counts['incomplete']} incomplete, "
                   f"{time.time() - t0:.0f}s)", flush=True)
+    if fused_queue:
+        # One substrate flip for the whole pass: set_search_impl clears
+        # the compiled-solve caches, so per-case flipping would pay a
+        # full recompile per case.
+        from deppy_tpu.engine import clear_compile_caches, core
+
+        clear_compile_caches()
+        core.set_search_impl("fused")
+        try:
+            for case, desc, vs, host in fused_queue:
+                fused = _outcome(
+                    lambda: sat.Solver(vs, backend="tpu").solve())
+                if host != fused:
+                    print(f"DIVERGENCE (host vs fused) at case {case}: "
+                          f"{desc}\n  host:  {host}\n  fused: {fused}",
+                          flush=True)
+                    return 1
+            print(f"fused pass clean: {len(fused_queue)} cases "
+                  f"({time.time() - t0:.0f}s total)", flush=True)
+        finally:
+            core.set_search_impl("auto")
     print(f"soak clean: {args.cases} cases, {counts}", flush=True)
     return 0
 
