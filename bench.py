@@ -220,11 +220,15 @@ def _arm_ladder() -> None:
 
 
 def _publish_record(rec: dict) -> None:
-    """Append an accelerator bench record to the ladder log (one JSON
-    line, same stream the ladder stages write)."""
+    """Append a bench record to the ladder log (one JSON line, same
+    stream the ladder stages write).  CPU records are published too —
+    the ladder's stage-D output would otherwise vanish on success
+    (run_stage keeps child stdout only on failure) — but
+    ``_ladder_record`` never PREFERS them: a cpu-backend record can't
+    stand in for a device record."""
     import time
 
-    if rec.get("backend") in (None, "cpu", "none"):
+    if rec.get("backend") in (None, "none"):
         return
     try:
         with open(LADDER_LOG, "a") as f:
@@ -260,9 +264,14 @@ def _ladder_record() -> dict | None:
             age = time.time() - float(entry.get("ts", 0))
         except (TypeError, ValueError):
             continue  # one bad ts in a shared /tmp log must not abort
+        # -1s tolerance: _publish_record rounds ts to 0.1s, which can
+        # land up to 50ms in the future — a freshly published record
+        # must not be rejected as "from the future" (observed: a
+        # publish-then-read within the same 100ms window).  Anything
+        # further future-dated than a second is still treated as bogus.
         if (isinstance(rec, dict) and "value" in rec
                 and rec.get("backend") not in (None, "cpu", "none")
-                and 0 <= age <= LADDER_FRESH_S):
+                and -1 <= age <= LADDER_FRESH_S):
             rec = dict(rec)
             rec["source"] = "revalidation-ladder"
             rec["ladder_record_age_s"] = round(age, 1)
@@ -298,9 +307,6 @@ def main() -> int:
             if rec is None:
                 _arm_ladder()
         used = backend
-    if rec is not None and used and used != "cpu":
-        rec.setdefault("backend", used)
-        _publish_record(rec)
     if rec is None:
         ladder = _ladder_record()
         if ladder is not None:
@@ -322,6 +328,11 @@ def main() -> int:
         }
         used = "none"
     rec.setdefault("backend", used)
+    # One publish point for every produced record (accelerator AND the
+    # CPU fallback — the ladder's stage D would otherwise leave no trace
+    # of a successful CPU bench); "none" error records are filtered
+    # inside.
+    _publish_record(rec)
     print(json.dumps(rec), flush=True)
     return 0
 

@@ -64,8 +64,14 @@ def _measure(problem, impl: str, repeats: int) -> dict:
     return rec
 
 
+def _append_log(rec: dict, log_path: str) -> None:
+    if log_path:
+        with open(log_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
 def run(n_packages: int, versions: int, repeats: int,
-        impls: "list | None" = None) -> list:
+        impls: "list | None" = None, log_path: str = "") -> list:
     import jax
 
     backend = jax.default_backend()
@@ -89,6 +95,11 @@ def run(n_packages: int, versions: int, repeats: int,
     for impl in impls:
         rec = _measure(problem, impl, repeats)
         print(json.dumps(rec), flush=True)
+        # Per-record, not end-of-run: a later (riskier) impl wedging the
+        # worker must not cost the safe measurement already completed —
+        # the same reason the revalidation ladder orders its stages
+        # safest-first.
+        _append_log(rec, log_path)
         out.append(rec)
     if len(out) >= 2:
         base = out[0]
@@ -102,6 +113,7 @@ def run(n_packages: int, versions: int, repeats: int,
                 "agree": rec["outcome"] == base["outcome"],
             }
             print(json.dumps(cmp), flush=True)
+            _append_log(cmp, log_path)
             out.append(cmp)
     return out
 
@@ -119,10 +131,14 @@ def main() -> None:
                     "on TPU).  The over-VMEM case is 'bits,blockwise' at "
                     "--packages 1000+ (clause planes 2-4x the fixpoint "
                     "kernel's VMEM cap; engine/pallas_blockwise.py)")
+    ap.add_argument("--log", default="",
+                    help="also append each record as a JSON line here "
+                    "(the revalidation ladder passes its own log so the "
+                    "measurement survives the stage)")
     args = ap.parse_args()
     run(args.packages, args.versions, args.repeats,
         impls=[s.strip() for s in args.impls.split(",") if s.strip()]
-        or None)
+        or None, log_path=args.log)
 
 
 if __name__ == "__main__":

@@ -62,7 +62,8 @@ def emit(rec: dict, log_path: str) -> None:
 
 
 def run_stage(rec: dict, cmd, env, timeout_s: int, log_path: str, *,
-              require_stage_line: bool = True) -> dict:
+              require_stage_line: bool = True,
+              capture_prefixes: tuple = ()) -> dict:
     """Run one subprocess stage; parse its STAGE line into ``rec``; emit
     and return the record.  A timed-out stage records the partial output
     tail — the line that says WHICH phase hung (run_captured attaches it
@@ -75,7 +76,13 @@ def run_stage(rec: dict, cmd, env, timeout_s: int, log_path: str, *,
     pin it as the expected backend (tpu_ab) and poison every later
     health check.  Stages whose entry points speak a different protocol
     (the benchmark suite, bench.py) pass False to keep rc-only
-    semantics."""
+    semantics.
+
+    ``capture_prefixes``: extra stdout line prefixes to copy into the
+    record (lowercased prefix -> first matching line's remainder), for
+    stages that report a result fingerprint alongside the STAGE timing
+    (e.g. spec_core_ab's ``CORE`` line carrying the rendered unsat
+    core)."""
     from deppy_tpu.utils.platform_env import run_captured
 
     env = dict(env)
@@ -89,6 +96,11 @@ def run_stage(rec: dict, cmd, env, timeout_s: int, log_path: str, *,
         line = next((l for l in (out or "").splitlines()
                      if l.startswith("STAGE")), "")
         parts = line.split()
+        for prefix in capture_prefixes:
+            hit = next((l for l in (out or "").splitlines()
+                        if l.startswith(prefix + " ")), None)
+            if hit is not None:
+                rec[prefix.lower()] = hit[len(prefix) + 1:].strip()
 
         def _num(i):
             try:
@@ -103,6 +115,15 @@ def run_stage(rec: dict, cmd, env, timeout_s: int, log_path: str, *,
                                      parsed["rate"]))
         rec.update(ok=rc == 0 and (complete or not require_stage_line),
                    **parsed)
+        if rc == 0 and not require_stage_line:
+            # Protocol-free stages (bench.py, the suite, the A/B
+            # children) report their result as their final stdout line;
+            # without this it would vanish on success (stdout is only
+            # kept on failure) and a green ladder log would carry no
+            # evidence of WHAT the stage measured.
+            lines = [l for l in (out or "").splitlines() if l.strip()]
+            if lines:
+                rec["last_line"] = lines[-1][-400:]
         if rc == 0 and require_stage_line and not complete:
             rec["tail"] = ("no fully parseable STAGE line in: "
                            + (out or "").strip()[-300:])
@@ -121,3 +142,26 @@ def probe_status(probe_timeout: int) -> dict:
     from deppy_tpu.utils.tpu_doctor import _probe
 
     return _probe(probe_timeout)
+
+
+def make_healthy(probe_timeout: int, allow_cpu: bool, expected: list,
+                 log_path: str):
+    """The between-steps health gate shared by tpu_ab, spec_core_ab and
+    lane_probe (this module exists so the harnesses cannot drift): probe
+    the backend, require 'ok' (or 'cpu-only' when ``allow_cpu``), and —
+    once the caller pins ``expected[0]`` from its first successful step —
+    require the SAME backend on every later probe.  A worker dying
+    mid-sweep flips probes to cpu-only; without the pin the remaining
+    steps would silently measure CPU and report it as device data."""
+    def healthy() -> bool:
+        r = probe_status(probe_timeout)
+        acceptable = ("ok", "cpu-only") if allow_cpu else ("ok",)
+        ok = (r["status"] in acceptable
+              and (expected[0] is None or r.get("backend") == expected[0]))
+        if not ok:
+            emit({"abort": "worker unhealthy, cpu-only without "
+                  "--allow-cpu, or backend changed",
+                  "probe": r, "expected": expected[0]}, log_path)
+        return ok
+
+    return healthy

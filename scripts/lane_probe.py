@@ -28,12 +28,14 @@ before the next scheduled benchmark), not right before one.
 from __future__ import annotations
 
 import argparse
-import json
+import os
 import subprocess
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._stage import emit, make_healthy  # noqa: E402
 
 STEP_SRC = """
 import os, signal, time
@@ -56,35 +58,35 @@ os._exit(0)
 """
 
 
-def _healthy(timeout_s: int) -> bool:
-    from deppy_tpu.utils.tpu_doctor import _probe
-
-    # cpu-only counts: a forced-CPU run of this sweep (smoke tests, lane
-    # policy on CPU XLA) has no worker to wedge.
-    return _probe(timeout_s)["status"] in ("ok", "cpu-only")
-
-
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--widths", default="512,1024,2048,4096")
     ap.add_argument("--lengths", default="24,48")
     ap.add_argument("--step-timeout", type=int, default=420)
     ap.add_argument("--probe-timeout", type=int, default=120)
+    ap.add_argument("--log", default="",
+                    help="also append each JSON line to this file (the "
+                    "revalidation ladder passes its own log so the "
+                    "lane verdict survives the stage)")
     a = ap.parse_args()
-
-    import os
 
     from deppy_tpu.utils.platform_env import run_captured
 
     widths = [int(w) for w in a.widths.split(",")]
     lengths = [int(s) for s in a.lengths.split(",")]
+    any_ok = [False]
+    # Backend pin (cpu-only acceptance covers forced-CPU smoke runs of
+    # the sweep): after a boundary crash the next disposable subprocess
+    # would silently fall back to CPU and report widths as "passed" that
+    # the device never ran — the pin makes the flip an abort instead.
+    expected = [None]
+    healthy = make_healthy(a.probe_timeout, True, expected, a.log)
     for width in widths:           # escalate width, small shape first
         for length in sorted(lengths):
-            if not _healthy(a.probe_timeout):
-                print(json.dumps({"abort": "worker unhealthy", "before":
-                                  {"width": width, "length": length}}),
-                      flush=True)
-                return
+            if not healthy():
+                # Nonzero so rc-reading callers (ladder stage I) see an
+                # aborted sweep as a failure, not a green stage.
+                sys.exit(1)
             env = dict(os.environ)
             env["DEPPY_TPU_MAX_LANES"] = str(width)
             rec = {"width": width, "length": length}
@@ -94,7 +96,11 @@ def main() -> None:
                     [sys.executable, "-c",
                      STEP_SRC.format(alarm=a.step_timeout + 30,
                                      length=length, width=width)],
-                    timeout_s=a.step_timeout, env=env, cwd=".",
+                    timeout_s=a.step_timeout, env=env,
+                    # ROOT, not ".": the subprocess needs deppy_tpu
+                    # importable regardless of the operator's cwd.
+                    cwd=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
                 )
                 line = next((l for l in (out or "").splitlines()
                              if l.startswith("STEP")), "")
@@ -111,12 +117,29 @@ def main() -> None:
             except subprocess.TimeoutExpired:
                 rec.update(ok=False, timeout_s=a.step_timeout)
             rec["wall_s"] = round(time.time() - t0, 1)
-            print(json.dumps(rec), flush=True)
+            emit(rec, a.log)
+            if rec["ok"]:
+                if expected[0] is None:
+                    expected[0] = rec["backend"]
+                elif rec["backend"] != expected[0]:
+                    # The step subprocess itself fell back (e.g. PJRT
+                    # init failed post-crash while the probe cached a
+                    # healthier verdict): its numbers are for the wrong
+                    # backend — abort rather than record them as passed.
+                    emit({"abort": "step backend flipped", "got":
+                          rec["backend"], "expected": expected[0]}, a.log)
+                    sys.exit(1)
+                any_ok[0] = True
             if not rec["ok"]:
-                print(json.dumps({"abort": "step failed; stopping sweep "
-                                  "before burying the worker deeper"}),
-                      flush=True)
-                return
+                emit({"abort": "step failed; stopping sweep "
+                      "before burying the worker deeper"}, a.log)
+                # A boundary crash is this probe's EXPECTED terminal
+                # outcome and still a completed sweep from the ladder's
+                # point of view (stage I runs last for exactly this), but
+                # rc must still distinguish "measured up to the boundary"
+                # from "measured nothing": exit 0 only if at least one
+                # step succeeded.
+                sys.exit(0 if any_ok[0] else 1)
 
 
 if __name__ == "__main__":

@@ -25,24 +25,28 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from scripts._stage import emit, probe_status, run_stage, solve_stage_src
+from scripts._stage import emit, make_healthy, run_stage, solve_stage_src
 
 KNOB_VARS = ("DEPPY_TPU_BCP_UNROLL", "DEPPY_TPU_STAGE1_STEPS",
              "DEPPY_TPU_SEARCH")
 
+# (name, knobs, tpu_only): tpu_only variants are SKIPPED when the pinned
+# backend is cpu — search-fused there runs the Pallas kernel in
+# interpret mode, which measures nothing about CPU XLA and takes long
+# enough to blow the step timeout (killing the rest of a smoke ladder).
 VARIANTS = [
-    ("baseline", {}),
-    ("unroll2", {"DEPPY_TPU_BCP_UNROLL": "2"}),
-    ("unroll4", {"DEPPY_TPU_BCP_UNROLL": "4"}),
-    ("stage1-96", {"DEPPY_TPU_STAGE1_STEPS": "96"}),
+    ("baseline", {}, False),
+    ("unroll2", {"DEPPY_TPU_BCP_UNROLL": "2"}, False),
+    ("unroll4", {"DEPPY_TPU_BCP_UNROLL": "4"}, False),
+    ("stage1-96", {"DEPPY_TPU_STAGE1_STEPS": "96"}, False),
     ("unroll2+stage1-96", {"DEPPY_TPU_BCP_UNROLL": "2",
-                           "DEPPY_TPU_STAGE1_STEPS": "96"}),
+                           "DEPPY_TPU_STAGE1_STEPS": "96"}, False),
     # The round-4 escalation: phase-1 search fused into one Pallas kernel
     # per problem (engine/pallas_search.py) — eliminates per-while-trip
     # dispatch overhead entirely at the price of grid-serializing the
     # batch.  The trip-overhead model predicts a large win on the
     # tunneled chip; measured-class loser on CPU XLA.
-    ("search-fused", {"DEPPY_TPU_SEARCH": "fused"}),
+    ("search-fused", {"DEPPY_TPU_SEARCH": "fused"}, True),
 ]
 
 
@@ -58,23 +62,22 @@ def main() -> None:
     a = ap.parse_args()
 
     expected = [None]
-
-    def healthy() -> bool:
-        r = probe_status(a.probe_timeout)
-        acceptable = ("ok", "cpu-only") if a.allow_cpu else ("ok",)
-        ok = (r["status"] in acceptable
-              and (expected[0] is None or r.get("backend") == expected[0]))
-        if not ok:
-            emit({"abort": "worker unhealthy, cpu-only without "
-                  "--allow-cpu, or backend changed",
-                  "probe": r, "expected": expected[0]}, a.log)
-        return ok
+    healthy = make_healthy(a.probe_timeout, a.allow_cpu, expected, a.log)
 
     src = solve_stage_src(alarm=a.step_timeout + 30, length=48,
                           count=a.count, reps=3)
-    for name, knobs in VARIANTS:
+    for name, knobs, tpu_only in VARIANTS:
+        if tpu_only and expected[0] == "cpu":
+            emit({"variant": name, "skipped":
+                  "tpu-only variant on a cpu backend (interpret-mode "
+                  "pallas measures nothing and can blow the timeout)"},
+                 a.log)
+            continue
         if not healthy():
-            return
+            # Nonzero so callers that read rc (the revalidation ladder's
+            # stage F runs with require_stage_line=False, where ok is
+            # rc==0) see an aborted A/B as a failure, not a green stage.
+            sys.exit(1)
         env = dict(os.environ)
         for k in KNOB_VARS:
             # A leftover exported knob would contaminate every variant
@@ -88,7 +91,7 @@ def main() -> None:
         if not rec["ok"]:
             emit({"abort": "variant failed; stopping before burying the "
                   "worker"}, a.log)
-            return
+            sys.exit(1)
         if expected[0] is None:
             expected[0] = rec["backend"]
 

@@ -16,6 +16,14 @@ health re-probe between stages:
      (bench.py publishes it and prefers such records over its CPU
      fallback; see bench.py LADDER_LOG)
   E. full benchmark suite (``deppy_tpu.benchmarks.suite``)
+  F. the trip-overhead A/B queue (``scripts/tpu_ab.py``: unroll /
+     stage1 / search-fused)
+  G. blockwise over-VMEM single-problem case (``pallas_case
+     --packages 1000 --impls bits,blockwise``)
+  H. speculative-core A/B (``scripts/spec_core_ab.py``)
+  I. lane-width boundary probe (``scripts/lane_probe.py``) — LAST:
+     expected to crash the worker at the boundary, so it runs only
+     after every safe measurement is on disk.
 
 Aborts at the first failed stage, and whenever the probed backend is no
 longer the one stage A ran on — results taken after a crash (or on a
@@ -146,10 +154,86 @@ def main() -> None:
     # E: full suite; the per-config JSON lines land in the stage log and
     # the aggregate in /tmp for a human to inspect and commit under
     # benchmarks/results/ with a backend-correct name.
-    _run_stage("E:suite",
-               [py, "-m", "deppy_tpu.benchmarks.suite",
-                "--out", "/tmp/reval_suite.json"],
-               env_rest, 2400, a.log, require_stage_line=False)
+    if not _run_stage("E:suite",
+                      [py, "-m", "deppy_tpu.benchmarks.suite",
+                       "--out", "/tmp/reval_suite.json"],
+                      env_rest, 2400, a.log,
+                      require_stage_line=False)["ok"]:
+        return
+    if not healthy():
+        return
+    # F-I: the round-4 recovery measurement queue (verdict items 1,3,4,5)
+    # — everything the round needs from a healed worker, captured without
+    # a human in the loop, ordered safest-first so the known-crash-risk
+    # probes cannot cost the safe measurements.  Each child script runs
+    # its own between-step health probes and writes into THIS log.
+    log_args = ["--log", os.path.abspath(a.log)] if a.log else []
+    # The ladder's forced-CPU smoke path (ladder_backend == "cpu", see
+    # healthy()) must exercise the F-I plumbing too: the A/B children
+    # need --allow-cpu there (they rightly refuse silent CPU runs
+    # otherwise), and G swaps the TPU workload for a small bits-only
+    # smoke — interpret-mode blockwise at 1000 packages would run for
+    # hours and measure nothing.
+    smoke = ladder_backend[0] == "cpu"
+    cpu_args = ["--allow-cpu"] if smoke else []
+    # F: the trip-overhead A/B queue (unroll/stage1/search-fused).
+    # Smoke shrinks the count like G/H/I shrink theirs: the full
+    # 1024×best-of-3 per variant exists to measure the device, not to
+    # exercise plumbing, and a slow CPU box could blow the per-variant
+    # timeout and kill the tail this smoke exists to cover.
+    f_shape = (["--count", "256"] if smoke else [])
+    if not _run_stage("F:tpu-ab",
+                      [py, os.path.join(ROOT, "scripts", "tpu_ab.py"),
+                       *f_shape, *log_args, *cpu_args],
+                      env_rest, 5400, a.log,
+                      require_stage_line=False)["ok"]:
+        return
+    if not healthy():
+        return
+    # G: blockwise over-VMEM single-problem case (bits must stream
+    # planes from HBM each round at this scale; blockwise's bet is that
+    # per-block local fixpoints win there).
+    g_shape = (["--packages", "120", "--repeats", "1",
+                "--impls", "bits"] if smoke else
+               ["--packages", "1000", "--repeats", "2",
+                "--impls", "bits,blockwise"])
+    if not _run_stage("G:blockwise-overvmem",
+                      [py, "-m", "deppy_tpu.benchmarks.pallas_case",
+                       *g_shape, *log_args],
+                      env_rest, 3000, a.log,
+                      require_stage_line=False)["ok"]:
+        return
+    if not healthy():
+        return
+    # H: speculative-core A/B on the giant-pinned-conflict catalog —
+    # the measurement DEPPY_TPU_SPEC_CORE's auto default is waiting on.
+    # Known crash-risk class (minutes-long single executions), hence
+    # after F/G.
+    h_shape = (["--packages", "40", "--versions", "4"] if smoke else [])
+    if not _run_stage("H:spec-core-ab",
+                      [py, os.path.join(ROOT, "scripts",
+                                        "spec_core_ab.py"),
+                       *h_shape, *log_args, *cpu_args],
+                      env_rest, 2400, a.log,
+                      require_stage_line=False)["ok"]:
+        return
+    # I: lane-width boundary probe — LAST, per its own CAUTION: it is
+    # EXPECTED to crash the worker at the boundary, and everything worth
+    # protecting is already on disk by now.  No healthy() gate after.
+    i_shape = (["--widths", "8,16", "--lengths", "8"] if smoke else [])
+    rec_i = _run_stage("I:lane-probe",
+                       [py, os.path.join(ROOT, "scripts", "lane_probe.py"),
+                        *i_shape, *log_args],
+                       env_rest, 5400, a.log, require_stage_line=False)
+    # ladder-complete is a CONTRACT line (BASELINE.md: "a green
+    # ladder-complete line means every measurement actually landed") —
+    # a lane probe that measured nothing (rc!=0: aborted before any
+    # step, or backend flip) must not produce it.  lane_probe itself
+    # exits 0 when it measured up to a crashed boundary, which IS a
+    # landed verdict.
+    if rec_i["ok"]:
+        _emit({"stage": "ladder-complete", "ts": round(time.time(), 1)},
+              a.log)
 
 
 if __name__ == "__main__":

@@ -112,8 +112,14 @@ def test_publish_record_roundtrip(tmp_path, monkeypatch):
     bench = _import_bench()
     log = tmp_path / "ladder.jsonl"
     monkeypatch.setattr(bench, "LADDER_LOG", str(log))
+    bench._publish_record({"metric": "m", "value": 1.0, "backend": "none"})
+    assert not log.exists()  # error records are never published
+    # CPU records ARE published (the ladder's stage-D trace) but never
+    # PREFERRED: _ladder_record must keep returning None over a
+    # cpu-backend record.
     bench._publish_record({"metric": "m", "value": 1.0, "backend": "cpu"})
-    assert not log.exists()  # CPU records are never published
+    assert log.exists()
+    assert bench._ladder_record() is None
     bench._publish_record({"metric": "m", "value": 4.5, "backend": "tpu"})
     rec = bench._ladder_record()
     assert rec and rec["value"] == 4.5 and rec["backend"] == "tpu"

@@ -85,11 +85,35 @@ def test_run_stage_protocol_free_entry_point_ok(tmp_path):
     require_stage_line=False rc==0 alone is success."""
     rec = _stage.run_stage(
         {"stage": "t"},
-        [sys.executable, "-c", "print('{}')"],
+        [sys.executable, "-c", "print('noise'); print('{\"value\": 3}')"],
         dict(os.environ), 30, str(tmp_path / "log.jsonl"),
         require_stage_line=False)
     assert rec["ok"] is True
     assert rec["backend"] is None
+    # The stage's final stdout line survives into the record — the only
+    # trace a successful protocol-free stage leaves.
+    assert rec["last_line"] == '{"value": 3}'
+
+
+def test_run_stage_capture_prefixes(tmp_path):
+    """Stages that report a result fingerprint alongside timing (e.g.
+    spec_core_ab's CORE line) get it copied into the record."""
+    rec = _stage.run_stage(
+        {"stage": "t"},
+        [sys.executable, "-c",
+         "print('CORE the-rendered-core'); print('STAGE cpu 1 0.5 2.0')"],
+        dict(os.environ), 30, str(tmp_path / "log.jsonl"),
+        capture_prefixes=("CORE",))
+    assert rec["ok"] is True
+    assert rec["core"] == "the-rendered-core"
+    # Absent prefix: no key, no crash.
+    rec2 = _stage.run_stage(
+        {"stage": "t"},
+        [sys.executable, "-c", "print('STAGE cpu 1 0.5 2.0')"],
+        dict(os.environ), 30, str(tmp_path / "log.jsonl"),
+        capture_prefixes=("CORE",))
+    assert rec2["ok"] is True
+    assert "core" not in rec2
 
 
 def test_solve_stage_src_is_runnable_python():
