@@ -1,0 +1,158 @@
+"""Stage wiring of the revalidation ladder (scripts/tpu_revalidate.py).
+
+The F-I recovery queue was validated end to end by forced-CPU smoke
+runs; these tests pin the CONTRACT pieces a smoke run can't isolate:
+stage order, abort propagation (a failed stage must stop the ladder and
+suppress ladder-complete), the smoke-vs-device argument selection, and
+the backend-flip abort — all by scripting run_stage/probe_status, so no
+subprocess or engine runs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scripts import tpu_revalidate  # noqa: E402
+
+
+class Script:
+    """Scripted run_stage/probe_status doubles recording every call."""
+
+    def __init__(self, backend="tpu", fail_at=None):
+        self.backend = backend
+        self.fail_at = fail_at  # stage-name prefix that returns ok=False
+        self.stages = []        # (name, cmd) in call order
+
+    def run_stage(self, rec, cmd, env, timeout_s, log_path, **kwargs):
+        name = rec.get("stage", rec.get("variant", "?"))
+        self.stages.append((name, [str(c) for c in cmd]))
+        self.envs = getattr(self, "envs", {})
+        self.envs[name] = dict(env)
+        ok = not (self.fail_at and name.startswith(self.fail_at))
+        rec.update(ok=ok, backend=self.backend, warm_s=1.0, run_s=0.1,
+                   rate=10.0)
+        return rec
+
+    def probe_status(self, timeout):
+        return {"status": "ok" if self.backend != "cpu" else "cpu-only",
+                "backend": self.backend}
+
+
+@pytest.fixture()
+def scripted(monkeypatch, tmp_path):
+    def make(**kw):
+        s = Script(**kw)
+        monkeypatch.setattr(tpu_revalidate, "run_stage", s.run_stage)
+        monkeypatch.setattr(tpu_revalidate, "probe_status", s.probe_status)
+        monkeypatch.setattr(
+            sys, "argv",
+            ["tpu_revalidate.py", "--skip-wait",
+             "--log", str(tmp_path / "ladder.jsonl")])
+        return s, tmp_path / "ladder.jsonl"
+    return make
+
+
+def _names(s):
+    return [n for n, _ in s.stages]
+
+
+def _log_stages(log):
+    import json
+
+    out = []
+    for line in log.read_text().splitlines():
+        try:
+            out.append(json.loads(line).get("stage"))
+        except ValueError:
+            pass
+    return out
+
+
+def test_device_ladder_runs_all_stages_in_order(scripted):
+    s, log = scripted(backend="tpu")
+    tpu_revalidate.main()
+    assert _names(s) == [
+        "A:tiny-cache-off", "B:tiny-cache-on", "C:headline-1024",
+        "D:bench.py", "E:suite", "F:tpu-ab", "G:blockwise-overvmem",
+        "H:spec-core-ab", "I:lane-probe"]
+    assert "ladder-complete" in _log_stages(log)
+    # Device mode: full shapes, no CPU allowances.
+    by_name = dict(s.stages)
+    assert "--allow-cpu" not in by_name["F:tpu-ab"]
+    assert "--count" not in by_name["F:tpu-ab"]
+    assert "1000" in by_name["G:blockwise-overvmem"]
+    assert "bits,blockwise" in by_name["G:blockwise-overvmem"]
+    assert "--widths" not in by_name["I:lane-probe"]
+
+
+def test_smoke_ladder_shrinks_shapes_and_allows_cpu(scripted):
+    s, log = scripted(backend="cpu")
+    tpu_revalidate.main()
+    assert _names(s)[-1] == "I:lane-probe"
+    by_name = dict(s.stages)
+    assert "--allow-cpu" in by_name["F:tpu-ab"]
+    assert "256" in by_name["F:tpu-ab"]
+    assert "120" in by_name["G:blockwise-overvmem"]
+    assert "bits" in by_name["G:blockwise-overvmem"]
+    assert "bits,blockwise" not in by_name["G:blockwise-overvmem"]
+    assert "--allow-cpu" in by_name["H:spec-core-ab"]
+    assert "--widths" in by_name["I:lane-probe"]
+    assert "ladder-complete" in _log_stages(log)
+
+
+def test_failed_cache_stage_continues_with_cache_off(scripted):
+    """The ONE exception to abort propagation: stage B (cache on)
+    failing must NOT stop the ladder — it convicts the compile cache and
+    the remaining stages run cache-off (the 2026-07-31 outage began at
+    the first compile of a cache-enabled run)."""
+    s, log = scripted(backend="tpu", fail_at="B:")
+    tpu_revalidate.main()
+    names = _names(s)
+    assert "C:headline-1024" in names and "I:lane-probe" in names
+    assert "ladder-complete" in _log_stages(log)
+    # Every post-B stage runs with the cache forced off.
+    import json
+
+    notes = [json.loads(l) for l in log.read_text().splitlines()
+             if "note" in l]
+    assert any("compile cache implicated" in str(n) for n in notes)
+    for stage in ("C:headline-1024", "F:tpu-ab", "I:lane-probe"):
+        assert s.envs[stage]["DEPPY_TPU_COMPILE_CACHE"] == "off"
+
+
+def test_failed_stage_stops_the_ladder(scripted):
+    s, log = scripted(backend="tpu", fail_at="F:")
+    tpu_revalidate.main()
+    assert _names(s)[-1] == "F:tpu-ab"  # nothing after the failure
+    assert "G:blockwise-overvmem" not in _names(s)
+    assert "ladder-complete" not in _log_stages(log)
+
+
+def test_failed_lane_probe_suppresses_ladder_complete(scripted):
+    s, log = scripted(backend="tpu", fail_at="I:")
+    tpu_revalidate.main()
+    assert _names(s)[-1] == "I:lane-probe"
+    assert "ladder-complete" not in _log_stages(log)
+
+
+def test_backend_flip_mid_ladder_aborts(scripted, monkeypatch):
+    s, log = scripted(backend="tpu")
+    # After stage C the worker dies and probes flip to cpu-only.
+    orig = s.run_stage
+
+    def run_stage(rec, cmd, env, t, lp, **k):
+        rec = orig(rec, cmd, env, t, lp, **k)
+        if rec.get("stage") == "C:headline-1024":
+            s.backend = "cpu"
+        return rec
+
+    monkeypatch.setattr(tpu_revalidate, "run_stage", run_stage)
+    tpu_revalidate.main()
+    assert "D:bench.py" not in _names(s)
+    assert "ladder-complete" not in _log_stages(log)
