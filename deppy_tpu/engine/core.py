@@ -545,6 +545,21 @@ def _resolved_search_impl() -> str:
     return _SEARCH_IMPL
 
 
+def _fused_routable(pts, arr) -> bool:
+    """The one dispatch rule shared by every fused-kernel factory
+    (batched_search / batched_minimize_gated / batched_core /
+    batched_core_gated): mesh-sharded batches stay on the XLA programs
+    (a pallas_call over a multi-device batch would need shard_map
+    plumbing the fused path doesn't have), and the batch's static shapes
+    must fit the kernel's unroll caps.  ``arr`` is the tensor whose
+    sharding decides (the planes the phase actually reads)."""
+    from . import pallas_search
+
+    sharding = getattr(arr, "sharding", None)
+    multi = sharding is not None and len(sharding.device_set) > 1
+    return not multi and pallas_search.fused_supported(pts)
+
+
 def _resolved_impl() -> str:
     if _BCP_IMPL == "auto":
         return "bits"
@@ -1394,12 +1409,7 @@ def batched_search(V: int, NCON: int, NV: int, T: int = 0):
         from . import pallas_search
 
         def dispatch(pts, budget, en):
-            # Mesh-sharded chunks stay on the XLA program: a pallas_call
-            # over a sharded batch would need shard_map plumbing the
-            # fused path doesn't have.
-            sharding = getattr(pts.pos_bits_r, "sharding", None)
-            multi = sharding is not None and len(sharding.device_set) > 1
-            if not multi and pallas_search.fused_supported(pts):
+            if _fused_routable(pts, pts.pos_bits_r):
                 return pallas_search.batched_search_fused(pts, budget, en)
             return xla_fn(pts, budget, en)
 
@@ -1409,9 +1419,23 @@ def batched_search(V: int, NCON: int, NV: int, T: int = 0):
 
 @functools.lru_cache(maxsize=128)
 def batched_core(V: int, NCON: int, NV: int):
-    """Jitted, vmapped phase-3 program over a compacted UNSAT batch."""
+    """Jitted, vmapped phase-3 program over a compacted UNSAT batch.
+    Under ``DEPPY_TPU_SEARCH=fused`` supported shapes route to the fused
+    deletion-sweep kernel (same dispatch rules as
+    :func:`batched_search`)."""
     fn = functools.partial(core_phase, V=V, NCON=NCON, NV=NV)
-    return jax.jit(jax.vmap(fn, in_axes=(0, None, 0, 0)))
+    xla_fn = jax.jit(jax.vmap(fn, in_axes=(0, None, 0, 0)))
+    if _resolved_search_impl() == "fused":
+        from . import pallas_search
+
+        def dispatch(pts, budget, steps, en):
+            if _fused_routable(pts, pts.pos_bits):
+                return pallas_search.batched_core_fused(
+                    pts, budget, steps, en, V=V, NCON=NCON, NV=NV)
+            return xla_fn(pts, budget, steps, en)
+
+        return dispatch
+    return xla_fn
 
 
 # --------------------------------------------------------------------------
@@ -1504,10 +1528,7 @@ def batched_minimize_gated(V: int, NCON: int, NV: int):
         from . import pallas_search
 
         def dispatch(pts, result, model, guessed, budget, steps, en):
-            sharding = getattr(pts.pos_bits_r, "sharding", None)
-            multi = (sharding is not None
-                     and len(sharding.device_set) > 1)
-            if not multi and pallas_search.fused_supported(pts):
+            if _fused_routable(pts, pts.pos_bits_r):
                 return pallas_search.batched_minimize_fused(
                     pts, result, model, guessed, budget, steps, en)
             return xla_fn(pts, result, model, guessed, budget, steps, en)
@@ -1527,6 +1548,19 @@ def _core_gated(pt, result, budget, steps, en_lanes, *, V, NCON, NV):
 def batched_core_gated(V: int, NCON: int, NV: int):
     """Phase-3 program gated by the phase-1 ``result`` on device — used
     when most of a batch is UNSAT, where compaction would re-upload nearly
-    everything for no lane savings."""
+    everything for no lane savings.  Routes to the fused kernel under
+    ``DEPPY_TPU_SEARCH=fused`` like :func:`batched_core`."""
     fn = functools.partial(_core_gated, V=V, NCON=NCON, NV=NV)
-    return jax.jit(jax.vmap(fn, in_axes=(0, 0, None, 0, 0)))
+    xla_fn = jax.jit(jax.vmap(fn, in_axes=(0, 0, None, 0, 0)))
+    if _resolved_search_impl() == "fused":
+        from . import pallas_search
+
+        def dispatch(pts, result, budget, steps, en):
+            if _fused_routable(pts, pts.pos_bits):
+                return pallas_search.batched_core_fused(
+                    pts, budget, steps, en & (result == UNSAT),
+                    V=V, NCON=NCON, NV=NV)
+            return xla_fn(pts, result, budget, steps, en)
+
+        return dispatch
+    return xla_fn

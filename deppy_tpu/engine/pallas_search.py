@@ -134,11 +134,21 @@ def _clear_bit(plane: jax.Array, var, on) -> jax.Array:
 
 
 def _fixpoint(pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f,
-              run):
+              run, card_act_bits=None):
     """:func:`core.planes_fixpoint`'s bits path, inlined: same
     pre-conflict overlap check, same round kernel, no impl dispatch and
     no unroll knob (there is no per-trip dispatch cost to amortize in
-    here)."""
+    here).
+
+    ``card_act_bits`` (full plane space only — the phase-3 kernel):
+    cardinality-row activity is NOT static there — a row is active iff
+    its owning constraint's activation literal is TRUE in the ENTRY
+    assignment, so it must be derived from ``t`` per fixpoint call
+    (core.planes_fixpoint's full-space branch); the static
+    ``card_active`` argument is ignored when it is given.  Reduced-space
+    callers (phases 1-2) keep passing the static ``card_valid`` mask."""
+    if card_act_bits is not None:
+        card_active = ((card_act_bits & t) != 0).any(axis=1, keepdims=True)
     pre_conflict = run & ((t & f) != 0).any()
     go = run & ~pre_conflict
 
@@ -174,7 +184,8 @@ def _first_unassigned(pvb, t, f):
 
 
 def _dpll(pos, neg, mem, card_active, card_n2, pvb, t_init, f_init,
-          min_bits, min_w, budget, steps, NV: int, enabled):
+          min_bits, min_w, budget, steps, NV: int, enabled,
+          card_act_bits=None):
     """Complete search under a fixed partial assignment — the kernel twin
     of :func:`core.dpll` (gini Solve(), search.go:168; solve.go:107):
     false-first decisions on the lowest unassigned problem var,
@@ -187,7 +198,7 @@ def _dpll(pos, neg, mem, card_active, card_n2, pvb, t_init, f_init,
 
     conflict0, t0, f0 = _fixpoint(
         pos, neg, mem, card_active, card_n2, min_bits, min_w,
-        t_init, f_init, enabled,
+        t_init, f_init, enabled, card_act_bits,
     )
     status0 = jnp.where(conflict0, jnp.int32(core.UNSAT),
                         jnp.int32(core.RUNNING))
@@ -220,7 +231,7 @@ def _dpll(pos, neg, mem, card_active, card_n2, pvb, t_init, f_init,
         f2 = _set_bit(f, var, do_step & neg_phase)
         conflict, t3, f3 = _fixpoint(
             pos, neg, mem, card_active, card_n2, min_bits, min_w,
-            t2, f2, do_step,
+            t2, f2, do_step, card_act_bits,
         )
 
         ok = do_step & ~conflict
@@ -631,6 +642,161 @@ def fused_supported(pts: core.ProblemTensors) -> bool:
     Kc = pts.choice_cand.shape[-1]
     W = pts.var_choices.shape[-1]
     return Kc <= MAX_KC and W <= MAX_W
+
+
+# --------------------------------------------------------------------------
+# fused phase 3: deletion-based unsat-core minimization (kernel twin of
+# core.core_phase — chunk-first deletion sweep, every probe a full
+# in-kernel DPLL over the FULL plane space, where activation literals are
+# live variables; the analog of gini's Why minimization,
+# lit_mapping.go:198-207)
+
+
+def _core_kernel(en_ref, ncons_ref, nvars_ref, budget_ref, steps_ref,
+                 pos_ref, neg_ref, mem_ref, cardn_ref, cardab_ref,
+                 pvb_ref, baset_ref, basef_ref,
+                 core_ref, steps_out_ref, *, NV: int, NCON: int, G: int):
+    """One problem's whole deletion sweep in one kernel invocation.
+
+    Constraint (de)activation is plane algebra: the all-active base
+    assignment has every activation literal's TRUE bit set (base_t), and
+    a probe's trial assignment clears the dropped constraints' act bits
+    — leaving them UNASSIGNED, exactly core._base_assignment's
+    ``act_enabled`` semantics.  The permanently-dropped set is carried as
+    a packed bit plane (``dropped``) so each probe constructs its trial
+    with ≤ G+1 one-hot bit ops instead of re-scattering NCON bits."""
+    pos = pos_ref[0]
+    neg = neg_ref[0]
+    mem = mem_ref[0]
+    card_n2 = cardn_ref[0]
+    # Full plane space: cardinality-row activity is DERIVED per fixpoint
+    # from the probe's activation bits (a dropped constraint's AtMost
+    # rows must stop constraining), so the kernel carries the act-bit
+    # planes, not a static card_valid mask.
+    card_act_bits = cardab_ref[0]
+    pvb = pvb_ref[0]
+    base_t = baset_ref[0]
+    base_f = basef_ref[0]
+    en = en_ref[0, 0] != 0
+    n_cons = ncons_ref[0, 0]
+    n_vars = nvars_ref[0, 0]
+    budget = budget_ref[0, 0]
+    steps0 = steps_ref[0, 0]
+    Wv = pos.shape[1]
+    lanes = _lanes_iota(NCON)
+    active0 = ((lanes < n_cons) & en).astype(jnp.int32)
+    no_min = jnp.zeros((1, Wv), jnp.int32)
+    zero_w = jnp.int32(0)
+
+    def cond(st):
+        j, _, _, _, _, steps = st
+        return en & (j < n_cons) & (steps <= budget)
+
+    def body(st):
+        j, k, chunk_mode, active, dropped, steps = st
+        # Trial plane: the dropped set plus this probe's candidates.
+        trial_plane = dropped
+        for g in range(G):  # static unroll (G = CORE_CHUNK)
+            idx = j + g
+            on_c = (chunk_mode & (idx < n_cons)
+                    & (_lane_read(active, idx) != 0))
+            trial_plane = _set_bit(trial_plane, n_vars + idx, on_c)
+        idx_m = j + k
+        on_m = ~chunk_mode & (idx_m < n_cons)
+        trial_plane = _set_bit(trial_plane, n_vars + idx_m, on_m)
+        in_chunk = (lanes >= j) & (lanes < j + G)
+        trial_act = jnp.where(chunk_mode & in_chunk, 0, active)
+        trial_act = jnp.where(~chunk_mode & (lanes == idx_m)
+                              & (idx_m < n_cons), 0, trial_act)
+
+        status, _, _, steps = _dpll(
+            pos, neg, mem, None, card_n2, pvb,
+            base_t & ~trial_plane, base_f, no_min, zero_w,
+            budget, steps, NV, en, card_act_bits,
+        )
+        unsat = status == core.UNSAT
+        active = jnp.where(unsat, trial_act, active)
+        dropped = jnp.where(unsat, trial_plane, dropped)
+        # Control twin of core.core_phase's cbody: chunk probe UNSAT →
+        # next chunk; chunk probe SAT → member-by-member; member sweep
+        # exhausts the chunk → next chunk.
+        k2 = jnp.where(chunk_mode, jnp.int32(0), k + 1)
+        chunk_done = chunk_mode & unsat
+        member_done = ~chunk_mode & ((k2 >= G) | (j + k2 >= n_cons))
+        advance = chunk_done | member_done
+        j = jnp.where(advance, j + G, j)
+        k2 = jnp.where(advance, jnp.int32(0), k2)
+        return j, k2, advance, active, dropped, steps
+
+    st = (jnp.int32(0), jnp.int32(0), jnp.bool_(True), active0,
+          jnp.zeros((1, Wv), jnp.int32), steps0)
+    _, _, _, core_act, _, steps = lax.while_loop(cond, body, st)
+    core_ref[0] = core_act
+    steps_out_ref[0, 0] = steps
+
+
+@functools.partial(jax.jit, static_argnames=("V", "NCON", "NV"))
+def _batched_core_fused(pts: core.ProblemTensors, budget, steps, en,
+                        *, V: int, NCON: int, NV: int):
+    """Phase-3 core extraction via the fused kernel — the drop-in twin of
+    ``core.batched_core(V, NCON, NV)(pts, budget, steps, en)``.  Reads
+    the FULL-space planes (activation literals live)."""
+    B, C, Wv = pts.pos_bits.shape
+    NA = pts.card_member_bits.shape[1]
+    G = min(core.CORE_CHUNK, max(NCON, 1))
+
+    init = jax.vmap(
+        lambda p: core._base_assignment(p, V, NCON))(pts)  # all active
+    pack = jax.vmap(lambda m: core.pack_mask(m, Wv))
+    base_t = pack(init == core.TRUE)
+    base_f = pack(init == core.FALSE)
+    idx = jnp.arange(V, dtype=jnp.int32)
+    pvb = pack(idx[None, :] < pts.n_vars[:, None])
+
+    smem_b = pl.BlockSpec((1, 1), lambda b: (b, 0),
+                          memory_space=pltpu.SMEM)
+    smem_c = pl.BlockSpec((1, 1), lambda b: (0, 0),
+                          memory_space=pltpu.SMEM)
+
+    def vmem(*blk):
+        return pl.BlockSpec((1,) + blk, lambda b: (b,) + (0,) * len(blk),
+                            memory_space=pltpu.VMEM)
+
+    core_out, steps_out = pl.pallas_call(
+        functools.partial(_core_kernel, NV=NV, NCON=NCON, G=G),
+        grid=(B,),
+        in_specs=[
+            smem_b, smem_b, smem_b, smem_c, smem_b,
+            vmem(C, Wv), vmem(C, Wv), vmem(NA, Wv),
+            vmem(NA, 1), vmem(NA, Wv),
+            vmem(1, Wv), vmem(1, Wv), vmem(1, Wv),
+        ],
+        out_specs=(vmem(1, NCON), smem_b),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, 1, NCON), jnp.int32),
+            jax.ShapeDtypeStruct((B, 1), jnp.int32),
+        ),
+        interpret=jax.default_backend() != "tpu",
+    )(en.astype(jnp.int32)[:, None],
+      pts.n_cons.astype(jnp.int32)[:, None],
+      pts.n_vars.astype(jnp.int32)[:, None],
+      jnp.full((1, 1), budget, jnp.int32),
+      steps.astype(jnp.int32)[:, None],
+      pts.pos_bits, pts.neg_bits, pts.card_member_bits,
+      pts.card_n[:, :, None], pts.card_act_bits,
+      pvb, base_t, base_f)
+
+    return core_out[:, 0, :] != 0, steps_out[:, 0]
+
+
+def batched_core_fused(pts, budget, steps, en, *, V, NCON, NV):
+    """Public entry for the fused phase-3 program (shape caps shared with
+    the phase-1/2 kernels via :func:`fused_supported`; callers fall back
+    to the XLA path otherwise)."""
+    if not fused_supported(pts):
+        raise ValueError("fused core kernel caps exceeded")
+    return _batched_core_fused(pts, budget, steps, en,
+                               V=V, NCON=NCON, NV=NV)
 
 
 @functools.partial(jax.jit, static_argnames=())

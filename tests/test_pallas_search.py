@@ -117,6 +117,185 @@ def test_dispatcher_routes_and_falls_back(monkeypatch):
     assert hasattr(fn, "lower")  # back to the jitted XLA program
 
 
+def test_dispatcher_keeps_sharded_chunks_on_xla():
+    """A mesh-sharded batch must route to the XLA program even under
+    DEPPY_TPU_SEARCH=fused: a pallas_call over a multi-device batch
+    would need shard_map plumbing the fused path doesn't have.  The
+    dispatcher detects the sharding and the solve still agrees."""
+    import jax
+
+    from deppy_tpu.parallel import default_mesh, shard_batch
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh from conftest")
+    problems = [encode(random_instance(length=12, seed=s))
+                for s in range(8)]
+    d, pts, en = _batch(problems)
+    try:
+        core.set_search_impl("fused")
+        fn = core.batched_search(d.V, d.NCON, d.NV, 0)
+        ref = fn(pts, jnp.int32(1 << 20), en)
+        mesh = default_mesh(jax.devices()[:4])
+        pts_sh = shard_batch(mesh, jax.tree_util.tree_map(np.asarray, pts))
+        en_sh = jax.device_put(
+            np.asarray(en),
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("batch")))
+        out = fn(pts_sh, jnp.int32(1 << 20), en_sh)
+        _assert_phase1_equal(ref, out, len(problems))
+    finally:
+        core.set_search_impl("auto")
+
+
+def _full_batch(problems):
+    """A batch with FULL-space planes (what the core phase consumes)."""
+    B = len(problems)
+    d = driver._Dims(problems, B)
+    pts = driver.pad_stack(problems, d, d.B, pack=False)
+    pts = core.ProblemTensors(*[jnp.asarray(x) for x in pts])
+    pts = driver._derive_planes(pts, d)
+    if core.phases_reduced():
+        pts = driver._derive_full(pts, d)
+    en = jnp.asarray(np.arange(d.B) < B)
+    return d, pts, en
+
+
+def _unsat_problems(n=6):
+    """Random instances filtered to UNSAT (so the core phase has work)."""
+    from deppy_tpu.sat.errors import NotSatisfiable
+    from deppy_tpu.sat.host import HostEngine
+
+    out = []
+    seed = 0
+    while len(out) < n and seed < 400:
+        p = encode(random_instance(length=24, seed=seed,
+                                   p_mandatory=0.3, p_conflict=0.3))
+        try:
+            HostEngine(p).solve()
+        except NotSatisfiable:
+            out.append(p)
+        seed += 1
+    assert len(out) == n, "could not find enough UNSAT instances"
+    return out
+
+
+def _unsat_cardinality_problems():
+    """UNSAT instances whose cores involve AtMost rows — the case where
+    cardinality-row activity must be DERIVED from each probe's
+    activation bits (a statically-active AtMost row of a dropped
+    constraint makes probes spuriously UNSAT and over-prunes the core;
+    caught by review, round 4)."""
+    from deppy_tpu import sat
+    from deppy_tpu.models import version_pinned_chains
+    from deppy_tpu.sat.errors import NotSatisfiable
+    from deppy_tpu.sat.host import HostEngine
+
+    # Two mandatory pins colliding on an AtMost-1 version group, at
+    # three scales — the smallest is the 3-constraint minimal case, the
+    # last buries the core inside a real chain catalog.
+    chain = version_pinned_chains(depth=4, width=2, seed=1) + [
+        sat.variable("pinA", sat.mandatory(), sat.dependency("l2.v0")),
+        sat.variable("pinB", sat.mandatory(), sat.dependency("l2.v1")),
+    ]
+    out = [encode([
+        sat.variable("x", sat.mandatory()),
+        sat.variable("y", sat.mandatory()),
+        sat.variable("g", sat.at_most(1, "x", "y")),
+    ]), encode([
+        sat.variable("a", sat.mandatory()),
+        sat.variable("b", sat.mandatory()),
+        sat.variable("c", sat.mandatory()),
+        sat.variable("cap", sat.at_most(2, "a", "b", "c")),
+        sat.variable("d", sat.dependency("a")),
+    ]), encode(chain)]
+    for p in out:
+        try:
+            HostEngine(p).solve()
+            raise AssertionError("expected UNSAT instance")
+        except NotSatisfiable:
+            pass
+    return out
+
+
+def test_fused_core_matches_xla_with_cardinality_rows():
+    """AtMost-bearing cores: identical cores and step counts (the
+    regression test for the statically-active-cardinality-row bug)."""
+    problems = _unsat_cardinality_problems()
+    d, pts, en = _full_batch(problems)
+    budget = jnp.int32(1 << 20)
+    steps0 = jnp.zeros(d.B, jnp.int32)
+    ref_core, ref_steps = core.batched_core(d.V, d.NCON, d.NV)(
+        pts, budget, steps0, en)
+    got_core, got_steps = pallas_search.batched_core_fused(
+        pts, budget, steps0, en, V=d.V, NCON=d.NCON, NV=d.NV)
+    n = len(problems)
+    np.testing.assert_array_equal(np.asarray(ref_core)[:n],
+                                  np.asarray(got_core)[:n])
+    np.testing.assert_array_equal(np.asarray(ref_steps)[:n],
+                                  np.asarray(got_steps)[:n])
+
+
+def test_fused_core_matches_xla():
+    """The fused deletion-sweep kernel must return the IDENTICAL core and
+    step count as core.batched_core — the same bit-for-bit contract as
+    phases 1-2 (and transitively the host spec's one-at-a-time loop,
+    which the XLA chunk-first sweep is proven against)."""
+    problems = _unsat_problems(6)
+    d, pts, en = _full_batch(problems)
+    budget = jnp.int32(1 << 20)
+    steps0 = jnp.zeros(d.B, jnp.int32) + 7  # carried phase-1 steps
+    ref_core, ref_steps = core.batched_core(d.V, d.NCON, d.NV)(
+        pts, budget, steps0, en)
+    got_core, got_steps = pallas_search.batched_core_fused(
+        pts, budget, steps0, en, V=d.V, NCON=d.NCON, NV=d.NV)
+    n = len(problems)
+    np.testing.assert_array_equal(np.asarray(ref_core)[:n],
+                                  np.asarray(got_core)[:n])
+    np.testing.assert_array_equal(np.asarray(ref_steps)[:n],
+                                  np.asarray(got_steps)[:n])
+
+
+def test_fused_core_gated_skips_non_unsat_lanes():
+    """The gated dispatcher twin: SAT/disabled lanes return empty cores
+    and untouched step counts, like core.batched_core_gated."""
+    problems = _unsat_problems(2) + [
+        encode(random_instance(length=16, seed=3))]
+    d, pts, en = _full_batch(problems)
+    budget = jnp.int32(1 << 20)
+    steps0 = jnp.arange(d.B, dtype=jnp.int32)
+    result = jnp.asarray(
+        [core.UNSAT, core.UNSAT, core.SAT] + [core.RUNNING] * (d.B - 3),
+        jnp.int32)
+    ref = core.batched_core_gated(d.V, d.NCON, d.NV)(
+        pts, result, budget, steps0, en)
+    got_core, got_steps = pallas_search.batched_core_fused(
+        pts, budget, steps0, en & (result == core.UNSAT),
+        V=d.V, NCON=d.NCON, NV=d.NV)
+    np.testing.assert_array_equal(np.asarray(ref[0]), np.asarray(got_core))
+    np.testing.assert_array_equal(np.asarray(ref[1]), np.asarray(got_steps))
+    # The SAT lane's core is empty and its steps untouched.
+    assert not np.asarray(got_core)[2].any()
+    assert int(np.asarray(got_steps)[2]) == 2
+
+
+def test_fused_core_budget_exhaustion_parity():
+    """A starved budget must stop the fused sweep at the same step count
+    as the XLA program (the Incomplete surface depends on it)."""
+    problems = _unsat_problems(3)
+    d, pts, en = _full_batch(problems)
+    budget = jnp.int32(25)
+    steps0 = jnp.zeros(d.B, jnp.int32)
+    ref_core, ref_steps = core.batched_core(d.V, d.NCON, d.NV)(
+        pts, budget, steps0, en)
+    got_core, got_steps = pallas_search.batched_core_fused(
+        pts, budget, steps0, en, V=d.V, NCON=d.NCON, NV=d.NV)
+    n = len(problems)
+    np.testing.assert_array_equal(np.asarray(ref_core)[:n],
+                                  np.asarray(got_core)[:n])
+    np.testing.assert_array_equal(np.asarray(ref_steps)[:n],
+                                  np.asarray(got_steps)[:n])
+
+
 def _xla_minimize(d, pts, p1, en, budget=1 << 20):
     fn = core.batched_minimize_gated(d.V, d.NCON, d.NV)
     return fn(pts, p1[0], p1[2], p1[1], jnp.int32(budget), p1[3], en)
@@ -177,3 +356,43 @@ def test_fused_end_to_end_matches_host(monkeypatch):
             host.append(("unsat", sorted(
                 (ac.variable.identifier, str(ac)) for ac in e.constraints)))
     assert fused == xla == host
+
+
+def test_fused_end_to_end_unsat_heavy_gated_path():
+    """UNSAT-heavy batch (> half the lanes): the driver takes the GATED
+    phase-3 route, so this pins the fused batched_core_gated dispatch —
+    conflict sets must match the host oracle exactly."""
+    from deppy_tpu import sat
+    from deppy_tpu.resolution import BatchResolver
+
+    pool = [random_instance(length=20, seed=s, p_mandatory=0.5,
+                            p_conflict=0.6, n_conflict=4)
+            for s in range(10)]
+
+    def render(results):
+        out = []
+        for r in results:
+            if isinstance(r, sat.NotSatisfiable):
+                out.append(("unsat", sorted(
+                    (ac.variable.identifier, str(ac))
+                    for ac in r.constraints)))
+            else:
+                out.append(("sat", sorted(k for k, v in r.items() if v)))
+        return out
+
+    try:
+        core.set_search_impl("fused")
+        fused = render(BatchResolver(backend="tpu").solve(pool))
+    finally:
+        core.set_search_impl("auto")
+    host = []
+    for variables in pool:
+        try:
+            installed = sat.Solver(variables, backend="host").solve()
+            host.append(("sat", sorted(v.identifier for v in installed)))
+        except sat.NotSatisfiable as e:
+            host.append(("unsat", sorted(
+                (ac.variable.identifier, str(ac)) for ac in e.constraints)))
+    n_unsat = sum(1 for h in host if h[0] == "unsat")
+    assert n_unsat > len(pool) // 2, "distribution drifted: not UNSAT-heavy"
+    assert fused == host
