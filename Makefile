@@ -47,8 +47,12 @@ bench-suite-quick: ## Suite at ~1/8 batch sizes (smoke).
 	$(PYTHON) -m deppy_tpu.benchmarks.suite --quick
 
 .PHONY: soak
-soak: ## Differential fuzz: host vs tensor vs clause-sharded (scripts/soak.py).
+soak: ## Differential fuzz: host vs tensor vs clause-sharded vs fused (scripts/soak.py).
 	$(PYTHON) scripts/soak.py --cases 300
+
+.PHONY: dist-dryrun
+dist-dryrun: ## Two-process jax.distributed fleet solve vs a single-process oracle.
+	$(PYTHON) scripts/dist_dryrun.py --processes 2 --devices-per-process 4
 
 ##@ Run
 
