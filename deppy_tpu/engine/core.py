@@ -560,6 +560,22 @@ def _fused_routable(pts, arr) -> bool:
     return not multi and pallas_search.fused_supported(pts)
 
 
+def _has_full_planes(pts, V: int) -> bool:
+    """Whether this batch carries REAL full-space bit planes.  Under the
+    gather impl (``phases_reduced()`` False and no bits planes anywhere)
+    the driver ships 1-row placeholders — the XLA core phase walks
+    ``pt.clauses`` directly and never reads them, but the fused deletion
+    kernel inlines bits algebra and MUST see the real planes (caught by
+    the gather+fused knob-combination test: a placeholder makes every
+    probe misbehave and the core comes back unminimized).  Checks BOTH
+    placeholder conventions: the 1-row gather dummy (row count) and the
+    1-word pack=False dummy (word width vs the V the planes must
+    cover)."""
+    rows_ok = pts.pos_bits.shape[-2] == pts.clauses.shape[-2]
+    width_ok = pts.pos_bits.shape[-1] == -(-V // WORD)
+    return rows_ok and width_ok
+
+
 def _resolved_impl() -> str:
     if _BCP_IMPL == "auto":
         return "bits"
@@ -1429,7 +1445,7 @@ def batched_core(V: int, NCON: int, NV: int):
         from . import pallas_search
 
         def dispatch(pts, budget, steps, en):
-            if _fused_routable(pts, pts.pos_bits):
+            if _has_full_planes(pts, V) and _fused_routable(pts, pts.pos_bits):
                 return pallas_search.batched_core_fused(
                     pts, budget, steps, en, V=V, NCON=NCON, NV=NV)
             return xla_fn(pts, budget, steps, en)
@@ -1556,7 +1572,7 @@ def batched_core_gated(V: int, NCON: int, NV: int):
         from . import pallas_search
 
         def dispatch(pts, result, budget, steps, en):
-            if _fused_routable(pts, pts.pos_bits):
+            if _has_full_planes(pts, V) and _fused_routable(pts, pts.pos_bits):
                 return pallas_search.batched_core_fused(
                     pts, budget, steps, en & (result == UNSAT),
                     V=V, NCON=NCON, NV=NV)

@@ -117,6 +117,50 @@ def test_dispatcher_routes_and_falls_back(monkeypatch):
     assert hasattr(fn, "lower")  # back to the jitted XLA program
 
 
+def test_fused_with_nondefault_bcp_impl_still_agrees():
+    """Knob combination: DEPPY_TPU_SEARCH=fused changes the phase
+    substrates while DEPPY_TPU_BCP changes only the XLA fixpoint impl —
+    the fused kernels inline their own bits algebra, and any lane that
+    falls back to XLA (or any XLA phase) must keep solving correctly
+    under the non-default impl.  Pin the combination against the host
+    oracle end to end."""
+    from deppy_tpu import sat
+    from deppy_tpu.resolution import BatchResolver
+
+    pool = [random_instance(length=16, seed=s, p_mandatory=0.4,
+                            p_conflict=0.4) for s in range(6)]
+
+    def render(results):
+        # Sorted core pairs, like test_differential: the parity contract
+        # is the SET of core constraints, not their rendering order.
+        out = []
+        for r in results:
+            if isinstance(r, sat.NotSatisfiable):
+                out.append(("unsat", sorted(
+                    (ac.variable.identifier, str(ac))
+                    for ac in r.constraints)))
+            else:
+                out.append(("sat", sorted(k for k, v in r.items() if v)))
+        return out
+
+    try:
+        core.set_search_impl("fused")
+        core.set_bcp_impl("gather")
+        combo = render(BatchResolver(backend="tpu").solve(pool))
+    finally:
+        core.set_bcp_impl("auto")
+        core.set_search_impl("auto")
+    host = []
+    for variables in pool:
+        try:
+            installed = sat.Solver(variables, backend="host").solve()
+            host.append(("sat", sorted(v.identifier for v in installed)))
+        except sat.NotSatisfiable as e:
+            host.append(("unsat", sorted(
+                (ac.variable.identifier, str(ac)) for ac in e.constraints)))
+    assert combo == host
+
+
 def test_dispatcher_keeps_sharded_chunks_on_xla():
     """A mesh-sharded batch must route to the XLA program even under
     DEPPY_TPU_SEARCH=fused: a pallas_call over a multi-device batch
