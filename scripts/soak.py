@@ -29,9 +29,11 @@ def _force_cpu() -> None:
     apply_platform_env()
 
 
-def _generate(rng: random.Random):
+def _generate(rng: random.Random, kinds=None):
     """One random problem with randomized shape/density; returns
-    (description, variables)."""
+    (description, variables).  ``kinds`` restricts the generator mix
+    (0 random, 1 operatorhub, 2 chains, 3 gvk, 4 pinned-tenant — the
+    ~90%-UNSAT family, for targeted unsat-core soaks)."""
     from deppy_tpu.models import (
         gvk_conflict_catalog,
         operatorhub_catalog,
@@ -40,7 +42,7 @@ def _generate(rng: random.Random):
         version_pinned_chains,
     )
 
-    kind = rng.randrange(5)
+    kind = rng.choice(kinds) if kinds else rng.randrange(5)
     seed = rng.randrange(1 << 30)
     if kind == 0:
         length = rng.choice([4, 12, 33, 64, 100])
@@ -86,6 +88,10 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--shard-every", type=int, default=10,
                     help="also run the clause-sharded path every N cases")
+    ap.add_argument("--kinds", default="",
+                    help="comma-separated generator kinds to restrict "
+                    "the mix (e.g. '4' = pinned-tenant only, the "
+                    "~90%%-UNSAT family — a targeted unsat-core soak)")
     ap.add_argument("--fused-every", type=int, default=5,
                     help="also run the fused Pallas search substrate "
                     "(DEPPY_TPU_SEARCH=fused) on every Nth case, in one "
@@ -103,8 +109,17 @@ def main() -> int:
     t0 = time.time()
     counts = {"sat": 0, "unsat": 0, "incomplete": 0}
     fused_queue = []  # (case, desc, vs, host outcome) for the fused pass
+    try:
+        kinds = [int(k) for k in args.kinds.split(",") if k.strip()] or None
+    except ValueError:
+        ap.error(f"--kinds must be comma-separated integers, got "
+                 f"{args.kinds!r}")
+    if kinds and any(k not in range(5) for k in kinds):
+        # _generate's dispatch would silently map any out-of-range kind
+        # to the pinned-tenant family — reject typos instead.
+        ap.error(f"--kinds values must be 0-4, got {kinds}")
     for case in range(args.cases):
-        desc, vs = _generate(rng)
+        desc, vs = _generate(rng, kinds)
         host = _outcome(lambda: sat.Solver(vs, backend="host").solve())
         tensor = _outcome(lambda: sat.Solver(vs, backend="tpu").solve())
         if host != tensor:
