@@ -4,8 +4,11 @@
 FROM python:3.12-slim
 
 WORKDIR /app
+COPY pyproject.toml LICENSE README.md ./
 COPY deppy_tpu/ deppy_tpu/
-RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+# Pinned, reproducible install from the project manifest (jax==0.9.0);
+# the [tpu] extra pulls the TPU-capable jaxlib from the libtpu index.
+RUN pip install --no-cache-dir ".[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
 
 # Non-root so the Deployment's runAsNonRoot admission check passes.
 RUN useradd --uid 65532 --create-home resolver
