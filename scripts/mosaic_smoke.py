@@ -1,0 +1,264 @@
+"""Mosaic compile-smoke of every Pallas kernel, at tiny shapes.
+
+Round 4 shipped three fused-phase kernels (engine/pallas_search.py) and
+the blockwise over-VMEM path (engine/pallas_blockwise.py) with only
+interpret-mode evidence: on this machine the kernels select
+``interpret=jax.default_backend() != "tpu"``, and the worker was down
+all round — so Mosaic (the TPU kernel compiler) has never seen them.
+A Mosaic rejection or mis-lowering would otherwise surface minutes deep
+inside stage F's full A/B (scripts/tpu_ab.py) or stage G's over-VMEM
+case.  This smoke runs FIRST on a healed worker: each kernel is
+compiled and executed once at tiny shapes and bit-compared against its
+XLA (or jnp-loop) twin — the same parity contract the interpret-mode
+suites pin (tests/test_pallas_search.py, tests/test_pallas_blockwise.py).
+
+Exit 0 when the harness completed (even with failing kernels: the
+verdict file is the result, and the ladder adapts stages F/G to skip
+broken substrates rather than aborting the whole measurement queue);
+exit 1 on harness/backend aborts.  Verdict JSON:
+
+  {"backend": ..., "kernels": {name: {"ok": bool, "compile_s": ...,
+   "run_s": ..., "error": ...}}, "all_ok": bool}
+
+Usage:  python scripts/mosaic_smoke.py [--log L] [--verdict F] [--allow-cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._stage import emit  # noqa: E402
+
+
+def _build_batches():
+    """Tiny batches for each kernel family (built once, on host)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deppy_tpu import sat
+    from deppy_tpu.engine import core, driver
+    from deppy_tpu.models import random_instance
+    from deppy_tpu.sat.encode import encode
+
+    def batch(problems, pack):
+        B = len(problems)
+        d = driver._Dims(problems, B)
+        pts = driver.pad_stack(problems, d, d.B, pack=pack)
+        pts = core.ProblemTensors(*[jnp.asarray(x) for x in pts])
+        if not pack:
+            pts = driver._derive_planes(pts, d)
+            if core.phases_reduced():
+                pts = driver._derive_full(pts, d)
+        en = jnp.asarray(np.arange(d.B) < B)
+        return d, pts, en
+
+    sat_problems = [encode(random_instance(length=12, seed=s))
+                    for s in range(4)]
+    # Known-UNSAT minimal instances, one with an AtMost (cardinality)
+    # row so the core kernel's derived-activity path compiles in.
+    unsat_problems = [
+        encode([
+            sat.variable("x", sat.mandatory()),
+            sat.variable("y", sat.mandatory()),
+            sat.variable("g", sat.at_most(1, "x", "y")),
+        ]),
+        encode([sat.variable("a", sat.mandatory(), sat.prohibited())]),
+    ]
+    return batch(sat_problems, True), batch(unsat_problems, False)
+
+
+def _bcp_args():
+    """Plane-level fixpoint arguments for the BCP kernels: a dependency
+    chain (multi-round propagation) solved from anchors."""
+    import jax.numpy as jnp
+
+    from deppy_tpu.engine import core, driver
+    from deppy_tpu.sat import dependency, mandatory, variable
+    from deppy_tpu.sat.encode import encode
+
+    n = 12
+    vs = [variable("a0", mandatory(), dependency("a1"))]
+    vs += [variable(f"a{i}", dependency(f"a{i + 1}"))
+           for i in range(1, n - 1)]
+    vs += [variable(f"a{n - 1}")]
+    p = encode(vs)
+    d = driver._Dims([p], 1)
+    pt = core.ProblemTensors(
+        *[jnp.asarray(x) for x in driver.pad_problem(p, d)])
+    base = core._base_assignment(pt, d.V, d.NCON)
+    base = core._apply_anchors(pt, base, d.V)
+    t0 = core.pack_mask(base == core.TRUE, d.Wv)
+    f0 = core.pack_mask(base == core.FALSE, d.Wv)
+    card_active = ((pt.card_act_bits & t0) != 0).any(axis=1, keepdims=True)
+    no_min = jnp.zeros((1, d.Wv), jnp.int32)
+    return (pt.pos_bits, pt.neg_bits, pt.card_member_bits, card_active,
+            pt.card_n[:, None], no_min, jnp.int32(0), t0, f0)
+
+
+def _bits_fixpoint(args):
+    import jax
+    import jax.numpy as jnp
+
+    from deppy_tpu.engine import core
+
+    def cond(s):
+        c, _, _, ch = s
+        return ~c & ch
+
+    def body(s):
+        _, t, f, _ = s
+        return core.round_planes(*args[:7], t, f)
+
+    c, t, f, _ = jax.lax.while_loop(
+        cond, body, (jnp.bool_(False), args[7], args[8], jnp.bool_(True)))
+    return c, t, f
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--log", default="")
+    ap.add_argument("--verdict", default="/tmp/mosaic_smoke_verdict.json")
+    ap.add_argument("--alarm", type=int, default=1700)
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="run on a CPU backend (interpret mode — "
+                    "exercises only this harness's plumbing)")
+    a = ap.parse_args()
+    signal.alarm(a.alarm)
+
+    from deppy_tpu.utils.platform_env import apply_platform_env
+    apply_platform_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    backend = jax.default_backend()
+    if backend != "tpu" and not a.allow_cpu:
+        emit({"smoke": "abort", "reason": f"backend {backend} is not tpu "
+              "(pass --allow-cpu for a plumbing-only run)"}, a.log)
+        sys.exit(1)
+
+    from deppy_tpu.engine import core, pallas_bcp, pallas_blockwise, \
+        pallas_search
+
+    (d, pts, en), (du, ptsu, enu) = _build_batches()
+    budget = jnp.int32(1 << 20)
+    verdict = {"backend": backend, "ts": round(time.time(), 1),
+               "kernels": {}}
+
+    def write_verdict():
+        # Incremental: a later kernel wedging the worker (SIGALRM kills
+        # this process) must not discard verdicts Mosaic already proved —
+        # the ladder would otherwise disable GOOD substrates too.
+        verdict["all_ok"] = all(k["ok"] for k in verdict["kernels"].values())
+        with open(a.verdict, "w") as f:
+            json.dump(verdict, f)
+
+    def check(name, fused_fn, ref_fn, compare):
+        rec = {"smoke": name, "backend": backend}
+        try:
+            t0 = time.perf_counter()
+            got = jax.block_until_ready(fused_fn())
+            rec["compile_s"] = round(time.perf_counter() - t0, 2)
+            t0 = time.perf_counter()
+            got = jax.block_until_ready(fused_fn())
+            rec["run_s"] = round(time.perf_counter() - t0, 4)
+            ref = jax.block_until_ready(ref_fn())
+            compare(ref, got)
+            rec["ok"] = True
+        except Exception as e:  # noqa: BLE001 — verdict captures any failure class
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"[:500]
+            rec["trace_tail"] = traceback.format_exc()[-600:]
+        verdict["kernels"][name] = {
+            k: rec.get(k) for k in ("ok", "compile_s", "run_s", "error")}
+        write_verdict()
+        emit(rec, a.log)
+        return rec["ok"]
+
+    def cmp_rows(n):
+        def _cmp(ref, got):
+            for x, y in zip(ref, got):
+                np.testing.assert_array_equal(
+                    np.asarray(x)[:n], np.asarray(y)[:n])
+        return _cmp
+
+    # Phase 1: fused search vs the XLA program.
+    xla_search = core.batched_search(d.V, d.NCON, d.NV, 0)
+    p1 = [None]
+
+    def run_xla_search():
+        p1[0] = xla_search(pts, budget, en)
+        return p1[0]
+
+    check("search-fused",
+          lambda: pallas_search.batched_search_fused(pts, budget, en),
+          run_xla_search, cmp_rows(4))
+
+    # Phase 2: fused minimize vs the gated XLA program (phase-1 outputs
+    # from the XLA search; computed above even if the fused search failed).
+    # The recompute can itself fail on a flaky just-recovered worker; the
+    # BCP checks below are independent and must still run.
+    if p1[0] is None:
+        try:
+            run_xla_search()
+        except Exception as e:  # noqa: BLE001
+            verdict["kernels"]["minimize-fused"] = {
+                "ok": False, "compile_s": None, "run_s": None,
+                "error": f"xla reference search failed: "
+                         f"{type(e).__name__}: {e}"[:500]}
+            write_verdict()
+            emit({"smoke": "minimize-fused", "ok": False,
+                  "error": "xla reference search failed"}, a.log)
+    if p1[0] is not None:
+        r1 = p1[0]
+        check("minimize-fused",
+              lambda: pallas_search.batched_minimize_fused(
+                  pts, r1[0], r1[2], r1[1], budget, r1[3], en),
+              lambda: core.batched_minimize_gated(d.V, d.NCON, d.NV)(
+                  pts, r1[0], r1[2], r1[1], budget, r1[3], en),
+              cmp_rows(4))
+
+    # Phase 3: fused deletion-sweep core vs the XLA program (UNSAT batch
+    # with full-space planes, one AtMost-bearing core).
+    steps0 = jnp.zeros(du.B, jnp.int32)
+    check("core-fused",
+          lambda: pallas_search.batched_core_fused(
+              ptsu, budget, steps0, enu, V=du.V, NCON=du.NCON, NV=du.NV),
+          lambda: core.batched_core(du.V, du.NCON, du.NV)(
+              ptsu, budget, steps0, enu),
+          cmp_rows(2))
+
+    # BCP fixpoint kernels vs the jnp bits loop.
+    args = _bcp_args()
+
+    def cmp_fix(ref, got):
+        cr, tr, fr = ref
+        cg, tg, fg = got
+        assert bool(cr) == bool(cg), f"conflict flag {cr} != {cg}"
+        np.testing.assert_array_equal(np.asarray(tr), np.asarray(tg))
+        np.testing.assert_array_equal(np.asarray(fr), np.asarray(fg))
+
+    check("bcp-fused",
+          lambda: pallas_bcp.bcp_fixpoint(*args),
+          lambda: _bits_fixpoint(args), cmp_fix)
+    # block_rows=2 forces real multi-block streaming + multi-sweep.
+    check("bcp-blockwise",
+          lambda: pallas_blockwise.bcp_fixpoint(*args, block_rows=2),
+          lambda: _bits_fixpoint(args), cmp_fix)
+
+    write_verdict()
+    emit({"smoke": "complete", "all_ok": verdict["all_ok"],
+          "verdict_file": a.verdict}, a.log)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,11 @@ def main() -> None:
     ap.add_argument("--allow-cpu", action="store_true",
                     help="permit running the A/B on a CPU-only backend "
                     "(smoke tests; the knobs are measured losers there)")
+    ap.add_argument("--skip-fused", action="store_true",
+                    help="skip the search-fused variant (set by the "
+                    "revalidation ladder when the Mosaic compile-smoke "
+                    "failed it — a known-broken variant would abort the "
+                    "A/B and lose the remaining measurements)")
     a = ap.parse_args()
 
     expected = [None]
@@ -67,6 +72,11 @@ def main() -> None:
     src = solve_stage_src(alarm=a.step_timeout + 30, length=48,
                           count=a.count, reps=3)
     for name, knobs, tpu_only in VARIANTS:
+        if a.skip_fused and knobs.get("DEPPY_TPU_SEARCH") == "fused":
+            emit({"variant": name,
+                  "skipped": "mosaic compile-smoke failed this substrate"},
+                 a.log)
+            continue
         if tpu_only and expected[0] == "cpu":
             emit({"variant": name, "skipped":
                   "tpu-only variant on a cpu backend (interpret-mode "

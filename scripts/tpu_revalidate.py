@@ -9,6 +9,12 @@ health re-probe between stages:
   B. tiny batch, compile cache ON      — isolates the cache as a wedge
      trigger: the 2026-07-31 outage began at the first compile of a
      cache-enabled run, and A-passes-B-fails would convict it
+  B2. Mosaic compile-smoke of every Pallas kernel at tiny shapes
+     (``scripts/mosaic_smoke.py``) — the round-4 fused kernels have
+     only ever run in interpret mode, so this is the first time Mosaic
+     sees them; run EARLY so the verdict lands in the first minutes of
+     a recovery window, and a rejection reconfigures stages F/G
+     (skip-fused / bits-only) instead of aborting them mid-measurement
   C. headline shape at 1024 problems (cache per B's verdict)
   D. the driver contract: ``bench.py`` end to end — BEFORE the long
      suite, so a worker that recovers ~30 min before a driver bench
@@ -37,6 +43,7 @@ log:
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -121,6 +128,39 @@ def main() -> None:
         if not healthy():
             return
     env_rest = env_on if cache_ok else env_off
+    # B2: Mosaic compile-smoke — each Pallas kernel compiled + executed
+    # once at tiny shapes and bit-compared vs its XLA twin.  The smoke
+    # exits 0 even with failing kernels (the verdict file is the
+    # result); only a harness abort / hang fails the stage, and even
+    # then the ladder continues with the fused substrates disabled so
+    # the safe measurements still land.
+    smoke_verdict = ((os.path.abspath(a.log) + ".smoke.json") if a.log
+                     else "/tmp/mosaic_smoke_verdict.json")
+    try:
+        os.unlink(smoke_verdict)
+    except FileNotFoundError:
+        pass
+    smoke_cpu = ["--allow-cpu"] if ladder_backend[0] == "cpu" else []
+    _run_stage("B2:mosaic-smoke",
+               [py, os.path.join(ROOT, "scripts", "mosaic_smoke.py"),
+                "--verdict", smoke_verdict,
+                *(["--log", os.path.abspath(a.log)] if a.log else []),
+                *smoke_cpu],
+               env_rest, 1800, a.log, require_stage_line=False)
+    kernels_ok = {}
+    try:
+        with open(smoke_verdict) as f:
+            kernels_ok = {k: v.get("ok", False) for k, v in
+                          json.load(f)["kernels"].items()}
+    except (OSError, ValueError, KeyError):
+        _emit({"stage": "note", "msg": "no mosaic-smoke verdict; "
+               "treating all Pallas substrates as unproven"}, a.log)
+    search_fused_ok = kernels_ok.get("search-fused", False) \
+        and kernels_ok.get("minimize-fused", False) \
+        and kernels_ok.get("core-fused", False)
+    blockwise_ok = kernels_ok.get("bcp-blockwise", False)
+    if not healthy():
+        return
     # C: headline shape.
     if not _run_stage(
             "C:headline-1024",
@@ -182,9 +222,15 @@ def main() -> None:
     # exercise plumbing, and a slow CPU box could blow the per-variant
     # timeout and kill the tail this smoke exists to cover.
     f_shape = (["--count", "256"] if smoke else [])
+    # On a TPU backend the smoke's verdict gates the fused variant; the
+    # forced-CPU smoke path skips it anyway (tpu_only) so no flag there.
+    f_fused = ([] if smoke or search_fused_ok else ["--skip-fused"])
+    if f_fused:
+        _emit({"stage": "note", "msg": "mosaic smoke failed the fused "
+               "search substrate; running stage F without it"}, a.log)
     if not _run_stage("F:tpu-ab",
                       [py, os.path.join(ROOT, "scripts", "tpu_ab.py"),
-                       *f_shape, *log_args, *cpu_args],
+                       *f_shape, *f_fused, *log_args, *cpu_args],
                       env_rest, 5400, a.log,
                       require_stage_line=False)["ok"]:
         return
@@ -196,7 +242,10 @@ def main() -> None:
     g_shape = (["--packages", "120", "--repeats", "1",
                 "--impls", "bits"] if smoke else
                ["--packages", "1000", "--repeats", "2",
-                "--impls", "bits,blockwise"])
+                "--impls", "bits,blockwise" if blockwise_ok else "bits"])
+    if not smoke and not blockwise_ok:
+        _emit({"stage": "note", "msg": "mosaic smoke failed blockwise; "
+               "stage G runs bits only"}, a.log)
     if not _run_stage("G:blockwise-overvmem",
                       [py, "-m", "deppy_tpu.benchmarks.pallas_case",
                        *g_shape, *log_args],

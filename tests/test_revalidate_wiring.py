@@ -24,16 +24,30 @@ from scripts import tpu_revalidate  # noqa: E402
 class Script:
     """Scripted run_stage/probe_status doubles recording every call."""
 
-    def __init__(self, backend="tpu", fail_at=None):
+    def __init__(self, backend="tpu", fail_at=None, smoke_fail=()):
         self.backend = backend
         self.fail_at = fail_at  # stage-name prefix that returns ok=False
+        self.smoke_fail = smoke_fail  # kernel names the smoke fails
+        self.smoke_verdict = True  # write a verdict file at all
         self.stages = []        # (name, cmd) in call order
 
     def run_stage(self, rec, cmd, env, timeout_s, log_path, **kwargs):
         name = rec.get("stage", rec.get("variant", "?"))
-        self.stages.append((name, [str(c) for c in cmd]))
+        cmd = [str(c) for c in cmd]
+        self.stages.append((name, cmd))
         self.envs = getattr(self, "envs", {})
         self.envs[name] = dict(env)
+        if "--verdict" in cmd and self.smoke_verdict:
+            # Model mosaic_smoke.py's contract: a verdict file keyed by
+            # kernel name, written even when kernels fail.
+            import json
+
+            kernels = ["search-fused", "minimize-fused", "core-fused",
+                       "bcp-fused", "bcp-blockwise"]
+            with open(cmd[cmd.index("--verdict") + 1], "w") as f:
+                json.dump({"backend": self.backend, "kernels": {
+                    k: {"ok": k not in self.smoke_fail} for k in kernels
+                }}, f)
         ok = not (self.fail_at and name.startswith(self.fail_at))
         rec.update(ok=ok, backend=self.backend, warm_s=1.0, run_s=0.1,
                    rate=10.0)
@@ -78,14 +92,17 @@ def test_device_ladder_runs_all_stages_in_order(scripted):
     s, log = scripted(backend="tpu")
     tpu_revalidate.main()
     assert _names(s) == [
-        "A:tiny-cache-off", "B:tiny-cache-on", "C:headline-1024",
-        "D:bench.py", "E:suite", "F:tpu-ab", "G:blockwise-overvmem",
-        "H:spec-core-ab", "I:lane-probe"]
+        "A:tiny-cache-off", "B:tiny-cache-on", "B2:mosaic-smoke",
+        "C:headline-1024", "D:bench.py", "E:suite", "F:tpu-ab",
+        "G:blockwise-overvmem", "H:spec-core-ab", "I:lane-probe"]
     assert "ladder-complete" in _log_stages(log)
-    # Device mode: full shapes, no CPU allowances.
+    # Device mode: full shapes, no CPU allowances, Pallas substrates on
+    # (the scripted smoke passed every kernel).
     by_name = dict(s.stages)
+    assert "--allow-cpu" not in by_name["B2:mosaic-smoke"]
     assert "--allow-cpu" not in by_name["F:tpu-ab"]
     assert "--count" not in by_name["F:tpu-ab"]
+    assert "--skip-fused" not in by_name["F:tpu-ab"]
     assert "1000" in by_name["G:blockwise-overvmem"]
     assert "bits,blockwise" in by_name["G:blockwise-overvmem"]
     assert "--widths" not in by_name["I:lane-probe"]
@@ -96,6 +113,7 @@ def test_smoke_ladder_shrinks_shapes_and_allows_cpu(scripted):
     tpu_revalidate.main()
     assert _names(s)[-1] == "I:lane-probe"
     by_name = dict(s.stages)
+    assert "--allow-cpu" in by_name["B2:mosaic-smoke"]
     assert "--allow-cpu" in by_name["F:tpu-ab"]
     assert "256" in by_name["F:tpu-ab"]
     assert "120" in by_name["G:blockwise-overvmem"]
@@ -103,6 +121,44 @@ def test_smoke_ladder_shrinks_shapes_and_allows_cpu(scripted):
     assert "bits,blockwise" not in by_name["G:blockwise-overvmem"]
     assert "--allow-cpu" in by_name["H:spec-core-ab"]
     assert "--widths" in by_name["I:lane-probe"]
+    assert "ladder-complete" in _log_stages(log)
+
+
+def test_smoke_fused_failure_skips_fused_but_keeps_measuring(scripted):
+    """A Mosaic rejection of any fused-phase kernel must NOT abort the
+    queue: stage F runs with --skip-fused and everything else proceeds
+    to ladder-complete (the smoke exists so a broken substrate costs
+    one variant, not the round's measurements)."""
+    s, log = scripted(backend="tpu")
+    s.smoke_fail = ("minimize-fused",)
+    tpu_revalidate.main()
+    by_name = dict(s.stages)
+    assert "--skip-fused" in by_name["F:tpu-ab"]
+    assert "bits,blockwise" in by_name["G:blockwise-overvmem"]
+    assert "ladder-complete" in _log_stages(log)
+
+
+def test_smoke_blockwise_failure_drops_blockwise_from_stage_g(scripted):
+    s, log = scripted(backend="tpu")
+    s.smoke_fail = ("bcp-blockwise",)
+    tpu_revalidate.main()
+    by_name = dict(s.stages)
+    assert "--skip-fused" not in by_name["F:tpu-ab"]
+    assert "bits,blockwise" not in by_name["G:blockwise-overvmem"]
+    assert "bits" in by_name["G:blockwise-overvmem"]
+    assert "ladder-complete" in _log_stages(log)
+
+
+def test_missing_smoke_verdict_is_conservative(scripted):
+    """A smoke that hung or never wrote its verdict leaves every Pallas
+    substrate unproven: F skips fused, G runs bits only, and the ladder
+    still completes."""
+    s, log = scripted(backend="tpu")
+    s.smoke_verdict = False
+    tpu_revalidate.main()
+    by_name = dict(s.stages)
+    assert "--skip-fused" in by_name["F:tpu-ab"]
+    assert "bits,blockwise" not in by_name["G:blockwise-overvmem"]
     assert "ladder-complete" in _log_stages(log)
 
 
