@@ -12,12 +12,16 @@ all: verify unit
 ##@ Development
 
 .PHONY: unit
-unit: ## Run the test suite (8-device virtual CPU mesh, see tests/conftest.py).
+unit: ## Default gate: every test at quick depth (trimmed randomized seeds, tests/_depth.py); ≤5 min on one core.
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q
+
+.PHONY: unit-full
+unit-full: ## Full-depth suite (all randomized seeds; ~18 min on one core — nightly / pre-release gate).
 	$(PYTHON) -m pytest tests/ -q
 
 .PHONY: unit-fast
 unit-fast: ## Tests minus the slow randomized-equivalence suites.
-	$(PYTHON) -m pytest tests/ -q -k "not Randomized and not fleet"
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -k "not Randomized and not fleet"
 
 .PHONY: verify
 verify: ## Sanity: everything compiles and collects (reference `make verify` analog).

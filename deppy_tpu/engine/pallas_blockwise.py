@@ -154,6 +154,15 @@ def bcp_fixpoint(pos, neg, mem, card_active, card_n2, min_bits, min_w,
     C, Wv = pos.shape
     br = block_rows or BLOCK_ROWS
     br = min(br, C)
+    # Mosaic requires the block's second-to-minor dim be 8-divisible (or
+    # equal to the array's row count); round up to the sublane quantum —
+    # the extra rows are zero clause planes, inert under round_planes
+    # (first hardware compile 2026-08-01 rejected a 2-row smoke block).
+    # Interpret mode has no such constraint and keeps the exact br so
+    # the tiny-block differential tests still exercise multi-block
+    # sweeps (cross-block conflict/forcing propagation).
+    if jax.default_backend() == "tpu":
+        br = max(8 * ((br + 7) // 8), 8)
     pad = (-C) % br
     if pad:
         zrow = jnp.zeros((pad, Wv), jnp.int32)

@@ -72,6 +72,22 @@ MAX_KC = 64
 MAX_W = 32
 
 
+def _smem_scalars(B: int) -> "pl.BlockSpec":
+    """Whole-column SMEM spec for per-problem ``(B, 1)`` scalars.
+
+    Mosaic requires a block's last two dims to be (8, 128)-divisible or
+    equal to the array's, so the natural per-problem (1, 1) block over a
+    (B, 1) scalar column is rejected (first hardware compile, 2026-08-01:
+    every phase kernel failed exactly here).  Instead every grid step
+    maps the whole column into SMEM and the kernel indexes its own row
+    with ``pl.program_id(0)`` — SMEM scalar loads/stores are cheap, and
+    because the TPU grid is sequential the per-step single-element
+    writes compose into the full (B, 1) output.
+    """
+    return pl.BlockSpec((B, 1), lambda b: (0, 0),
+                        memory_space=pltpu.SMEM)
+
+
 # --------------------------------------------------------------------------
 # one-hot indexing primitives (Mosaic-safe dynamic indexing)
 
@@ -295,8 +311,9 @@ def _kernel(en_ref, na_ref, budget_ref,
     t_seed = t0p_ref[0]              # [1, Wr] anchors-assumed plane
     f_seed = f0p_ref[0]              # [1, Wr] padding pinned false
     pvb = pvb_ref[0]                 # [1, Wr] problem-var mask
-    en = en_ref[0, 0] != 0
-    na = na_ref[0, 0]
+    b = pl.program_id(0)
+    en = en_ref[b, 0] != 0
+    na = na_ref[b, 0]
     budget = budget_ref[0, 0]
 
     NC, Kc = choice_cand.shape
@@ -482,10 +499,10 @@ def _kernel(en_ref, na_ref, budget_ref,
      result, m_t, m_f, assumed, done, _, steps, tr_n) = st
     result = jnp.where(done, result, jnp.int32(core.RUNNING))
 
-    out0_ref[0, 0] = outcome0
-    res_ref[0, 0] = result
-    steps_ref[0, 0] = steps
-    trn_ref[0, 0] = tr_n
+    out0_ref[b, 0] = outcome0
+    res_ref[b, 0] = result
+    steps_ref[b, 0] = steps
+    trn_ref[b, 0] = tr_n
     t0o_ref[0] = t0
     f0o_ref[0] = f0
     asm_ref[0] = assumed
@@ -512,10 +529,11 @@ def _min_kernel(en_ref, nx_ref, budget_ref, steps_ref,
     m_init_f = mif_ref[0]
     extras_bits = ext_ref[0]
     pvb = pvb_ref[0]
-    en = en_ref[0, 0] != 0
-    n_extras = nx_ref[0, 0]
+    b = pl.program_id(0)
+    en = en_ref[b, 0] != 0
+    n_extras = nx_ref[b, 0]
     budget = budget_ref[0, 0]
-    steps = steps_ref[0, 0]
+    steps = steps_ref[b, 0]
 
     def mcond(c):
         lo, hi, _, _, _, steps = c
@@ -551,8 +569,8 @@ def _min_kernel(en_ref, nx_ref, budget_ref, steps_ref,
     m2_t = jnp.where(need_final & (f_status == core.SAT), f_t, m2_t)
     min_found = (jnp.where(need_final, f_status == core.SAT, m_found)
                  | (en & (n_extras == 0)))
-    found_ref[0, 0] = min_found.astype(jnp.int32)
-    steps_out_ref[0, 0] = steps
+    found_ref[b, 0] = min_found.astype(jnp.int32)
+    steps_out_ref[b, 0] = steps
     m2t_ref[0] = m2_t
 
 
@@ -585,8 +603,7 @@ def _batched_minimize_fused(pts: core.ProblemTensors, result, model,
     m2t0 = pack(model == core.TRUE)
     pvb = pack(pv_mask)
 
-    smem_b = pl.BlockSpec((1, 1), lambda b: (b, 0),
-                          memory_space=pltpu.SMEM)
+    smem_b = _smem_scalars(B)
     smem_c = pl.BlockSpec((1, 1), lambda b: (0, 0),
                           memory_space=pltpu.SMEM)
 
@@ -677,11 +694,12 @@ def _core_kernel(en_ref, ncons_ref, nvars_ref, budget_ref, steps_ref,
     pvb = pvb_ref[0]
     base_t = baset_ref[0]
     base_f = basef_ref[0]
-    en = en_ref[0, 0] != 0
-    n_cons = ncons_ref[0, 0]
-    n_vars = nvars_ref[0, 0]
+    b = pl.program_id(0)
+    en = en_ref[b, 0] != 0
+    n_cons = ncons_ref[b, 0]
+    n_vars = nvars_ref[b, 0]
     budget = budget_ref[0, 0]
-    steps0 = steps_ref[0, 0]
+    steps0 = steps_ref[b, 0]
     Wv = pos.shape[1]
     lanes = _lanes_iota(NCON)
     active0 = ((lanes < n_cons) & en).astype(jnp.int32)
@@ -732,7 +750,7 @@ def _core_kernel(en_ref, ncons_ref, nvars_ref, budget_ref, steps_ref,
           jnp.zeros((1, Wv), jnp.int32), steps0)
     _, _, _, core_act, _, steps = lax.while_loop(cond, body, st)
     core_ref[0] = core_act
-    steps_out_ref[0, 0] = steps
+    steps_out_ref[b, 0] = steps
 
 
 @functools.partial(jax.jit, static_argnames=("V", "NCON", "NV"))
@@ -753,8 +771,7 @@ def _batched_core_fused(pts: core.ProblemTensors, budget, steps, en,
     idx = jnp.arange(V, dtype=jnp.int32)
     pvb = pack(idx[None, :] < pts.n_vars[:, None])
 
-    smem_b = pl.BlockSpec((1, 1), lambda b: (b, 0),
-                          memory_space=pltpu.SMEM)
+    smem_b = _smem_scalars(B)
     smem_c = pl.BlockSpec((1, 1), lambda b: (0, 0),
                           memory_space=pltpu.SMEM)
 
@@ -822,8 +839,7 @@ def _batched_search_fused(pts: core.ProblemTensors, budget, en):
     card_n2 = pts.card_n[:, :, None]
     card_v2 = pts.card_valid[:, :, None]
 
-    smem_b = pl.BlockSpec((1, 1), lambda b: (b, 0),
-                          memory_space=pltpu.SMEM)
+    smem_b = _smem_scalars(B)
     smem_c = pl.BlockSpec((1, 1), lambda b: (0, 0),
                           memory_space=pltpu.SMEM)
 

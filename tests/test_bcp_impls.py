@@ -189,8 +189,10 @@ class TestRandomizedEquivalence:
     def test_fixpoints_agree(self):
         # Random instances, random partial assignments: all impls must
         # agree on (conflict, fixpoint assignment).
+        from _depth import depth
+
         rng = np.random.default_rng(7)
-        for seed in range(8):
+        for seed in range(depth(8, 3)):
             p = encode(random_instance(length=24, seed=seed))
             d = driver._Dims([p], 1)
             pt = driver.pad_problem(p, d)
@@ -207,7 +209,10 @@ class TestRandomizedEquivalence:
                     np.testing.assert_array_equal(got[1], ref[1], err_msg=f"{seed} {impl}")
 
     def test_full_solves_agree(self):
-        problems = [encode(random_instance(length=20, seed=s)) for s in range(6)]
+        from _depth import depth
+
+        problems = [encode(random_instance(length=20, seed=s))
+                    for s in range(depth(6, 3))]
         outcomes = {}
         installs = {}
         for impl in IMPLS:

@@ -19,7 +19,9 @@ from deppy_tpu.resolution import BatchResolver
 
 pytest.importorskip("jax")
 
-SEEDS = range(20)
+from _depth import depth  # noqa: E402
+
+SEEDS = range(depth(20, 6))
 LENGTH = 40
 
 

@@ -11,12 +11,13 @@ from __future__ import annotations
 
 import pytest
 
+from _depth import depth
 from deppy_tpu import sat
 from deppy_tpu.models import random_instance
 from deppy_tpu.utils import check_solution
 
 
-@pytest.mark.parametrize("seed", range(25))
+@pytest.mark.parametrize("seed", range(depth(25, 8)))
 def test_random_instance(seed: int):
     variables = random_instance(length=48, seed=seed)
     solver = sat.Solver(variables, backend="host")

@@ -20,6 +20,7 @@ pytest.importorskip("jax")
 
 import jax.numpy as jnp  # noqa: E402
 
+from _depth import depth  # noqa: E402
 from deppy_tpu.engine import core, driver, pallas_search  # noqa: E402
 from deppy_tpu.models import random_instance  # noqa: E402
 from deppy_tpu.sat.encode import encode  # noqa: E402
@@ -54,11 +55,12 @@ def _assert_phase1_equal(a, b, n):
 
 def test_fused_matches_xla_on_benchmark_distribution():
     problems = [
-        encode(random_instance(length=24, seed=s)) for s in range(8)
+        encode(random_instance(length=24, seed=s))
+        for s in range(depth(8, 3))
     ] + [
         encode(random_instance(length=16, seed=s, p_mandatory=0.5,
                                p_conflict=0.5, n_conflict=4))
-        for s in range(8)
+        for s in range(depth(8, 3))
     ]
     d, pts, en = _batch(problems)
     _assert_phase1_equal(
@@ -128,7 +130,7 @@ def test_fused_with_nondefault_bcp_impl_still_agrees():
     from deppy_tpu.resolution import BatchResolver
 
     pool = [random_instance(length=16, seed=s, p_mandatory=0.4,
-                            p_conflict=0.4) for s in range(6)]
+                            p_conflict=0.4) for s in range(depth(6, 3))]
 
     def render(results):
         # Sorted core pairs, like test_differential: the parity contract
@@ -284,7 +286,7 @@ def test_fused_core_matches_xla():
     step count as core.batched_core — the same bit-for-bit contract as
     phases 1-2 (and transitively the host spec's one-at-a-time loop,
     which the XLA chunk-first sweep is proven against)."""
-    problems = _unsat_problems(6)
+    problems = _unsat_problems(depth(6, 3))
     d, pts, en = _full_batch(problems)
     budget = jnp.int32(1 << 20)
     steps0 = jnp.zeros(d.B, jnp.int32) + 7  # carried phase-1 steps
@@ -347,7 +349,8 @@ def _xla_minimize(d, pts, p1, en, budget=1 << 20):
 
 def test_fused_minimize_matches_xla():
     problems = [
-        encode(random_instance(length=24, seed=s)) for s in range(8)
+        encode(random_instance(length=24, seed=s))
+        for s in range(depth(8, 3))
     ]
     d, pts, en = _batch(problems)
     p1 = _xla_search(d, pts, en)
@@ -366,10 +369,11 @@ def test_fused_end_to_end_matches_host(monkeypatch):
     from deppy_tpu import sat
     from deppy_tpu.resolution import BatchResolver
 
-    problems = [random_instance(length=24, seed=s) for s in range(6)] + [
+    problems = [random_instance(length=24, seed=s)
+                for s in range(depth(6, 2))] + [
         random_instance(length=16, seed=s, p_mandatory=0.5,
                         p_conflict=0.5, n_conflict=4)
-        for s in range(6)
+        for s in range(depth(6, 2))
     ]
 
     def outcomes(results):
@@ -411,7 +415,7 @@ def test_fused_end_to_end_unsat_heavy_gated_path():
 
     pool = [random_instance(length=20, seed=s, p_mandatory=0.5,
                             p_conflict=0.6, n_conflict=4)
-            for s in range(10)]
+            for s in range(depth(10, 4))]
 
     def render(results):
         out = []

@@ -132,8 +132,10 @@ def test_trace_counts_match_on_fuzz_instances():
     """Backtrack-count parity over the benchmark distribution: the two
     engines implement the same search, so the trace stream has the same
     length on every instance."""
+    from _depth import depth
+
     mismatches = []
-    for seed in range(8):
+    for seed in range(depth(8, 3)):
         variables = random_instance(length=24, seed=seed, p_conflict=0.3)
         host_t, dev_t = sat.StatsTracer(), sat.StatsTracer()
         h = _run(variables, "host", host_t)
