@@ -191,21 +191,13 @@ def main() -> None:
         return
     if not healthy():
         return
-    # E: full suite; the per-config JSON lines land in the stage log and
-    # the aggregate in /tmp for a human to inspect and commit under
-    # benchmarks/results/ with a backend-correct name.
-    if not _run_stage("E:suite",
-                      [py, "-m", "deppy_tpu.benchmarks.suite",
-                       "--out", "/tmp/reval_suite.json"],
-                      env_rest, 2400, a.log,
-                      require_stage_line=False)["ok"]:
-        return
-    if not healthy():
-        return
     # F-I: the round-4 recovery measurement queue (verdict items 1,3,4,5)
     # — everything the round needs from a healed worker, captured without
-    # a human in the loop, ordered safest-first so the known-crash-risk
-    # probes cannot cost the safe measurements.  Each child script runs
+    # a human in the loop, ordered safest-first AND
+    # highest-value-first: F (the baseline/fused A/B) runs before the
+    # suite because heal windows have died minutes in (2026-08-01)
+    # and the fused verdict is what round 5 is for; the crash-risk
+    # probes still cannot cost the safe measurements.  Each child script runs
     # its own between-step health probes and writes into THIS log.
     log_args = ["--log", os.path.abspath(a.log)] if a.log else []
     # The ladder's forced-CPU smoke path (ladder_backend == "cpu", see
@@ -232,6 +224,17 @@ def main() -> None:
                       [py, os.path.join(ROOT, "scripts", "tpu_ab.py"),
                        *f_shape, *f_fused, *log_args, *cpu_args],
                       env_rest, 5400, a.log,
+                      require_stage_line=False)["ok"]:
+        return
+    if not healthy():
+        return
+    # E: full suite; the per-config JSON lines land in the stage log and
+    # the aggregate in /tmp for a human to inspect and commit under
+    # benchmarks/results/ with a backend-correct name.
+    if not _run_stage("E:suite",
+                      [py, "-m", "deppy_tpu.benchmarks.suite",
+                       "--out", "/tmp/reval_suite.json"],
+                      env_rest, 2400, a.log,
                       require_stage_line=False)["ok"]:
         return
     if not healthy():

@@ -91,9 +91,11 @@ def _log_stages(log):
 def test_device_ladder_runs_all_stages_in_order(scripted):
     s, log = scripted(backend="tpu")
     tpu_revalidate.main()
+    # F (the baseline/fused A/B) runs BEFORE the suite: heal windows
+    # have died minutes in, and the fused verdict outranks the suite.
     assert _names(s) == [
         "A:tiny-cache-off", "B:tiny-cache-on", "B2:mosaic-smoke",
-        "C:headline-1024", "D:bench.py", "E:suite", "F:tpu-ab",
+        "C:headline-1024", "D:bench.py", "F:tpu-ab", "E:suite",
         "G:blockwise-overvmem", "H:spec-core-ab", "I:lane-probe"]
     assert "ladder-complete" in _log_stages(log)
     # Device mode: full shapes, no CPU allowances, Pallas substrates on
