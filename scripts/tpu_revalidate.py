@@ -21,9 +21,11 @@ health re-probe between stages:
      window still lands an accelerator bench record in the ladder log
      (bench.py publishes it and prefers such records over its CPU
      fallback; see bench.py LADDER_LOG)
+  F. the trip-overhead A/B queue (``scripts/tpu_ab.py``: baseline /
+     search-fused / stage1 / unroll) — BEFORE the suite: heal windows
+     have died minutes in (2026-08-01), and the baseline-vs-fused pair
+     is the highest-value measurement in the queue
   E. full benchmark suite (``deppy_tpu.benchmarks.suite``)
-  F. the trip-overhead A/B queue (``scripts/tpu_ab.py``: unroll /
-     stage1 / search-fused)
   G. blockwise over-VMEM single-problem case (``pallas_case
      --packages 1000 --impls bits,blockwise``)
   H. speculative-core A/B (``scripts/spec_core_ab.py``)
@@ -278,11 +280,16 @@ def main() -> None:
                         *i_shape, *log_args],
                        env_rest, 5400, a.log, require_stage_line=False)
     # ladder-complete is a CONTRACT line (BASELINE.md: "a green
-    # ladder-complete line means every measurement actually landed") —
+    # ladder-complete line means every safe measurement actually
+    # landed, and the fused bet has a recorded verdict either way") —
     # a lane probe that measured nothing (rc!=0: aborted before any
     # step, or backend flip) must not produce it.  lane_probe itself
     # exits 0 when it measured up to a crashed boundary, which IS a
-    # landed verdict.
+    # landed verdict.  The one exception inside stage F: a full-shape
+    # search-fused failure on a still-healthy worker is recorded as its
+    # own note line and the queue continues (tpu_ab.py) — the fused
+    # VERDICT landed (it failed); what must never be lost silently is
+    # the safe knob ladder behind it.
     if rec_i["ok"]:
         _emit({"stage": "ladder-complete", "ts": round(time.time(), 1)},
               a.log)

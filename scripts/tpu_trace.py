@@ -102,11 +102,9 @@ def main() -> None:
         ],
         "trace_dir": a.trace_dir,
     }
-    line = json.dumps(summary)
-    print(line)
-    if a.out:
-        with open(a.out, "a") as f:
-            f.write(line + "\n")
+    from scripts._stage import emit
+
+    emit(summary, a.out)
 
 
 if __name__ == "__main__":

@@ -47,3 +47,13 @@ if jax is not None:
     jax.config.update(
         "jax_platforms", os.environ.get("DEPPY_TEST_PLATFORM", "cpu")
     )
+    # The env vars above are inherited by subprocess tests, but THIS
+    # process is too late for them: sitecustomize imports jax at
+    # interpreter startup (before conftest), and the cache config reads
+    # its env defaults at import.  Set it through jax.config as well.
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
+    )

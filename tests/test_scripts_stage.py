@@ -136,3 +136,66 @@ def test_run_stage_sets_orphan_guard_env(monkeypatch):
     monkeypatch.setattr(platform_env, "run_captured", fake_run)
     _stage.run_stage({"stage": "t"}, ["x"], {}, 100, "")
     assert seen.get("DEPPY_BENCH_SELF_DESTRUCT") == "160"
+
+
+# ---------------------------------------------------------------------------
+# tpu_ab variant-queue wiring: the fused variant is the one crash-flagged
+# entry, and its full-shape failure on a still-healthy worker must not
+# cost the safe knob ladder behind it (round-5 change; every other
+# failure still aborts so a wedged worker is never buried).
+
+
+def _run_ab(monkeypatch, tmp_path, fail_variant=None, healthy_after=True):
+    import sys
+
+    from scripts import tpu_ab
+
+    calls = []
+
+    def fake_run_stage(rec, cmd, env, timeout_s, log_path, **kw):
+        name = rec.get("variant")
+        calls.append(name)
+        rec.update(ok=name != fail_variant, backend="tpu",
+                   warm_s=1.0, run_s=0.1, rate=10.0)
+        return rec
+
+    def fake_make_healthy(timeout, allow_cpu, expected, log):
+        def healthy():
+            if calls and calls[-1] == fail_variant:
+                return healthy_after
+            return True
+
+        return healthy
+
+    monkeypatch.setattr(tpu_ab, "run_stage", fake_run_stage)
+    monkeypatch.setattr(tpu_ab, "make_healthy", fake_make_healthy)
+    monkeypatch.setattr(sys, "argv",
+                        ["tpu_ab.py", "--log", str(tmp_path / "ab.jsonl")])
+    rc = 0
+    try:
+        tpu_ab.main()
+    except SystemExit as e:
+        rc = int(e.code or 0)
+    return calls, rc
+
+
+def test_tpu_ab_fused_failure_on_healthy_worker_continues(
+        monkeypatch, tmp_path):
+    calls, rc = _run_ab(monkeypatch, tmp_path, fail_variant="search-fused")
+    assert rc == 0
+    assert calls[0] == "baseline" and calls[1] == "search-fused"
+    assert len(calls) == 6, calls  # the safe knob ladder still ran
+
+
+def test_tpu_ab_fused_failure_on_wedged_worker_aborts(
+        monkeypatch, tmp_path):
+    calls, rc = _run_ab(monkeypatch, tmp_path,
+                        fail_variant="search-fused", healthy_after=False)
+    assert rc == 1
+    assert calls[-1] == "search-fused" and len(calls) == 2
+
+
+def test_tpu_ab_safe_variant_failure_still_aborts(monkeypatch, tmp_path):
+    calls, rc = _run_ab(monkeypatch, tmp_path, fail_variant="unroll2")
+    assert rc == 1
+    assert calls[-1] == "unroll2"
