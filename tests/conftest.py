@@ -36,7 +36,7 @@ os.environ.setdefault(
     os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir,
                                  ".jax_cache")),
 )
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 
 try:
     import jax  # noqa: E402
