@@ -277,10 +277,8 @@ def _stale_device_record() -> dict | None:
     in machine-readable form."""
     import glob
 
-    committed = sorted(
-        glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "benchmarks", "results", "ladder_*.jsonl")),
-        key=os.path.getmtime, reverse=True)
+    committed = glob.glob(
+        os.path.join(REPO, "benchmarks", "results", "ladder_*.jsonl"))
     return _scan_device_records([LADDER_LOG, *committed], None)
 
 
@@ -292,7 +290,7 @@ def _newest_record(lines, max_age: float | None) -> dict | None:
             entry = json.loads(line)
         except (json.JSONDecodeError, ValueError):
             continue
-        if entry.get("stage") != "bench-record":
+        if not isinstance(entry, dict) or entry.get("stage") != "bench-record":
             continue
         rec = entry.get("record")
         try:

@@ -1,0 +1,142 @@
+"""Mosaic lowering legality of every Pallas kernel — without TPU hardware.
+
+The first real-chip compile of the fused kernels (2026-08-01, ladder
+stage B2) rejected 4 of 5 on a block-shape rule that fires at LOWERING
+time, not execution — which means ``jax.export`` cross-platform lowering
+(``platforms=["tpu"]``) can catch the whole class on the CPU-only test
+box.  These tests lower each kernel's wrapper for TPU at both tiny and
+production-like shapes; a Mosaic rejection (illegal block shape, layout
+hazard, unsupported op) fails here in CI instead of burning a scarce
+heal window on the real chip.
+
+This pins lowering legality only; bit-exactness vs the XLA programs is
+the interpret-mode differential suites' job, and real-chip execution is
+stage B2's (scripts/mosaic_smoke.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from deppy_tpu.engine import core, driver, pallas_search  # noqa: E402
+from deppy_tpu.models import random_instance  # noqa: E402
+from deppy_tpu.sat.encode import encode  # noqa: E402
+
+
+def _batch(problems, pack=True, full=False):
+    B = len(problems)
+    d = driver._Dims(problems, B)
+    pts = driver.pad_stack(problems, d, d.B, pack=pack)
+    pts = core.ProblemTensors(*[jnp.asarray(x) for x in pts])
+    if full:
+        pts = driver._derive_planes(pts, d)
+        if core.phases_reduced():
+            pts = driver._derive_full(pts, d)
+    en = jnp.asarray(np.arange(d.B) < B)
+    return d, pts, en
+
+
+def _shapes_of(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)),
+        tree)
+
+
+def _export_tpu(fn, *args):
+    """Cross-lower ``fn`` for TPU on this CPU-only box; any Mosaic
+    lowering rejection raises here."""
+    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(
+        *_shapes_of(args))
+    assert exp.mlir_module_serialized  # lowered, serialized, non-empty
+    return exp
+
+
+def _problems(n, length):
+    return [encode(random_instance(length=length, seed=s))
+            for s in range(n)]
+
+
+@pytest.fixture(autouse=True)
+def _force_mosaic(monkeypatch):
+    """The kernel wrappers select interpret mode off-TPU; lowering FOR
+    tpu must lower the real Mosaic kernel instead."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+
+@pytest.mark.parametrize("n,length", [(2, 8), (64, 24)])
+def test_search_fused_lowers_for_tpu(n, length):
+    d, pts, en = _batch(_problems(n, length))
+    _export_tpu(
+        lambda p, e: pallas_search._batched_search_fused(
+            p, jnp.int32(1 << 20), e),
+        pts, en)
+
+
+@pytest.mark.parametrize("n,length", [(2, 8), (64, 24)])
+def test_minimize_fused_lowers_for_tpu(n, length):
+    d, pts, en = _batch(_problems(n, length))
+    NV = pts.var_choices.shape[1]
+    B = pts.pos_bits_r.shape[0]
+    result = jnp.full(B, core.SAT, jnp.int32)
+    model = jnp.zeros((B, NV), jnp.int32)
+    guessed = jnp.zeros((B, NV), bool)
+    steps = jnp.zeros(B, jnp.int32)
+    _export_tpu(
+        lambda p, r, m, g, s, e: pallas_search._batched_minimize_fused(
+            p, r, m, g, jnp.int32(1 << 20), s, e),
+        pts, result, model, guessed, steps, en)
+
+
+@pytest.mark.parametrize("n,length", [(2, 8), (48, 24)])
+def test_core_fused_lowers_for_tpu(n, length):
+    problems = _problems(n, length)
+    d, pts, en = _batch(problems, pack=False, full=True)
+    steps = jnp.zeros(d.B, jnp.int32)
+    _export_tpu(
+        lambda p, s, e: pallas_search._batched_core_fused(
+            p, jnp.int32(1 << 20), s, e, V=d.V, NCON=d.NCON, NV=d.NV),
+        pts, steps, en)
+
+
+def test_blockwise_lowers_for_tpu():
+    from deppy_tpu.engine import pallas_blockwise
+
+    # Build the planes the fixpoint consumes directly; block_rows=16
+    # over 64 clause rows keeps the sweep multi-block after the 8-row
+    # sublane rounding.
+    pos = jnp.asarray(np.zeros((64, 4), np.int32))
+    neg = jnp.asarray(np.zeros((64, 4), np.int32))
+    mem = jnp.asarray(np.zeros((8, 4), np.int32))
+    card_active = jnp.zeros((8, 1), bool)
+    card_n2 = jnp.zeros((8, 1), jnp.int32)
+    min_bits = jnp.zeros((1, 4), jnp.int32)
+    t0 = jnp.zeros((1, 4), jnp.int32)
+    f0 = jnp.zeros((1, 4), jnp.int32)
+    _export_tpu(
+        lambda *a: pallas_blockwise.bcp_fixpoint(
+            *a, enabled=True, block_rows=16),
+        pos, neg, mem, card_active, card_n2, min_bits, jnp.int32(0),
+        t0, f0)
+
+
+def test_bcp_fused_lowers_for_tpu():
+    from deppy_tpu.engine import pallas_bcp
+
+    pos = jnp.asarray(np.zeros((64, 4), np.int32))
+    neg = jnp.asarray(np.zeros((64, 4), np.int32))
+    mem = jnp.asarray(np.zeros((8, 4), np.int32))
+    card_active = jnp.zeros((8, 1), bool)
+    card_n2 = jnp.zeros((8, 1), jnp.int32)
+    min_bits = jnp.zeros((1, 4), jnp.int32)
+    t0 = jnp.zeros((1, 4), jnp.int32)
+    f0 = jnp.zeros((1, 4), jnp.int32)
+    _export_tpu(
+        lambda *a: pallas_bcp.bcp_fixpoint(*a, enabled=True),
+        pos, neg, mem, card_active, card_n2, min_bits, jnp.int32(0),
+        t0, f0)
