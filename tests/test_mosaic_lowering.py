@@ -69,8 +69,10 @@ def _force_mosaic(monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
 
 
-@pytest.mark.parametrize("n,length", [(2, 8), (64, 24)])
+@pytest.mark.parametrize("n,length", [(2, 8), (64, 24), (512, 48)])
 def test_search_fused_lowers_for_tpu(n, length):
+    # (512, 48) is the EXACT production dispatch shape: the lane-cap
+    # chunk of the headline workload, what the on-chip A/B runs.
     d, pts, en = _batch(_problems(n, length))
     _export_tpu(
         lambda p, e: pallas_search._batched_search_fused(
