@@ -181,6 +181,16 @@ def _run_workload(platform: str | None, timeout_s: int) -> dict | None:
         except (json.JSONDecodeError, ValueError):
             continue
         if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            # Self-label any non-default engine knob the workload ran
+            # under: a knob-opt-in record in the shared ladder log must
+            # never pass for a default-config measurement (the ladder's
+            # F2 stage benches DEPPY_TPU_SEARCH=fused before the
+            # default flips).
+            for knob in ("DEPPY_TPU_SEARCH", "DEPPY_TPU_BCP"):
+                val = env.get(knob, "auto")
+                if val not in ("", "auto"):
+                    rec.setdefault(knob.removeprefix("DEPPY_TPU_").lower(),
+                                   val)
             return rec
     _log(f"workload produced no JSON record (platform={platform})")
     return None

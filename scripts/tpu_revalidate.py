@@ -61,6 +61,45 @@ def _emit(rec: dict, log_path: str) -> None:
     _emit_line(rec, log_path)
 
 
+def _log_line_count(log_path: str) -> int:
+    if not log_path:
+        return 0
+    try:
+        with open(log_path) as f:
+            return sum(1 for _ in f)
+    except OSError:
+        return 0
+
+
+def _fused_beat_baseline(log_path: str, from_line: int = 0):
+    """(baseline_rate, fused_rate) when THIS run's stage-F variant
+    records (lines appended at/after ``from_line`` — the shared /tmp log
+    carries older runs' records, including fused wins from before a
+    Mosaic regression) show search-fused ahead of baseline on a non-cpu
+    backend, else None."""
+    if not log_path:
+        return None
+    rates: dict = {}
+    try:
+        with open(log_path) as f:
+            lines = f.read().splitlines()[from_line:]
+    except OSError:
+        return None
+    for line in lines:  # newest-last wins per variant
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if (isinstance(rec, dict) and rec.get("variant")
+                and rec.get("ok") and rec.get("backend") != "cpu"
+                and isinstance(rec.get("rate"), (int, float))):
+            rates[rec["variant"]] = float(rec["rate"])
+    base, fused = rates.get("baseline"), rates.get("search-fused")
+    if base and fused and fused > base:
+        return base, fused
+    return None
+
+
 def _run_stage(name: str, cmd, env, timeout_s: int, log_path: str,
                **kwargs) -> dict:
     return run_stage({"stage": name, "ts": round(time.time(), 1)},
@@ -222,6 +261,7 @@ def main() -> None:
     if f_fused:
         _emit({"stage": "note", "msg": "mosaic smoke failed the fused "
                "search substrate; running stage F without it"}, a.log)
+    f_log_start = _log_line_count(a.log)
     if not _run_stage("F:tpu-ab",
                       [py, os.path.join(ROOT, "scripts", "tpu_ab.py"),
                        *f_shape, *f_fused, *log_args, *cpu_args],
@@ -230,6 +270,33 @@ def main() -> None:
         return
     if not healthy():
         return
+    # F2: when THIS run's smoke passed the fused substrate AND the A/B
+    # just measured it beating the XLA baseline, capture the headline
+    # bench under the winning knob right now — the heal window may not
+    # last until a human flips the default, and bench.py prefers the
+    # newest device record in this log, so the driver's next BENCH
+    # artifact carries the fused rate (bench.py labels the record with
+    # any non-default search knob).  The default itself stays XLA until
+    # the measured row is reviewed (the tree's measured-defaults
+    # policy).  F2 is an opportunistic BONUS artifact: its failure is
+    # noted and the safe stages E/G/H still run (same policy as
+    # tpu_ab's fused-failure continue).
+    fused_win = (search_fused_ok
+                 and _fused_beat_baseline(a.log, f_log_start))
+    if fused_win:
+        _emit({"stage": "note", "msg": "fused beat baseline "
+               f"({fused_win[1]:.1f} vs {fused_win[0]:.1f}/s); capturing "
+               "a fused-knob bench record"}, a.log)
+        env_f2 = dict(env_bench)
+        env_f2["DEPPY_TPU_SEARCH"] = "fused"
+        if not _run_stage("F2:bench-fused",
+                          [py, os.path.join(ROOT, "bench.py")],
+                          env_f2, 3400, a.log,
+                          require_stage_line=False)["ok"]:
+            _emit({"stage": "note", "msg": "F2 fused bench failed; "
+                   "continuing with the safe stages"}, a.log)
+        if not healthy():
+            return
     # E: full suite; the per-config JSON lines land in the stage log and
     # the aggregate in /tmp for a human to inspect and commit under
     # benchmarks/results/ with a backend-correct name.

@@ -29,6 +29,7 @@ class Script:
         self.fail_at = fail_at  # stage-name prefix that returns ok=False
         self.smoke_fail = smoke_fail  # kernel names the smoke fails
         self.smoke_verdict = True  # write a verdict file at all
+        self.f_variants = []    # (name, rate, backend) stage F "emits"
         self.stages = []        # (name, cmd) in call order
 
     def run_stage(self, rec, cmd, env, timeout_s, log_path, **kwargs):
@@ -48,6 +49,17 @@ class Script:
                 json.dump({"backend": self.backend, "kernels": {
                     k: {"ok": k not in self.smoke_fail} for k in kernels
                 }}, f)
+        if name == "F:tpu-ab" and self.f_variants:
+            # Model tpu_ab.py: variant records are emitted into the
+            # ladder log DURING stage F (the F2 gate reads only lines
+            # appended after F started).
+            import json
+
+            with open(log_path, "a") as f:
+                for vname, rate, backend in self.f_variants:
+                    f.write(json.dumps({"variant": vname, "ok": True,
+                                        "backend": backend,
+                                        "rate": rate}) + "\n")
         ok = not (self.fail_at and name.startswith(self.fail_at))
         rec.update(ok=ok, backend=self.backend, warm_s=1.0, run_s=0.1,
                    rate=10.0)
@@ -214,3 +226,62 @@ def test_backend_flip_mid_ladder_aborts(scripted, monkeypatch):
     tpu_revalidate.main()
     assert "D:bench.py" not in _names(s)
     assert "ladder-complete" not in _log_stages(log)
+
+
+def test_fused_win_captures_bench_fused(scripted):
+    s, log = scripted(backend="tpu")
+    s.f_variants = [("baseline", 3000.0, "tpu"),
+                    ("search-fused", 9000.0, "tpu")]
+    tpu_revalidate.main()
+    names = _names(s)
+    assert "F2:bench-fused" in names
+    assert names.index("F:tpu-ab") < names.index("F2:bench-fused") < \
+        names.index("E:suite")
+    assert s.envs["F2:bench-fused"]["DEPPY_TPU_SEARCH"] == "fused"
+    # The F2 bench must publish into the ladder log, like stage D.
+    assert s.envs["F2:bench-fused"]["DEPPY_BENCH_ARM_LADDER"] == "0"
+
+
+def test_fused_loss_skips_bench_fused(scripted):
+    s, log = scripted(backend="tpu")
+    s.f_variants = [("baseline", 3000.0, "tpu"),
+                    ("search-fused", 2000.0, "tpu")]
+    tpu_revalidate.main()
+    assert "F2:bench-fused" not in _names(s)
+
+
+def test_cpu_variant_records_do_not_trigger_bench_fused(scripted):
+    s, log = scripted(backend="tpu")
+    s.f_variants = [("baseline", 300.0, "cpu"),
+                    ("search-fused", 900.0, "cpu")]
+    tpu_revalidate.main()
+    assert "F2:bench-fused" not in _names(s)
+
+
+def test_stale_fused_win_in_shared_log_does_not_trigger(scripted):
+    """A fused win from a PREVIOUS run lingering in the shared /tmp log
+    must not launch F2 when this run's smoke rejected the substrate and
+    stage F skipped it (the regression the from_line gate exists for)."""
+    import json
+
+    s, log = scripted(backend="tpu")
+    s.smoke_fail = ("search-fused",)
+    with open(log, "w") as f:
+        for name, rate in (("baseline", 3000.0), ("search-fused", 9000.0)):
+            f.write(json.dumps({"variant": name, "ok": True,
+                                "backend": "tpu", "rate": rate}) + "\n")
+    tpu_revalidate.main()
+    assert "F2:bench-fused" not in _names(s)
+
+
+def test_failed_f2_still_runs_safe_stages(scripted):
+    """F2 is a bonus artifact: its failure is noted and E/G/H/I still
+    run to ladder-complete."""
+    s, log = scripted(backend="tpu", fail_at="F2:")
+    s.f_variants = [("baseline", 3000.0, "tpu"),
+                    ("search-fused", 9000.0, "tpu")]
+    tpu_revalidate.main()
+    names = _names(s)
+    assert "F2:bench-fused" in names
+    assert "E:suite" in names and "I:lane-probe" in names
+    assert "ladder-complete" in _log_stages(log)
