@@ -39,9 +39,10 @@ sharding live in :mod:`deppy_tpu.engine.driver` and
 from __future__ import annotations
 
 import functools
+import json
 import os
 import threading
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -522,11 +523,44 @@ def set_bcp_impl(name: str) -> None:
 # module; "fused" = the whole phase in ONE Pallas kernel per problem
 # (engine/pallas_search.py) — the escalation against the tunneled chip's
 # ~175µs-per-while-trip overhead (BASELINE.md; round-3 verdict #1).
-# "auto" = "xla" until the fused kernel is measured on a real chip: its
-# grid serializes problems, a measured-class loser on CPU XLA, and every
-# device bet in this tree defaults off until a BASELINE.md row exists
-# (scripts/tpu_ab.py carries the A/B variant).
+# "auto" = "xla" unless a MEASURED default exists for the current
+# backend (measured_defaults.json — written by the revalidation
+# ladder's stage F3 only after a same-run Mosaic smoke pass + paired
+# A/B win + full headline bench under the knob; every device bet in
+# this tree defaults off until such a measured row exists).  The env
+# knob and set_search_impl always override.
 _SEARCH_IMPL = os.environ.get("DEPPY_TPU_SEARCH", "auto")
+
+# Measured-default registry: {backend: {"search": "fused"|"xla", ...}}.
+# Package-local so an installed wheel carries its measured defaults;
+# DEPPY_TPU_MEASURED_DEFAULTS overrides the path (tests, the ladder).
+_MEASURED_DEFAULTS_PATH = os.environ.get(
+    "DEPPY_TPU_MEASURED_DEFAULTS",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "measured_defaults.json"))
+_MEASURED_DEFAULTS: Optional[dict] = None
+
+
+def _measured_default_search() -> Optional[str]:
+    global _MEASURED_DEFAULTS
+    if _MEASURED_DEFAULTS is None:
+        try:
+            with open(_MEASURED_DEFAULTS_PATH) as f:
+                loaded = json.load(f)
+            _MEASURED_DEFAULTS = loaded if isinstance(loaded, dict) else {}
+        except (OSError, ValueError):
+            _MEASURED_DEFAULTS = {}
+    entry = _MEASURED_DEFAULTS.get(jax.default_backend())
+    impl = entry.get("search") if isinstance(entry, dict) else None
+    return impl if impl in ("fused", "xla") else None
+
+
+def reload_measured_defaults() -> None:
+    """Drop the cached measured-default registry (tests; the ladder
+    after writing a new row) and invalidate compiled solves."""
+    global _MEASURED_DEFAULTS
+    _MEASURED_DEFAULTS = None
+    clear_batched_caches()
 
 
 def set_search_impl(name: str) -> None:
@@ -541,7 +575,7 @@ def set_search_impl(name: str) -> None:
 
 def _resolved_search_impl() -> str:
     if _SEARCH_IMPL == "auto":
-        return "xla"
+        return _measured_default_search() or "xla"
     return _SEARCH_IMPL
 
 

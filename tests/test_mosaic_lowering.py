@@ -142,3 +142,23 @@ def test_bcp_fused_lowers_for_tpu():
         lambda *a: pallas_bcp.bcp_fixpoint(*a, enabled=True),
         pos, neg, mem, card_active, card_n2, min_bits, jnp.int32(0),
         t0, f0)
+
+
+def test_measured_default_routes_auto_to_fused(monkeypatch, tmp_path):
+    """The F3 registry flips `auto` to the fused dispatcher on the
+    recorded backend — and only there."""
+    import json as _json
+
+    reg = tmp_path / "measured_defaults.json"
+    reg.write_text(_json.dumps(
+        {"tpu": {"search": "fused", "evidence": {}}}))
+    monkeypatch.setattr(core, "_MEASURED_DEFAULTS_PATH", str(reg))
+    try:
+        core.reload_measured_defaults()
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert core._resolved_search_impl() == "fused"
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert core._resolved_search_impl() == "xla"
+    finally:
+        monkeypatch.undo()
+        core.reload_measured_defaults()

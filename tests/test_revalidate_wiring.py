@@ -76,6 +76,9 @@ def scripted(monkeypatch, tmp_path):
         s = Script(**kw)
         monkeypatch.setattr(tpu_revalidate, "run_stage", s.run_stage)
         monkeypatch.setattr(tpu_revalidate, "probe_status", s.probe_status)
+        # Stage F3 must never touch the real package registry from a test.
+        monkeypatch.setenv("DEPPY_TPU_MEASURED_DEFAULTS",
+                           str(tmp_path / "measured_defaults.json"))
         monkeypatch.setattr(
             sys, "argv",
             ["tpu_revalidate.py", "--skip-wait",
@@ -285,3 +288,42 @@ def test_failed_f2_still_runs_safe_stages(scripted):
     assert "F2:bench-fused" in names
     assert "E:suite" in names and "I:lane-probe" in names
     assert "ladder-complete" in _log_stages(log)
+
+
+def test_f2_success_writes_measured_default(scripted, tmp_path):
+    import json
+
+    s, log = scripted(backend="tpu")
+    s.f_variants = [("baseline", 3000.0, "tpu"),
+                    ("search-fused", 9000.0, "tpu")]
+    tpu_revalidate.main()
+    path = tmp_path / "measured_defaults.json"
+    assert path.exists()
+    data = json.loads(path.read_text())
+    assert data["tpu"]["search"] == "fused"
+    assert data["tpu"]["evidence"]["fused_rate"] == 9000.0
+    assert "F3:measured-default" in _log_stages(log)
+
+
+def test_failed_f2_does_not_write_measured_default(scripted, tmp_path):
+    s, log = scripted(backend="tpu", fail_at="F2:")
+    s.f_variants = [("baseline", 3000.0, "tpu"),
+                    ("search-fused", 9000.0, "tpu")]
+    tpu_revalidate.main()
+    assert not (tmp_path / "measured_defaults.json").exists()
+
+
+def test_post_f3_stages_pin_the_preflip_substrate(scripted):
+    """After F3 records the fused default, the remaining stages must
+    keep measuring the PRE-flip substrate explicitly (their artifacts
+    are compared round-over-round), so the env knob is pinned to xla."""
+    s, log = scripted(backend="tpu")
+    s.f_variants = [("baseline", 3000.0, "tpu"),
+                    ("search-fused", 9000.0, "tpu")]
+    tpu_revalidate.main()
+    for stage in ("E:suite", "G:blockwise-overvmem", "H:spec-core-ab"):
+        assert s.envs[stage]["DEPPY_TPU_SEARCH"] == "xla", stage
+    # And without a fused win, nothing is pinned.
+    s2, _ = scripted(backend="tpu")
+    tpu_revalidate.main()
+    assert "DEPPY_TPU_SEARCH" not in s2.envs["E:suite"]
