@@ -541,7 +541,10 @@ _MEASURED_DEFAULTS_PATH = os.environ.get(
 _MEASURED_DEFAULTS: Optional[dict] = None
 
 
-def _measured_default_search() -> Optional[str]:
+def measured_default(key: str) -> Optional[str]:
+    """The measured default recorded for ``key`` on the current backend
+    (None when no measured row exists).  Keys in use: ``search``
+    (phase-substrate: 'fused'|'xla') and ``spec_core`` ('on'|'off')."""
     global _MEASURED_DEFAULTS
     if _MEASURED_DEFAULTS is None:
         try:
@@ -551,7 +554,12 @@ def _measured_default_search() -> Optional[str]:
         except (OSError, ValueError):
             _MEASURED_DEFAULTS = {}
     entry = _MEASURED_DEFAULTS.get(jax.default_backend())
-    impl = entry.get("search") if isinstance(entry, dict) else None
+    val = entry.get(key) if isinstance(entry, dict) else None
+    return val if isinstance(val, str) else None
+
+
+def _measured_default_search() -> Optional[str]:
+    impl = measured_default("search")
     return impl if impl in ("fused", "xla") else None
 
 

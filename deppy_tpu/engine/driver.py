@@ -480,9 +480,15 @@ SPEC_CORE_CAP = int(os.environ.get("DEPPY_TPU_SPEC_CORE_CAP", str(1 << 15)))
 def _spec_core_enabled() -> bool:
     if SPEC_CORE == "1":
         return True
-    # "auto" is currently off on every backend: the accelerator upside is
-    # unmeasured while the downside is a known worker-crash class (see
-    # SPEC_CORE above).
+    if SPEC_CORE == "auto":
+        # Measured default per backend: the revalidation ladder's stage
+        # H records the full-scale A/B verdict ('on' only when the
+        # speculative sweep agreed with the host sweep AND won on time)
+        # in the measured-defaults registry; with no measured row the
+        # conservative answer stays OFF — the accelerator upside is
+        # unmeasured while the downside is a known worker-crash class
+        # (see SPEC_CORE above).
+        return core.measured_default("spec_core") == "on"
     return False
 
 

@@ -71,10 +71,12 @@ def _log_line_count(log_path: str) -> int:
         return 0
 
 
-def _write_measured_default(backend: str, fused_win, log_path: str) -> None:
-    """Record a measured search-substrate default for ``backend`` in the
+def _write_measured_default(backend: str, stage: str, updates: dict,
+                            evidence: dict, log_path: str) -> None:
+    """Merge measured-default ``updates`` for ``backend`` into the
     package-local registry (DEPPY_TPU_MEASURED_DEFAULTS overrides the
-    path).  Merge-writes so other backends' rows survive."""
+    path); other backends' rows and this backend's other keys
+    survive."""
     path = os.environ.get(
         "DEPPY_TPU_MEASURED_DEFAULTS",
         os.path.join(ROOT, "deppy_tpu", "engine", "measured_defaults.json"))
@@ -85,23 +87,68 @@ def _write_measured_default(backend: str, fused_win, log_path: str) -> None:
             data = {}
     except (OSError, ValueError):
         data = {}
-    data[backend] = {
-        "search": "fused",
-        "evidence": {
-            "baseline_rate": round(fused_win[0], 1),
-            "fused_rate": round(fused_win[1], 1),
-            "ts": round(time.time(), 1),
-            "ladder_log": os.path.abspath(log_path) if log_path else "",
-        },
-    }
+    entry = data.get(backend)
+    if not isinstance(entry, dict):
+        entry = {}
+    entry.update(updates)
+    ev = entry.get("evidence")
+    if not isinstance(ev, dict):
+        ev = {}
+    # Evidence is nested PER KEY: a later run that measures only one
+    # key must not re-stamp provenance (ts / ladder_log) on rows it
+    # never measured.
+    stamp = {**evidence, "ts": round(time.time(), 1),
+             "ladder_log": os.path.abspath(log_path) if log_path else ""}
+    for key in updates:
+        ev[key] = dict(stamp)
+    entry["evidence"] = ev
+    data[backend] = entry
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(data, f, indent=2, sort_keys=True)
         f.write("\n")
     os.replace(tmp, path)
-    _emit_line({"stage": "F3:measured-default",
-                "backend": backend, "search": "fused",
+    _emit_line({"stage": stage, "backend": backend, **updates,
                 "path": path}, log_path)
+
+
+def _records_since(log_path: str, from_line: int) -> list:
+    """Parsed dict records appended to the ladder log at/after
+    ``from_line`` (bad/partial lines skipped)."""
+    if not log_path:
+        return []
+    try:
+        with open(log_path) as f:
+            lines = f.read().splitlines()[from_line:]
+    except OSError:
+        return []
+    out = []
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def _spec_core_verdict(log_path: str, from_line: int = 0):
+    """Stage H's final verdict line from THIS run: ('on'|'off', rec)
+    when the A/B landed with agreeing cores, else None.  ON requires
+    both agreement and a time win; a measured loss records OFF (it
+    resolves the pending-measurement default either way)."""
+    for rec in reversed(_records_since(log_path, from_line)):
+        if "verdict" not in rec:
+            continue
+        if rec.get("verdict") != "ok":
+            return None  # divergence: never flip on a wrong answer
+        on_s, off_s = rec.get("on_s"), rec.get("off_s")
+        if (isinstance(on_s, (int, float))
+                and isinstance(off_s, (int, float))):
+            return ("on" if on_s < off_s else "off"), rec
+        return None
+    return None
 
 
 def _fused_beat_baseline(log_path: str, from_line: int = 0):
@@ -113,18 +160,9 @@ def _fused_beat_baseline(log_path: str, from_line: int = 0):
     if not log_path:
         return None
     rates: dict = {}
-    try:
-        with open(log_path) as f:
-            lines = f.read().splitlines()[from_line:]
-    except OSError:
-        return None
-    for line in lines:  # newest-last wins per variant
-        try:
-            rec = json.loads(line)
-        except (json.JSONDecodeError, ValueError):
-            continue
-        if (isinstance(rec, dict) and rec.get("variant")
-                and rec.get("ok") and rec.get("backend") != "cpu"
+    for rec in _records_since(log_path, from_line):  # newest-last wins
+        if (rec.get("variant") and rec.get("ok")
+                and rec.get("backend") != "cpu"
                 and isinstance(rec.get("rate"), (int, float))):
             rates[rec["variant"]] = float(rec["rate"])
     base, fused = rates.get("baseline"), rates.get("search-fused")
@@ -344,7 +382,10 @@ def main() -> None:
             # measurements), so pin the env knob for them; bench.py
             # labels any non-auto knob in its records.
             _write_measured_default(
-                ladder_backend[0] or "tpu", fused_win, a.log)
+                ladder_backend[0] or "tpu", "F3:measured-default",
+                {"search": "fused"},
+                {"baseline_rate": round(fused_win[0], 1),
+                 "fused_rate": round(fused_win[1], 1)}, a.log)
             env_rest = dict(env_rest)
             env_rest["DEPPY_TPU_SEARCH"] = "xla"
         if not healthy():
@@ -383,6 +424,7 @@ def main() -> None:
     # Known crash-risk class (minutes-long single executions), hence
     # after F/G.
     h_shape = (["--packages", "40", "--versions", "4"] if smoke else [])
+    h_log_start = _log_line_count(a.log)
     if not _run_stage("H:spec-core-ab",
                       [py, os.path.join(ROOT, "scripts",
                                         "spec_core_ab.py"),
@@ -390,6 +432,19 @@ def main() -> None:
                       env_rest, 2400, a.log,
                       require_stage_line=False)["ok"]:
         return
+    # H3: the full-scale spec-core verdict resolves the two-round-old
+    # pending default (driver.SPEC_CORE auto) — record the measured
+    # winner either way (OFF is a verdict too; only an agreeing,
+    # faster ON flips it on).  Smoke-shape runs measure plumbing, not
+    # the device, so only a device-backend ladder records.
+    if not smoke:
+        sc = _spec_core_verdict(a.log, h_log_start)
+        if sc is not None:
+            _write_measured_default(
+                ladder_backend[0] or "tpu", "H3:measured-default",
+                {"spec_core": sc[0]},
+                {"spec_core_on_s": sc[1].get("on_s"),
+                 "spec_core_off_s": sc[1].get("off_s")}, a.log)
     # I: lane-width boundary probe — LAST, per its own CAUTION: it is
     # EXPECTED to crash the worker at the boundary, and everything worth
     # protecting is already on disk by now.  No healthy() gate after.

@@ -162,3 +162,28 @@ def test_measured_default_routes_auto_to_fused(monkeypatch, tmp_path):
     finally:
         monkeypatch.undo()
         core.reload_measured_defaults()
+
+
+def test_measured_default_resolves_spec_core(monkeypatch, tmp_path):
+    import json as _json
+
+    from deppy_tpu.engine import driver
+
+    reg = tmp_path / "measured_defaults.json"
+    reg.write_text(_json.dumps(
+        {"tpu": {"spec_core": "on", "evidence": {}}}))
+    monkeypatch.setattr(core, "_MEASURED_DEFAULTS_PATH", str(reg))
+    monkeypatch.setattr(driver, "SPEC_CORE", "auto")
+    try:
+        core.reload_measured_defaults()
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert driver._spec_core_enabled()
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert not driver._spec_core_enabled()
+        # The env knob still overrides the registry in both directions.
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(driver, "SPEC_CORE", "0")
+        assert not driver._spec_core_enabled()
+    finally:
+        monkeypatch.undo()
+        core.reload_measured_defaults()

@@ -30,6 +30,7 @@ class Script:
         self.smoke_fail = smoke_fail  # kernel names the smoke fails
         self.smoke_verdict = True  # write a verdict file at all
         self.f_variants = []    # (name, rate, backend) stage F "emits"
+        self.h_verdict = None   # dict stage H "emits" as its verdict line
         self.stages = []        # (name, cmd) in call order
 
     def run_stage(self, rec, cmd, env, timeout_s, log_path, **kwargs):
@@ -60,6 +61,11 @@ class Script:
                     f.write(json.dumps({"variant": vname, "ok": True,
                                         "backend": backend,
                                         "rate": rate}) + "\n")
+        if name == "H:spec-core-ab" and self.h_verdict is not None:
+            import json
+
+            with open(log_path, "a") as f:
+                f.write(json.dumps(self.h_verdict) + "\n")
         ok = not (self.fail_at and name.startswith(self.fail_at))
         rec.update(ok=ok, backend=self.backend, warm_s=1.0, run_s=0.1,
                    rate=10.0)
@@ -301,7 +307,7 @@ def test_f2_success_writes_measured_default(scripted, tmp_path):
     assert path.exists()
     data = json.loads(path.read_text())
     assert data["tpu"]["search"] == "fused"
-    assert data["tpu"]["evidence"]["fused_rate"] == 9000.0
+    assert data["tpu"]["evidence"]["search"]["fused_rate"] == 9000.0
     assert "F3:measured-default" in _log_stages(log)
 
 
@@ -327,3 +333,51 @@ def test_post_f3_stages_pin_the_preflip_substrate(scripted):
     s2, _ = scripted(backend="tpu")
     tpu_revalidate.main()
     assert "DEPPY_TPU_SEARCH" not in s2.envs["E:suite"]
+
+
+def test_spec_core_win_records_on(scripted, tmp_path):
+    import json
+
+    s, log = scripted(backend="tpu")
+    s.h_verdict = {"verdict": "ok", "off_s": 8.6, "on_s": 2.9}
+    tpu_revalidate.main()
+    data = json.loads((tmp_path / "measured_defaults.json").read_text())
+    assert data["tpu"]["spec_core"] == "on"
+    assert "H3:measured-default" in _log_stages(log)
+
+
+def test_spec_core_loss_records_off(scripted, tmp_path):
+    import json
+
+    s, log = scripted(backend="tpu")
+    s.h_verdict = {"verdict": "ok", "off_s": 2.1, "on_s": 27.6}
+    tpu_revalidate.main()
+    data = json.loads((tmp_path / "measured_defaults.json").read_text())
+    assert data["tpu"]["spec_core"] == "off"
+
+
+def test_spec_core_divergence_records_nothing(scripted, tmp_path):
+    s, log = scripted(backend="tpu")
+    s.h_verdict = {"verdict": "CORE-DIVERGENCE", "off_s": 2.0, "on_s": 1.0}
+    tpu_revalidate.main()
+    assert not (tmp_path / "measured_defaults.json").exists()
+
+
+def test_smoke_ladder_never_records_spec_core(scripted, tmp_path):
+    s, log = scripted(backend="cpu")
+    s.h_verdict = {"verdict": "ok", "off_s": 8.0, "on_s": 2.0}
+    tpu_revalidate.main()
+    assert not (tmp_path / "measured_defaults.json").exists()
+
+
+def test_f3_and_h3_rows_merge(scripted, tmp_path):
+    import json
+
+    s, log = scripted(backend="tpu")
+    s.f_variants = [("baseline", 3000.0, "tpu"),
+                    ("search-fused", 9000.0, "tpu")]
+    s.h_verdict = {"verdict": "ok", "off_s": 8.6, "on_s": 2.9}
+    tpu_revalidate.main()
+    data = json.loads((tmp_path / "measured_defaults.json").read_text())
+    assert data["tpu"]["search"] == "fused"
+    assert data["tpu"]["spec_core"] == "on"
