@@ -28,7 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from scripts._stage import emit, make_healthy, run_stage, solve_stage_src
 
 KNOB_VARS = ("DEPPY_TPU_BCP_UNROLL", "DEPPY_TPU_STAGE1_STEPS",
-             "DEPPY_TPU_SEARCH")
+             "DEPPY_TPU_SEARCH", "DEPPY_TPU_MAX_LANES")
 
 # (name, knobs, tpu_only): tpu_only variants are SKIPPED when the pinned
 # backend is cpu — search-fused there runs the Pallas kernel in
@@ -50,6 +50,13 @@ VARIANTS = [
     ("unroll4", {"DEPPY_TPU_BCP_UNROLL": "4"}, False),
     ("unroll2+stage1-96", {"DEPPY_TPU_BCP_UNROLL": "2",
                            "DEPPY_TPU_STAGE1_STEPS": "96"}, False),
+    # Chunk-width DOWN-probe: 512-lane lockstep pays max-steps-in-chunk
+    # trips for every lane; smaller chunks trade straggler waste for
+    # more per-chunk dispatch.  Round 4's lane_probe only measured
+    # WIDER (512->4096, flat then worse on CPU); the narrow side is
+    # unmeasured on the chip.
+    ("lanes-128", {"DEPPY_TPU_MAX_LANES": "128"}, False),
+    ("lanes-256", {"DEPPY_TPU_MAX_LANES": "256"}, False),
 ]
 
 
