@@ -417,6 +417,18 @@ _BCP_IMPL = os.environ.get("DEPPY_TPU_BCP", "auto")
 # dominates.  Default 1; A/B on a real TPU before ever raising it.
 _BCP_UNROLL = max(1, int(os.environ.get("DEPPY_TPU_BCP_UNROLL", "1")))
 
+# Decision steps applied per dpll while_loop trip — the decision-level
+# twin of _BCP_UNROLL, one level up the trip hierarchy (search trips =
+# episodes × decisions × propagation rounds; this attacks the middle
+# factor).  The dpll body is fully lane-gated on a ``live`` predicate
+# (status RUNNING and in budget), so K-fold body repetition inside one
+# trip is exit-state- and step-count-identical at any K: a finished or
+# budget-exhausted lane's extra applications are no-ops.  Same bet
+# shape as _BCP_UNROLL — redundant gated work for fewer ~175µs trips —
+# and same policy: default 1 everywhere until a real-chip A/B row
+# exists (scripts/tpu_ab.py carries dpll-unroll variants).
+_DPLL_UNROLL = max(1, int(os.environ.get("DEPPY_TPU_DPLL_UNROLL", "1")))
+
 
 def _batch_planes(clauses: jax.Array, W: int) -> Tuple[jax.Array, jax.Array]:
     """Batched signed clause matrices [B, C, K] → (pos, neg) packed int32
@@ -846,12 +858,19 @@ def dpll(pt: ProblemTensors, t_init: jax.Array, f_init: jax.Array,
         word = un_words[wi]
         lsb = word & -word
         first_un = wi * WORD + popcount32(lsb - 1)
-        sat_now = ~flip & ~has_un
+        # ``live`` restates the while cond inside the body: under
+        # _DPLL_UNROLL > 1 repeated applications run WITHOUT a cond
+        # check between them, and a lane that finished or exhausted its
+        # budget mid-trip must be inert — including for the SAT check,
+        # which would otherwise overwrite a budget-exhausted RUNNING
+        # verdict.  At unroll 1 this is exactly what cond guaranteed.
+        live = (status == RUNNING) & (steps <= budget)
+        sat_now = live & ~flip & ~has_un
         status = jnp.where(sat_now, jnp.int32(SAT), status)
         m_t = jnp.where(sat_now, t, m_t)
         m_f = jnp.where(sat_now, f, m_f)
 
-        do_step = status == RUNNING
+        do_step = live & (status == RUNNING)
         # The decision applied this iteration: a pending flip re-tries the
         # level's variable true, otherwise decide first-unassigned false.
         var = jnp.where(flip, dec_var[jnp.clip(sp, 0, NV - 1)], first_un)
@@ -898,6 +917,12 @@ def dpll(pt: ProblemTensors, t_init: jax.Array, f_init: jax.Array,
         _, _, _, _, status, _, _, _, _, steps = st
         return enabled & (status == RUNNING) & (steps <= budget)
 
+    def trip(st):
+        st = body(st)
+        for _ in range(_DPLL_UNROLL - 1):
+            st = body(st)  # gated repeats: no-ops on finished lanes
+        return st
+
     st = (
         jnp.zeros(NV, jnp.int32),
         jnp.zeros(NV, jnp.int32),
@@ -908,7 +933,7 @@ def dpll(pt: ProblemTensors, t_init: jax.Array, f_init: jax.Array,
         snap_t0, snap_f0,
         steps,
     )
-    (_, _, _, _, status, m_t, m_f, _, _, steps) = lax.while_loop(cond, body, st)
+    (_, _, _, _, status, m_t, m_f, _, _, steps) = lax.while_loop(cond, trip, st)
     return status, m_t, m_f, steps
 
 

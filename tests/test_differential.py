@@ -114,3 +114,58 @@ def test_single_device_solve_matches_host(seed: int):
     except sat.NotSatisfiable as e:
         dev = ("unsat", sorted(str(ac) for ac in e.constraints))
     assert host == dev
+
+
+_UNROLL_BUDGETS = (None, 7, 33, 200)
+
+
+def _unroll_problems():
+    from deppy_tpu.sat.encode import encode
+
+    return [encode(random_instance(length=24, seed=s))
+            for s in range(4)] + [
+        encode(random_instance(length=16, seed=s, p_mandatory=0.5,
+                               p_conflict=0.5, n_conflict=4))
+        for s in range(4)
+    ]
+
+
+def _unroll_solve_all(problems):
+    import numpy as np
+
+    from deppy_tpu.engine import driver
+
+    return [
+        [(int(r.outcome), np.asarray(r.installed).tolist(),
+          np.asarray(r.core).tolist(), int(r.steps))
+         for r in driver.solve_problems(problems, max_steps=b)]
+        for b in _UNROLL_BUDGETS
+    ]
+
+
+@pytest.fixture(scope="module")
+def unroll_baseline():
+    """Unroll-1 snapshots, computed once for every parametrized K."""
+    problems = _unroll_problems()
+    return problems, _unroll_solve_all(problems)
+
+
+@pytest.mark.parametrize("unroll", [2, 3])
+def test_dpll_unroll_is_bit_identical(monkeypatch, unroll, unroll_baseline):
+    """_DPLL_UNROLL repeats the gated dpll body inside one while trip;
+    the contract is EXIT-STATE IDENTITY at any setting — outcomes,
+    installed sets, cores, and step counts — including under budgets
+    that exhaust mid-trip (the ``live`` gate's corner: a repeat must
+    never flip a budget-exhausted RUNNING lane to SAT)."""
+    from deppy_tpu.engine import core
+
+    problems, base = unroll_baseline
+    monkeypatch.setattr(core, "_DPLL_UNROLL", unroll)
+    core.clear_batched_caches()
+    try:
+        got = _unroll_solve_all(problems)
+    finally:
+        monkeypatch.undo()
+        core.clear_batched_caches()
+    for b, x, y in zip(_UNROLL_BUDGETS, base, got):
+        assert x == y, f"unroll {unroll} diverged at budget {b}"
