@@ -429,6 +429,13 @@ _BCP_UNROLL = max(1, int(os.environ.get("DEPPY_TPU_BCP_UNROLL", "1")))
 # exists (scripts/tpu_ab.py carries dpll-unroll variants).
 _DPLL_UNROLL = max(1, int(os.environ.get("DEPPY_TPU_DPLL_UNROLL", "1")))
 
+# Episode-control steps (guess-stack pushes/pops) applied per control
+# while_loop trip — the outermost factor of the trip product.  Same
+# gated-repeat construction and same identity contract as _DPLL_UNROLL
+# (the control body's arms are selected under a ``live`` predicate);
+# default 1 until an on-chip A/B row exists.
+_CTL_UNROLL = max(1, int(os.environ.get("DEPPY_TPU_CTL_UNROLL", "1")))
+
 
 def _batch_planes(clauses: jax.Array, W: int) -> Tuple[jax.Array, jax.Array]:
     """Batched signed clause matrices [B, C, K] → (pos, neg) packed int32
@@ -1009,10 +1016,17 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
          steps, tr_stack, tr_n) = st
 
         # Arm selection (mutually exclusive; reference precedence order).
-        is_leaf = (cnt == 0) & (result == RUNNING)
-        is_bt = ~is_leaf & (result == UNSAT)
-        is_done = ~is_leaf & ~is_bt & (cnt == 0)
-        is_push = ~is_leaf & ~is_bt & ~is_done
+        # ``live`` restates ctl_cond inside the body: under
+        # _CTL_UNROLL > 1 repeated applications run without a cond check
+        # between them, and a parked (need_leaf), done, or
+        # budget-exhausted lane must take NO arm — every write below is
+        # gated through an arm flag, so a non-live application is inert.
+        # At unroll 1 this is exactly what ctl_cond guaranteed.
+        live = ~done & ~need_leaf & (steps <= budget)
+        is_leaf = live & (cnt == 0) & (result == RUNNING)
+        is_bt = live & ~is_leaf & (result == UNSAT)
+        is_done = live & ~is_leaf & ~is_bt & (cnt == 0)
+        is_push = live & ~is_leaf & ~is_bt & ~is_done
 
         # Trace: the reference fires Tracer.Trace at every backtrack entry
         # (search.go:172-173) with the pre-pop guess stack.
@@ -1136,7 +1150,13 @@ def search(pt: ProblemTensors, t0: jax.Array, f0: jax.Array,
     def episode_body(st):
         # Drain control arms until every live lane is done or parked at a
         # leaf, then run one lane-gated dpll for all parked lanes.
-        st = lax.while_loop(ctl_cond, body, st)
+        def ctl_trip(s):
+            s = body(s)
+            for _ in range(_CTL_UNROLL - 1):
+                s = body(s)  # gated repeats: no-ops on non-live lanes
+            return s
+
+        st = lax.while_loop(ctl_cond, ctl_trip, st)
         (dq_c, dq_i, head, cnt, g_c, g_i, g_v, g_ch, gsp,
          snap_t, snap_f, out_st, result, m_t, m_f, assumed, done, need_leaf,
          steps, tr_stack, tr_n) = st

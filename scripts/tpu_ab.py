@@ -29,7 +29,7 @@ from scripts._stage import emit, make_healthy, run_stage, solve_stage_src
 
 KNOB_VARS = ("DEPPY_TPU_BCP_UNROLL", "DEPPY_TPU_STAGE1_STEPS",
              "DEPPY_TPU_SEARCH", "DEPPY_TPU_MAX_LANES",
-             "DEPPY_TPU_DPLL_UNROLL")
+             "DEPPY_TPU_DPLL_UNROLL", "DEPPY_TPU_CTL_UNROLL")
 
 # (name, knobs, tpu_only): tpu_only variants are SKIPPED when the pinned
 # backend is cpu — search-fused there runs the Pallas kernel in
@@ -51,9 +51,12 @@ VARIANTS = [
     # trip — attacks the middle factor of the trip product (episodes ×
     # decisions × propagation rounds) at ~10µs of redundant gated work
     # against ~175µs of trip overhead saved per elided trip.
-    # Exit-state-identical at any K (test_dpll_unroll_is_bit_identical).
+    # Exit-state-identical at any K (test_trip_unroll_is_bit_identical).
     ("dpll-unroll-2", {"DEPPY_TPU_DPLL_UNROLL": "2"}, False),
     ("dpll-unroll-4", {"DEPPY_TPU_DPLL_UNROLL": "4"}, False),
+    ("ctl-unroll-4", {"DEPPY_TPU_CTL_UNROLL": "4"}, False),
+    ("dpll2+ctl2", {"DEPPY_TPU_DPLL_UNROLL": "2",
+                    "DEPPY_TPU_CTL_UNROLL": "2"}, False),
     ("unroll2", {"DEPPY_TPU_BCP_UNROLL": "2"}, False),
     ("unroll4", {"DEPPY_TPU_BCP_UNROLL": "4"}, False),
     ("unroll2+stage1-96", {"DEPPY_TPU_BCP_UNROLL": "2",

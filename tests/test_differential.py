@@ -150,17 +150,25 @@ def unroll_baseline():
     return problems, _unroll_solve_all(problems)
 
 
-@pytest.mark.parametrize("unroll", [2, 3])
-def test_dpll_unroll_is_bit_identical(monkeypatch, unroll, unroll_baseline):
-    """_DPLL_UNROLL repeats the gated dpll body inside one while trip;
-    the contract is EXIT-STATE IDENTITY at any setting — outcomes,
-    installed sets, cores, and step counts — including under budgets
-    that exhaust mid-trip (the ``live`` gate's corner: a repeat must
-    never flip a budget-exhausted RUNNING lane to SAT)."""
+@pytest.mark.parametrize("knob,unroll", [
+    ("_DPLL_UNROLL", 2), ("_DPLL_UNROLL", 3),
+    ("_CTL_UNROLL", 2), ("_CTL_UNROLL", 3),
+    ("BOTH", 2),
+])
+def test_trip_unroll_is_bit_identical(monkeypatch, knob, unroll,
+                                      unroll_baseline):
+    """_DPLL_UNROLL / _CTL_UNROLL repeat the gated dpll / episode-control
+    bodies inside one while trip; the contract is EXIT-STATE IDENTITY at
+    any setting — outcomes, installed sets, cores, and step counts —
+    including under budgets that exhaust mid-trip (the ``live`` gates'
+    corner: a repeat must never advance a budget-exhausted or parked
+    lane)."""
     from deppy_tpu.engine import core
 
     problems, base = unroll_baseline
-    monkeypatch.setattr(core, "_DPLL_UNROLL", unroll)
+    for attr in (("_DPLL_UNROLL", "_CTL_UNROLL") if knob == "BOTH"
+                 else (knob,)):
+        monkeypatch.setattr(core, attr, unroll)
     core.clear_batched_caches()
     try:
         got = _unroll_solve_all(problems)
@@ -168,4 +176,4 @@ def test_dpll_unroll_is_bit_identical(monkeypatch, unroll, unroll_baseline):
         monkeypatch.undo()
         core.clear_batched_caches()
     for b, x, y in zip(_UNROLL_BUDGETS, base, got):
-        assert x == y, f"unroll {unroll} diverged at budget {b}"
+        assert x == y, f"{knob}={unroll} diverged at budget {b}"
