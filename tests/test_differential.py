@@ -177,3 +177,46 @@ def test_trip_unroll_is_bit_identical(monkeypatch, knob, unroll,
         core.clear_batched_caches()
     for b, x, y in zip(_UNROLL_BUDGETS, base, got):
         assert x == y, f"{knob}={unroll} diverged at budget {b}"
+
+
+@pytest.mark.parametrize("knob", ["_DPLL_UNROLL", "_CTL_UNROLL"])
+def test_trip_unroll_preserves_backtrack_traces(monkeypatch, knob):
+    """The tracer contract under unrolled trips: backtrack trace rows
+    and counts are written INSIDE the repeated control body, so they
+    must be identical at any K (sequential applications preserve
+    order; non-live repeats write nothing)."""
+    import numpy as np
+
+    from deppy_tpu.engine import core, driver
+    from deppy_tpu.sat.encode import encode
+
+    # Backtracks need a guess that only deeper propagation refutes:
+    # b needs one of {x, y} and one of {w, z}, but every cross pair
+    # conflicts (the tracer suite's doomed construction).
+    doomed = [
+        sat.variable("b", sat.mandatory(), sat.dependency("x", "y"),
+                     sat.dependency("w", "z")),
+        sat.variable("x", sat.conflict("w"), sat.conflict("z")),
+        sat.variable("y", sat.conflict("w"), sat.conflict("z")),
+        sat.variable("w"), sat.variable("z"),
+    ]
+    problems = [encode(doomed)] + [
+        encode(random_instance(length=20, seed=s,
+                               p_mandatory=0.4, p_conflict=0.4))
+        for s in range(3)]
+
+    def traces():
+        out = driver.solve_problems(problems, trace_cap=8)
+        return [(int(r.trace_n), np.asarray(r.trace_stack).tolist())
+                for r in out]
+
+    base = traces()
+    assert any(n > 0 for n, _ in base), "distribution produced no backtracks"
+    monkeypatch.setattr(core, knob, 3)
+    core.clear_batched_caches()
+    try:
+        got = traces()
+    finally:
+        monkeypatch.undo()
+        core.clear_batched_caches()
+    assert got == base
