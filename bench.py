@@ -361,6 +361,14 @@ def main() -> int:
             return 0
         _log("falling back to forced-CPU platform")
         rec = _run_workload("cpu", RUN_TIMEOUT_S)
+        if rec is None:
+            # Even forced-CPU init can hang INTERMITTENTLY while the
+            # tunnel is wedged (sitecustomize registers the PJRT plugin
+            # in every fresh python; observed 2026-08-01: one cpu-env
+            # probe hung, the retry a minute later succeeded).  One
+            # retry before surrendering to the zero-value record.
+            _log("forced-CPU workload failed; one retry")
+            rec = _run_workload("cpu", RUN_TIMEOUT_S)
         used = "cpu"
         if rec is not None:
             # Not the headline (the machine's device is down NOW), but
