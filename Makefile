@@ -36,6 +36,14 @@ e2e: ## End-to-end: boot the service, exercise probes/metrics/resolve (reference
 e2e-docker: docker-build ## e2e against the built container image.
 	DEPPY_E2E_MODE=docker IMG=$(IMG) bash scripts/e2e.sh
 
+.PHONY: metrics-smoke
+metrics-smoke: ## Boot the service on an ephemeral port, resolve the golden problem, assert a nonzero /metrics scrape.
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_smoke.py
+
+.PHONY: test-telemetry
+test-telemetry: ## Observability subsystem tests only (the `telemetry` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m telemetry
+
 ##@ Benchmarks
 
 .PHONY: bench

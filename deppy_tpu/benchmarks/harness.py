@@ -76,7 +76,7 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
         f"device: {n} in {dev_s:.3f}s = {rate:.1f}/s "
         f"({n_sat} sat / {n_unsat} unsat; warm-up {warm_s:.1f}s)"
     )
-    return {
+    out = {
         "n_problems": n,
         "host_s_per_problem": host_s,
         "device_seconds": dev_s,
@@ -85,3 +85,23 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
         "sat": n_sat,
         "unsat": n_unsat,
     }
+    # Occupancy/fallback telemetry from the timed dispatch (ISSUE 1): the
+    # driver publishes a SolveReport per solve_problems call; carrying it
+    # in the record means every BENCH_*.json row shows how much of the
+    # measured batch was padding and which escalation stage resolved it.
+    from .. import telemetry
+
+    rep = telemetry.last_report()
+    if rep is not None:
+        out["telemetry"] = {
+            "batch_fill_ratio": round(rep.batch_fill_ratio, 4),
+            "pad_waste_ratio": round(rep.pad_waste_ratio, 4),
+            "escalation_stage": rep.escalation_stage,
+            "host_fallback_rows": rep.host_fallback_rows,
+            "backtracks": rep.backtracks,
+            "steps": rep.steps,
+            "n_chunks": rep.n_chunks,
+            "n_buckets": rep.n_buckets,
+        }
+        log(rep.format_table())
+    return out

@@ -63,6 +63,11 @@ def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
         "host_rate_live": round(1.0 / m["host_s_per_problem"], 1),
         "host_rate_used": round(1.0 / host_s, 1),
     }
+    if "telemetry" in m:
+        # Occupancy and fallback columns ride in every BENCH row (ISSUE
+        # 1): a throughput regression can then be attributed to padding
+        # waste or host routing without a rerun.
+        result["telemetry"] = m["telemetry"]
     print(json.dumps(result), flush=True)
     return result
 

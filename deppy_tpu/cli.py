@@ -10,7 +10,9 @@ directs the rebuild to make it real:
   * ``deppy bench``         — run the headline benchmark and print its one
     JSON line;
   * ``deppy serve``         — run the batch-resolution service (the analog
-    of the reference's controller manager, main.go:46-86).
+    of the reference's controller manager, main.go:46-86);
+  * ``deppy stats``         — summarize a telemetry JSONL file (spans +
+    last solve report; docs/observability.md).
 
 Exit codes: 0 = all problems satisfiable, 1 = at least one unsatisfiable,
 2 = bad input / usage, 3 = incomplete (iteration budget exhausted before a
@@ -67,6 +69,20 @@ def _build_parser() -> argparse.ArgumentParser:
         "a crashed batch run from its completed groups (tensor backend; "
         "see deppy_tpu.engine.checkpoint)",
     )
+    p_resolve.add_argument(
+        "--telemetry-file",
+        default=None,
+        metavar="FILE",
+        help="append every pipeline span and the per-batch solve report "
+        "as JSONL events to FILE (also via DEPPY_TPU_TELEMETRY_FILE; "
+        "summarize with `deppy stats FILE`)",
+    )
+    p_resolve.add_argument(
+        "--report",
+        action="store_true",
+        help="print the per-batch solve report (padding occupancy, "
+        "escalation stage, host fallback) on stderr after resolving",
+    )
 
     p_bench = sub.add_parser(
         "bench", help="run the headline benchmark (one JSON line on stdout)"
@@ -100,6 +116,26 @@ def _build_parser() -> argparse.ArgumentParser:
         "controller_manager_config.yaml:1-11); explicitly passed flags "
         "override file values",
     )
+    p_serve.add_argument(
+        "--telemetry-file", default=None, metavar="FILE",
+        help="append every pipeline span and per-batch solve report as "
+        "JSONL events to FILE (also via DEPPY_TPU_TELEMETRY_FILE)",
+    )
+
+    p_stats = sub.add_parser(
+        "stats",
+        help="summarize a telemetry JSONL file: per-span counts/timings "
+        "and the last solve report (see docs/observability.md)",
+    )
+    p_stats.add_argument(
+        "file", nargs="?", default=None,
+        help="telemetry JSONL file (default: $DEPPY_TPU_TELEMETRY_FILE)",
+    )
+    p_stats.add_argument(
+        "--output", choices=["text", "json"], default="text",
+        help="output format (default: text)",
+    )
+
     p_doctor = sub.add_parser(
         "doctor",
         help="diagnose the accelerator backend (probe in a killable "
@@ -154,6 +190,10 @@ def _load_serve_config(path: str) -> dict:
 
 
 def _cmd_resolve(args) -> int:
+    if args.telemetry_file:
+        from .telemetry import configure_sink
+
+        configure_sink(args.telemetry_file)
     try:
         problems, is_batch = problem_io.load_document(args.file)
     except FileNotFoundError:
@@ -168,14 +208,17 @@ def _cmd_resolve(args) -> int:
 
     from .resolution.facade import BatchResolver
 
+    resolver = BatchResolver(
+        backend=args.backend, max_steps=args.max_steps,
+        checkpoint_dir=args.checkpoint_dir,
+    )
     try:
-        results = BatchResolver(
-            backend=args.backend, max_steps=args.max_steps,
-            checkpoint_dir=args.checkpoint_dir,
-        ).solve(problems)
+        results = resolver.solve(problems)
     except (DuplicateIdentifier, InternalSolverError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.report and resolver.last_report is not None:
+        print(resolver.last_report.format_table(), file=sys.stderr)
 
     rendered = [problem_io.result_to_dict(res) for res in results]
     statuses = {r["status"] for r in rendered}
@@ -213,8 +256,96 @@ def _cmd_bench(args) -> int:
     return 0
 
 
+def _cmd_stats(args) -> int:
+    """Summarize a telemetry JSONL file (the sink written under
+    ``--telemetry-file`` / ``DEPPY_TPU_TELEMETRY_FILE``): per-span
+    count/total/mean wall clock, event totals, and the last recorded
+    solve report — the same report `deppy resolve --report` and the
+    bench harness print."""
+    import os
+
+    path = args.file or os.environ.get("DEPPY_TPU_TELEMETRY_FILE")
+    if not path:
+        print("error: no telemetry file (pass FILE or set "
+              "DEPPY_TPU_TELEMETRY_FILE)", file=sys.stderr)
+        return 2
+    spans: dict = {}
+    last_report = None
+    n_events = 0
+    n_bad = 0
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    n_bad += 1
+                    continue
+                if not isinstance(ev, dict):
+                    n_bad += 1
+                    continue
+                n_events += 1
+                if ev.get("kind") == "span":
+                    agg = spans.setdefault(
+                        ev.get("name", "?"), {"count": 0, "total_s": 0.0}
+                    )
+                    agg["count"] += 1
+                    try:
+                        agg["total_s"] += float(ev.get("dur_s", 0.0))
+                    except (TypeError, ValueError):
+                        pass
+                elif ev.get("kind") == "report":
+                    if isinstance(ev.get("report"), dict):
+                        last_report = ev["report"]
+    except FileNotFoundError:
+        print(f"error: no such file: {path}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+
+    for agg in spans.values():
+        agg["mean_s"] = agg["total_s"] / agg["count"] if agg["count"] else 0.0
+
+    if args.output == "json":
+        json.dump({"events": n_events, "malformed_lines": n_bad,
+                   "spans": spans, "last_report": last_report},
+                  sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+
+    print(f"telemetry: {n_events} events from {path}"
+          + (f" ({n_bad} malformed lines skipped)" if n_bad else ""))
+    if spans:
+        width = max(len(n) for n in spans)
+        print(f"{'span'.ljust(width)}  {'count':>7}  {'total_s':>9}  "
+              f"{'mean_ms':>8}")
+        for name in sorted(spans):
+            agg = spans[name]
+            print(f"{name.ljust(width)}  {agg['count']:>7}  "
+                  f"{agg['total_s']:>9.3f}  {agg['mean_s'] * 1e3:>8.2f}")
+    else:
+        print("no span events recorded")
+    if last_report is not None:
+        from .telemetry import SolveReport
+
+        print()
+        # One canonical renderer: the same table `deppy resolve
+        # --report` and the bench harness print.
+        print("last " + SolveReport.from_dict(last_report).format_table())
+    return 0
+
+
 def _cmd_serve(args) -> int:
     from .service import serve
+
+    if args.telemetry_file:
+        from .telemetry import configure_sink
+
+        configure_sink(args.telemetry_file)
 
     # Precedence: built-in defaults < --config file < explicit flags
     # (the reference's flag-vs-ControllerManagerConfig behavior).  Flags
@@ -261,6 +392,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_bench(args)
     if args.command == "serve":
         return _cmd_serve(args)
+    if args.command == "stats":
+        return _cmd_stats(args)
     if args.command == "doctor":
         from .utils.tpu_doctor import run_from_args
 

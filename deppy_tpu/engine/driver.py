@@ -22,11 +22,13 @@ from __future__ import annotations
 
 import functools as _functools
 import os
+import time as _time
 from typing import List, Optional, Sequence, Union
 
 import jax
 import numpy as np
 
+from .. import telemetry
 from ..sat.constraints import Variable
 from ..sat.encode import Problem, encode
 from ..sat.errors import Incomplete, InternalSolverError, NotSatisfiable
@@ -47,6 +49,45 @@ assert_env_platform()
 # yields Incomplete rather than an unbounded device loop (the reference
 # quirk of unhonored cancellation — SURVEY.md §3.1 — done better).
 DEFAULT_MAX_STEPS = 1 << 24
+
+
+# ----------------------------------------------------------------- telemetry
+#
+# Span/counter/report instrumentation for the whole dispatch pipeline
+# (ISSUE 1, SURVEY.md §5): pad/pack economics, device transfer, per-chunk
+# dispatch, escalation staging, and host-fallback routing all record into
+# the default telemetry registry (and into the thread's active SolveReport
+# when one exists).  Everything here is a handful of perf_counter calls
+# and dict updates per BATCH — nowhere near the per-lane hot path.
+
+
+def _telem_record_pad(problems, total: int, d: _Dims, n_chunks: int,
+                      dur_s: float) -> None:
+    """Record one bucket's padding economics: live vs padded lanes, and
+    live vs padded clause-matrix cells (the dominant tensor)."""
+    reg = telemetry.default_registry()
+    n = len(problems)
+    live_cells = int(sum(p.clauses.size for p in problems))
+    pad_cells = int(total) * d.C * d.K
+    reg.histogram(
+        "deppy_batch_fill_ratio",
+        "Live problems per dispatched batch lane (1.0 = no lane padding).",
+        buckets=telemetry.RATIO_BUCKETS,
+    ).observe(n / total if total else 1.0)
+    reg.counter("deppy_pad_cells_total",
+                "Clause-matrix cells dispatched, including padding."
+                ).inc(pad_cells)
+    reg.counter("deppy_live_cells_total",
+                "Clause-matrix cells carrying live problem data."
+                ).inc(live_cells)
+    reg.counter("deppy_chunks_total",
+                "Device dispatch chunks issued.").inc(n_chunks)
+    rep = telemetry.current_report()
+    if rep is not None:
+        rep.record_batch(live_lanes=n, batch_lanes=int(total),
+                         live_cells=live_cells, pad_cells=pad_cells,
+                         n_chunks=n_chunks)
+        rep.add_wall("pad_pack", dur_s)
 
 
 def _bucket(n: int, minimum: int = 1) -> int:
@@ -612,6 +653,15 @@ def _host_core_rows(problems, idx, d: _Dims, budget, spent,
     against the budget."""
     from ..sat.host import HostEngine
 
+    # The "silent host fallback" made loud: every row routed here counts.
+    telemetry.default_registry().counter(
+        "deppy_host_fallback_rows_total",
+        "UNSAT rows whose core extraction routed to the host spec engine.",
+    ).inc(len(idx))
+    _rep = telemetry.current_report()
+    if _rep is not None:
+        _rep.host_fallback_rows += len(idx)
+
     cores = np.zeros((len(idx), d.NCON), bool)
     steps = np.zeros(len(idx), np.int64)
     for r, i in enumerate(idx):
@@ -647,14 +697,22 @@ def _solve_monolith(problems, budget, mesh, trace_cap) -> List[core.SolveResult]
     n = len(problems)
     d = _Dims(problems, max(n, 1), batch_multiple=mesh.size if mesh is not None else 1)
     host_core = any(p.n_cons > HOST_CORE_NCONS for p in problems)
+    reg = telemetry.default_registry()
+    rep = telemetry.current_report()
     # The single program runs every device phase, so both plane spaces
     # materialize — except under host_core, where the deletion arm (the
     # only reader of the full-space planes under the bits impl) is
     # compiled out and the default derivation suffices.  _put_chunk
     # device_puts the compact tensors first so they cross host→device
     # exactly once.
-    pts = _put_chunk(pad_stack(problems, d, d.B, pack=False), mesh, d,
-                     full=True if not host_core else None)
+    with reg.span("driver.pad_pack", problems=n, lanes=int(d.B)) as sp:
+        pts_np = pad_stack(problems, d, d.B, pack=False)
+    _telem_record_pad(problems, d.B, d, n_chunks=1, dur_s=sp.dur_s)
+    with reg.span("driver.device_put", lanes=int(d.B)) as sp:
+        pts = _put_chunk(pts_np, mesh, d,
+                         full=True if not host_core else None)
+    if rep is not None:
+        rep.add_wall("device_put", sp.dur_s)
     fn = core.batched_solve(d.V, d.NCON, d.NV, trace_cap,
                             with_core=not host_core)
     res = fn(pts, budget)
@@ -725,8 +783,13 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
     CH = d.B
     n_chunks = max(1, -(-n // CH))
     total = n_chunks * CH
+    reg = telemetry.default_registry()
+    rep = telemetry.current_report()
     empty_row = pad_problem(_empty_problem(), d, pack=False)
-    pts_np = pad_stack(problems, d, total, pack=False)
+    with reg.span("driver.pad_pack", problems=n, lanes=total,
+                  chunks=n_chunks) as sp:
+        pts_np = pad_stack(problems, d, total, pack=False)
+    _telem_record_pad(problems, total, d, n_chunks=n_chunks, dur_s=sp.dur_s)
     en = np.arange(total) < n
     slices = _chunk_slices(total, CH)
 
@@ -739,15 +802,21 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
     # the buffers directly, so nothing is re-uploaded.  Under a mesh the
     # per-chunk path shards each chunk's batch axis instead (a single
     # upload would fix the whole batch onto one device).
-    if mesh is None:
-        pts_all = _put_compact(pts_np)
-        pts_dev = [_derive_planes(_rows(pts_all, sl), d) for sl in slices]
-        # The chunk slices are independent buffers; drop the full-batch
-        # copy so it doesn't hold HBM alongside them for the whole solve.
-        del pts_all
-    else:
-        pts_dev = [_put_chunk(_rows(pts_np, sl), mesh, d) for sl in slices]
-    en_dev = [_to_device(en[sl], mesh) for sl in slices]
+    with reg.span("driver.device_put", lanes=total, chunks=n_chunks) as sp:
+        if mesh is None:
+            pts_all = _put_compact(pts_np)
+            pts_dev = [_derive_planes(_rows(pts_all, sl), d)
+                       for sl in slices]
+            # The chunk slices are independent buffers; drop the
+            # full-batch copy so it doesn't hold HBM alongside them for
+            # the whole solve.
+            del pts_all
+        else:
+            pts_dev = [_put_chunk(_rows(pts_np, sl), mesh, d)
+                       for sl in slices]
+        en_dev = [_to_device(en[sl], mesh) for sl in slices]
+    if rep is not None:
+        rep.add_wall("device_put", sp.dur_s)
 
     fn_a = core.batched_search(d.V, d.NCON, d.NV, trace_cap)
     outs = [fn_a(p, budget, e) for p, e in zip(pts_dev, en_dev)]
@@ -952,10 +1021,25 @@ STAGE1_MAX_STRAGGLERS = 0.25
 STAGE1_MIN_BATCH = 64
 
 
+def _record_escalation(stage: int, stragglers: int = 0) -> None:
+    """Record the escalation stage a dispatch group reached: 0 = single
+    stage (escalation disabled or not profitable), 1 = stage-1 budget
+    resolved every lane, 2 = stage-2 (compacted redo or full rerun)."""
+    telemetry.default_registry().counter(
+        "deppy_escalation_total",
+        "Dispatch groups by the budget-escalation stage reached.",
+        labelname="stage",
+    ).inc(1, label=str(stage))
+    rep = telemetry.current_report()
+    if rep is not None:
+        rep.note_escalation(stage)
+
+
 def _solve_escalating(impl, problems, budget, mesh, trace_cap):
     """Run ``impl`` in two budget stages when profitable; transparent
     fallbacks otherwise.  Tracing disables escalation (stage-2 re-runs
     would re-record trace buffers from scratch)."""
+    reg = telemetry.default_registry()
     if (
         STAGE1_STEPS <= 0
         or trace_cap > 0
@@ -966,23 +1050,34 @@ def _solve_escalating(impl, problems, budget, mesh, trace_cap):
         # run (on the critical path), exhaust, and be redone in stage 2.
         or any(p.n_cons > HOST_CORE_NCONS for p in problems)
     ):
-        return impl(problems, budget, mesh, trace_cap)
-    results = impl(problems, np.int32(STAGE1_STEPS), mesh, 0)
-    stragglers = [
-        i for i, r in enumerate(results) if r.outcome == core.RUNNING
-    ]
-    if not stragglers:
+        with reg.span("driver.escalation", problems=len(problems),
+                      stage=0):
+            results = impl(problems, budget, mesh, trace_cap)
+        _record_escalation(0)
         return results
-    if len(stragglers) > STAGE1_MAX_STRAGGLERS * len(problems):
-        return impl(problems, budget, mesh, trace_cap)
-    sub = impl([problems[i] for i in stragglers], budget, mesh, 0)
-    for i, r in zip(stragglers, sub):
-        # Each lane reports the steps of the run that produced its result
-        # (stage-1 work on a redone straggler is not added: both redo
-        # branches then agree, and a lane can never report steps > budget
-        # alongside a decided outcome — same invariant as single-stage).
-        results[i] = r
-    return results
+    with reg.span("driver.escalation", problems=len(problems)) as sp:
+        results = impl(problems, np.int32(STAGE1_STEPS), mesh, 0)
+        stragglers = [
+            i for i, r in enumerate(results) if r.outcome == core.RUNNING
+        ]
+        sp.set(stragglers=len(stragglers))
+        if not stragglers:
+            sp["stage"] = 1
+            _record_escalation(1)
+            return results
+        sp["stage"] = 2
+        _record_escalation(2, stragglers=len(stragglers))
+        if len(stragglers) > STAGE1_MAX_STRAGGLERS * len(problems):
+            return impl(problems, budget, mesh, trace_cap)
+        sub = impl([problems[i] for i in stragglers], budget, mesh, 0)
+        for i, r in zip(stragglers, sub):
+            # Each lane reports the steps of the run that produced its
+            # result (stage-1 work on a redone straggler is not added:
+            # both redo branches then agree, and a lane can never report
+            # steps > budget alongside a decided outcome — same
+            # invariant as single-stage).
+            results[i] = r
+        return results
 
 
 def solve_problems(
@@ -1003,10 +1098,48 @@ def solve_problems(
     ``split_phases`` (default: automatic — on for real batches, off for a
     batch of one) dispatches search / minimization / core extraction as
     separate compacted batches; ``bucketing`` partitions heterogeneous
-    batches into size classes first."""
+    batches into size classes first.
+
+    Telemetry: the whole call runs under a ``driver.solve`` span, and the
+    thread's active :class:`deppy_tpu.telemetry.SolveReport` (created
+    here when none is active — nested calls, e.g. checkpoint groups,
+    merge into the enclosing one) accumulates padding economics,
+    per-stage wall clock, escalation staging, and outcome counters;
+    retrieve it afterwards via :func:`deppy_tpu.telemetry.last_report`."""
     for p in problems:
         if p.errors:
             raise InternalSolverError(p.errors)
+    rep, owns = telemetry.begin_report(backend="tpu",
+                                       n_problems=len(problems))
+    reg = telemetry.default_registry()
+    t0 = _time.perf_counter()
+    try:
+        with reg.span("driver.solve", problems=len(problems)):
+            results = _solve_problems_inner(
+                problems, max_steps, mesh, trace_cap, split_phases,
+                bucketing,
+            )
+        for r in results:
+            o = int(r.outcome)
+            key = ("sat" if o == core.SAT
+                   else "unsat" if o == core.UNSAT else "incomplete")
+            rep.count_outcome(key)
+            rep.steps += int(r.steps)
+            rep.backtracks += int(r.trace_n)
+        reg.histogram(
+            "deppy_solve_seconds",
+            "Wall-clock seconds per driver solve call (pad through "
+            "decode).",
+        ).observe(_time.perf_counter() - t0)
+    finally:
+        rep.add_wall("solve", _time.perf_counter() - t0)
+        if owns:
+            telemetry.end_report(rep, owns)
+    return results
+
+
+def _solve_problems_inner(problems, max_steps, mesh, trace_cap,
+                          split_phases, bucketing):
     n = len(problems)
     budget = _budget(max_steps)
     if split_phases is None:
@@ -1137,6 +1270,7 @@ def solve_one(
     if stats is not None:
         stats["steps"] = int(res.steps)
         stats["backtracks"] = int(res.trace_n)
+        stats["report"] = telemetry.last_report()
     if tracer is not None:
         _replay_trace(problem, res, tracer)
     if res.outcome == core.SAT:
@@ -1162,16 +1296,25 @@ def solve_batch(
     batch.  ``checkpoint_dir`` enables group-wise resume for fleet-scale
     batches (see :mod:`deppy_tpu.engine.checkpoint`)."""
     problems = [encode(vs) for vs in problem_vars]
-    if checkpoint_dir is not None:
-        from .checkpoint import solve_problems_checkpointed
+    # Own the SolveReport across the whole batch so a checkpointed run's
+    # per-group driver calls merge into one report instead of each
+    # publishing their own.
+    rep, owns = telemetry.begin_report(backend="tpu")
+    try:
+        if checkpoint_dir is not None:
+            from .checkpoint import solve_problems_checkpointed
 
-        results = solve_problems_checkpointed(
-            problems, checkpoint_dir, max_steps=max_steps, mesh=mesh
-        )
-    else:
-        results = solve_problems(problems, max_steps=max_steps, mesh=mesh)
+            results = solve_problems_checkpointed(
+                problems, checkpoint_dir, max_steps=max_steps, mesh=mesh
+            )
+        else:
+            results = solve_problems(problems, max_steps=max_steps,
+                                     mesh=mesh)
+    finally:
+        telemetry.end_report(rep, owns)
     if stats is not None:
         stats["steps"] = int(sum(int(r.steps) for r in results))
+        stats["report"] = telemetry.last_report()
     out: List[Union[dict, NotSatisfiable, Incomplete]] = []
     for p, res in zip(problems, results):
         if res.outcome == core.SAT:

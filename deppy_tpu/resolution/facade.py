@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Union
 
+from .. import telemetry
 from ..entity.entity import EntityID
 from ..entity.source import EntityQuerier
 from ..sat.constraints import Variable
@@ -35,6 +36,19 @@ def _to_solution(variables: Sequence[Variable], installed: Sequence[Variable]) -
     for v in installed:
         solution[v.identifier] = True
     return solution
+
+
+def _fold_report(batch: telemetry.SolveReport,
+                 one: telemetry.SolveReport) -> None:
+    """Fold one problem's host SolveReport into the batch report."""
+    for k, v in one.outcomes.items():
+        batch.count_outcome(k, v)
+    batch.steps += one.steps
+    batch.decisions += one.decisions
+    batch.propagation_rounds += one.propagation_rounds
+    batch.backtracks += one.backtracks
+    for stage, s in one.wall.items():
+        batch.add_wall(stage, s)
 
 
 class Resolver:
@@ -99,6 +113,11 @@ class BatchResolver:
         # Engine iterations consumed by the last solve, summed over the
         # batch (SURVEY.md §5 observability; exported by the service).
         self.last_steps: int = 0
+        # Structured per-batch telemetry for the last solve (ISSUE 1):
+        # outcomes, engine counters, padding economics, escalation
+        # stage, host-fallback rows.  The service feeds its /metrics
+        # histograms from this.
+        self.last_report: Optional[telemetry.SolveReport] = None
 
     def solve(
         self, problems: Sequence[Sequence[Variable]]
@@ -107,6 +126,7 @@ class BatchResolver:
 
         backend = resolve_backend(self.backend)
         self.last_steps = 0
+        self.last_report = None
         if backend == "host":
             if self.checkpoint_dir is not None:
                 import sys
@@ -118,19 +138,35 @@ class BatchResolver:
                     file=sys.stderr,
                 )
             out: List[Union[Solution, NotSatisfiable, Incomplete]] = []
-            for variables in problems:
-                solver = Solver(
-                    variables, backend="host", max_steps=self.max_steps
-                )
-                try:
-                    installed = solver.solve()
-                    out.append(_to_solution(variables, installed))
-                except NotSatisfiable as e:
-                    out.append(e)
-                except Incomplete as e:
-                    out.append(e)
-                finally:
-                    self.last_steps += solver.steps
+            # begin/end (not a bare SolveReport) so host-backend batches
+            # honor the same telemetry contract as device batches: the
+            # report reaches telemetry.last_report() and the JSONL sink,
+            # and the serial loop shows up as a span.
+            batch_rep, owns_rep = telemetry.begin_report(
+                backend="host", n_problems=len(problems)
+            )
+            reg = telemetry.default_registry()
+            try:
+                with reg.span("facade.host_solve", problems=len(problems)):
+                    for variables in problems:
+                        solver = Solver(
+                            variables, backend="host",
+                            max_steps=self.max_steps,
+                        )
+                        try:
+                            installed = solver.solve()
+                            out.append(_to_solution(variables, installed))
+                        except NotSatisfiable as e:
+                            out.append(e)
+                        except Incomplete as e:
+                            out.append(e)
+                        finally:
+                            self.last_steps += solver.steps
+                            if solver.report is not None:
+                                _fold_report(batch_rep, solver.report)
+            finally:
+                telemetry.end_report(batch_rep, owns_rep)
+            self.last_report = batch_rep
             return out
         from ..engine.driver import solve_batch
 
@@ -142,3 +178,4 @@ class BatchResolver:
             )
         finally:
             self.last_steps = stats.get("steps", 0)
+            self.last_report = stats.get("report")

@@ -40,7 +40,7 @@ import numpy as np
 from .constraints import AppliedConstraint, Variable
 from .encode import Problem
 from .errors import Incomplete, InternalSolverError, NotSatisfiable
-from .tracer import SearchPosition, Tracer
+from .tracer import SearchPosition, StatsTracer, Tracer
 
 SAT = 1
 UNSAT = -1
@@ -73,6 +73,11 @@ class _Position(SearchPosition):
         return self._conflicts
 
 
+# Shared sentinel handed to stats-only tracers (wants_position = False):
+# they count the call and never look inside.
+_EMPTY_POSITION = _Position([], [])
+
+
 class HostEngine:
     """Reference engine over a lowered :class:`Problem`."""
 
@@ -83,9 +88,28 @@ class HostEngine:
         max_steps: Optional[int] = None,
     ):
         self.p = problem
-        self.tracer = tracer
+        # StatsTracer is the default tracer (SURVEY.md §5): every host
+        # solve — including the driver's host-fallback rows — counts
+        # decisions/propagation rounds/backtracks into the same channel
+        # the tensor engine reports, at the cost of three int adds.
+        self.tracer = tracer if tracer is not None else StatsTracer()
         self.max_steps = max_steps
         self._steps = 0
+        # Engine-side counters, always maintained (a custom tracer may
+        # not implement the optional count_* hooks).
+        self.decisions = 0
+        self.propagation_rounds = 0
+        self.backtracks = 0
+        self._hook_decision = getattr(self.tracer, "count_decision", None)
+        self._hook_propagation = getattr(
+            self.tracer, "count_propagation", None
+        )
+        # Stats-only tracers (wants_position = False) skip the
+        # per-backtrack position snapshot entirely, so wiring StatsTracer
+        # as the default adds only integer increments to the hot path.
+        self._trace_wants_position = getattr(
+            self.tracer, "wants_position", True
+        )
 
         p = problem
         self.n = p.n_vars
@@ -135,9 +159,26 @@ class HostEngine:
         loop (the native replacement for CardinalityConstrainer + Leq(w),
         solve.go:100-110).
         """
+        self._bcp_rounds = 0
+        try:
+            return self._bcp_loop(assign, min_mask, min_w)
+        finally:
+            # Telemetry (SURVEY.md §5): every fixpoint iteration counts,
+            # whichever of the loop's return paths ended it.
+            self.propagation_rounds += self._bcp_rounds
+            if self._hook_propagation is not None:
+                self._hook_propagation(self._bcp_rounds)
+
+    def _bcp_loop(
+        self,
+        assign: np.ndarray,
+        min_mask: Optional[np.ndarray],
+        min_w: int,
+    ) -> Tuple[bool, np.ndarray]:
         p = self.p
         self.last_conflicts = []
         while True:
+            self._bcp_rounds += 1
             changed = False
             conflict = False
             want = np.zeros(self.v, dtype=np.int8)  # pending implications
@@ -272,6 +313,7 @@ class HostEngine:
             if unassigned.size == 0:
                 return True, assign
             var = int(unassigned[0])
+            self._count_decision()
             stack.append((var, False, assign))
             trial = assign.copy()
             trial[var] = _FALSE
@@ -343,12 +385,15 @@ class HostEngine:
                     model = m
 
             if result == UNSAT:
+                self.backtracks += 1
                 if self.tracer is not None:
                     self.tracer.trace(
                         _Position(
                             [p.variables[g.var] for g in guesses if g.var >= 0],
                             list(self.last_conflicts),
                         )
+                        if self._trace_wants_position
+                        else _EMPTY_POSITION
                     )
                 if not guesses:
                     break
@@ -378,6 +423,7 @@ class HostEngine:
             guesses.append(g)
             if var < 0:
                 continue
+            self._count_decision()
             for ch in p.var_choices[var] if var < len(p.var_choices) else []:
                 if ch >= 0:
                     g.children += 1
@@ -476,3 +522,8 @@ class HostEngine:
         self._steps += 1
         if self.max_steps is not None and self._steps > self.max_steps:
             raise Incomplete()
+
+    def _count_decision(self) -> None:
+        self.decisions += 1
+        if self._hook_decision is not None:
+            self._hook_decision()

@@ -23,11 +23,13 @@ Usage::
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional, Sequence
 
+from .. import telemetry
 from .constraints import Variable
 from .encode import Problem, encode
-from .errors import InternalSolverError
+from .errors import Incomplete, InternalSolverError, NotSatisfiable
 from .host import HostEngine
 from .tracer import Tracer
 
@@ -59,18 +61,15 @@ class Solver:
         self.trace_cap = trace_cap
         # Engine iterations consumed by the last solve (SURVEY.md §5).
         self.steps: int = 0
+        # Structured telemetry for the last solve (SURVEY.md §5 /
+        # ISSUE 1): outcome, step/decision/propagation counters, and —
+        # on the tensor backend — the driver's padding/escalation data.
+        self.report: Optional[telemetry.SolveReport] = None
 
     def solve(self) -> List[Variable]:
         backend = resolve_backend(self.backend, batch=False)
         if backend == "host":
-            engine = HostEngine(
-                self.problem, tracer=self.tracer, max_steps=self.max_steps
-            )
-            try:
-                installed, _ = engine.solve()
-            finally:
-                self.steps = engine.steps
-            return installed
+            return self._solve_host()
         from ..engine.driver import solve_one
 
         stats: dict = {}
@@ -80,6 +79,35 @@ class Solver:
                              trace_cap=self.trace_cap)
         finally:
             self.steps = stats.get("steps", 0)
+            self.report = stats.get("report")
+
+    def _solve_host(self) -> List[Variable]:
+        engine = HostEngine(
+            self.problem, tracer=self.tracer, max_steps=self.max_steps
+        )
+        t0 = time.perf_counter()
+        outcome: Optional[str] = None
+        try:
+            installed, _ = engine.solve()
+            outcome = "sat"
+            return installed
+        except NotSatisfiable:
+            outcome = "unsat"
+            raise
+        except Incomplete:
+            outcome = "incomplete"
+            raise
+        finally:
+            self.steps = engine.steps
+            rep = telemetry.SolveReport(backend="host", n_problems=1)
+            if outcome is not None:
+                rep.count_outcome(outcome)
+            rep.steps = engine.steps
+            rep.decisions = engine.decisions
+            rep.propagation_rounds = engine.propagation_rounds
+            rep.backtracks = engine.backtracks
+            rep.add_wall("solve", time.perf_counter() - t0)
+            self.report = rep
 
 
 def resolve_backend(backend: str, *, batch: bool = True) -> str:

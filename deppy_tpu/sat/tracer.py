@@ -49,11 +49,44 @@ class LoggingTracer:
 
 
 class StatsTracer:
-    """Counts backtracks — the cheap always-on statistics channel the tensor
-    engine also reports (decisions/conflicts/propagation rounds)."""
+    """Counts backtracks, decisions, and propagation rounds — the cheap
+    always-on statistics channel matching the tensor engine's counters
+    (SolveResult.steps / trace_n), so host-fallback solves contribute to
+    the same telemetry as device solves.
+
+    ``trace`` (the base Tracer protocol) counts search backtracks;
+    ``count_decision`` / ``count_propagation`` are optional hook methods
+    the host engine invokes when its tracer defines them — it is wired
+    as the host engine's default tracer, so every host solve carries
+    these counters without opting in.
+
+    ``wants_position = False`` tells the engine this tracer never reads
+    the position argument, so the per-backtrack position snapshot is
+    skipped — the default tracer must not perturb the timed host
+    baseline the benchmarks compare against."""
+
+    wants_position = False
 
     def __init__(self) -> None:
         self.backtracks = 0
+        self.decisions = 0
+        self.propagation_rounds = 0
 
     def trace(self, position: SearchPosition) -> None:
         self.backtracks += 1
+
+    def count_decision(self, n: int = 1) -> None:
+        """One search/DPLL decision (a variable guessed, either by the
+        preference-ordered search or the leaf DPLL)."""
+        self.decisions += n
+
+    def count_propagation(self, rounds: int = 1) -> None:
+        """``rounds`` BCP fixpoint iterations completed."""
+        self.propagation_rounds += rounds
+
+    def as_dict(self) -> dict:
+        return {
+            "backtracks": self.backtracks,
+            "decisions": self.decisions,
+            "propagation_rounds": self.propagation_rounds,
+        }

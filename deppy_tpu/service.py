@@ -33,6 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from . import io as problem_io
+from . import telemetry
 from .sat.errors import DuplicateIdentifier, InternalSolverError
 
 
@@ -70,76 +71,106 @@ def _make_http_server(addr: Tuple[str, int], handler) -> ThreadingHTTPServer:
     return ThreadingHTTPServer(addr, handler)
 
 
-class Metrics:
-    """Thread-safe counters rendered in Prometheus text exposition format."""
+def _default_engine_probe() -> Optional[bool]:
+    """Auto-routing verdict for the scrape-time gauge: 1 tensor engine,
+    0 host fallback (accelerator unusable), None while no verdict exists
+    yet.  Lives behind an injectable callback so ``Metrics.render`` is
+    pure and testable without the solver module (ISSUE 1 satellite)."""
+    from .sat import solver as _solver
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self.resolutions: Dict[str, int] = {"sat": 0, "unsat": 0, "incomplete": 0}
-        self.batches = 0
-        self.errors = 0
-        self.solve_seconds = 0.0
-        self.engine_steps = 0
+    return _solver._ENGINE_USABLE
+
+
+class Metrics:
+    """The service's metric surface, rendered in Prometheus text
+    exposition format.
+
+    Rebuilt on :class:`deppy_tpu.telemetry.Registry` (ISSUE 1): the
+    historical counters keep their exact names and rendering, and the
+    registry adds histogram families — ``deppy_solve_seconds`` (per-batch
+    wall clock), ``deppy_batch_fill_ratio`` (live problems per dispatched
+    lane) and ``deppy_escalation_stage`` (budget-escalation stage
+    reached), fed from each batch's :class:`telemetry.SolveReport`.
+
+    Each ``Metrics`` owns a private registry, so concurrent servers (and
+    tests) never share counts; the pipeline-global driver telemetry
+    lives separately on ``telemetry.default_registry()``.
+    """
+
+    def __init__(self, registry: Optional[telemetry.Registry] = None,
+                 engine_usable_probe=_default_engine_probe) -> None:
+        self.registry = registry if registry is not None else telemetry.Registry()
+        self._engine_probe = engine_usable_probe
         self.leader: Optional[bool] = None  # None = election disabled
+        r = self.registry
+        self._resolutions = r.counter(
+            "deppy_resolutions_total", "Problems resolved by outcome.",
+            labelname="outcome",
+        ).preset("sat", "unsat", "incomplete")
+        self._batches = r.counter(
+            "deppy_batches_total", "Resolution batches dispatched.")
+        self._errors = r.counter(
+            "deppy_request_errors_total", "Malformed or failed requests.")
+        self._solve_seconds = r.counter(
+            "deppy_solve_seconds_total",
+            "Wall-clock seconds spent solving.", initial=0.0)
+        self._engine_steps = r.counter(
+            "deppy_engine_steps_total",
+            "Engine iterations (tests, decisions, backtracks).")
+        self._solve_hist = r.histogram(
+            "deppy_solve_seconds",
+            "Resolution batch wall-clock seconds.",
+            buckets=telemetry.SECONDS_BUCKETS)
+        self._fill_hist = r.histogram(
+            "deppy_batch_fill_ratio",
+            "Live problems per dispatched batch lane (1.0 = no padding).",
+            buckets=telemetry.RATIO_BUCKETS)
+        self._esc_hist = r.histogram(
+            "deppy_escalation_stage",
+            "Budget-escalation stage reached per batch (0 = single "
+            "stage, 1 = stage-1 budget sufficed, 2 = stage-2 redo).",
+            buckets=telemetry.STAGE_BUCKETS)
 
     def observe_batch(self, outcomes: Dict[str, int], seconds: float,
-                      steps: int = 0) -> None:
-        with self._lock:
-            self.batches += 1
-            for k, v in outcomes.items():
-                self.resolutions[k] = self.resolutions.get(k, 0) + v
-            self.solve_seconds += seconds
-            self.engine_steps += steps
+                      steps: int = 0,
+                      report: Optional[telemetry.SolveReport] = None) -> None:
+        self._batches.inc()
+        for k, v in outcomes.items():
+            self._resolutions.inc(v, label=k)
+        self._solve_seconds.inc(seconds)
+        self._engine_steps.inc(steps)
+        self._solve_hist.observe(seconds)
+        if report is not None:
+            self._fill_hist.observe(report.batch_fill_ratio)
+            self._esc_hist.observe(report.escalation_stage)
 
     def observe_error(self) -> None:
-        with self._lock:
-            self.errors += 1
+        self._errors.inc()
 
     def render(self) -> str:
-        with self._lock:
-            lines = [
-                "# HELP deppy_resolutions_total Problems resolved by outcome.",
-                "# TYPE deppy_resolutions_total counter",
-            ]
-            for outcome, n in sorted(self.resolutions.items()):
-                lines.append(
-                    f'deppy_resolutions_total{{outcome="{outcome}"}} {n}'
-                )
+        # The probe runs OUTSIDE any metric lock (it may import the
+        # solver module on first call); rendering itself is pure.
+        usable = None
+        if self._engine_probe is not None:
+            try:
+                usable = self._engine_probe()
+            except Exception:
+                usable = None  # a broken probe must not break scrapes
+        lines = self.registry.render_lines()
+        if usable is not None:
             lines += [
-                "# HELP deppy_batches_total Resolution batches dispatched.",
-                "# TYPE deppy_batches_total counter",
-                f"deppy_batches_total {self.batches}",
-                "# HELP deppy_request_errors_total Malformed or failed requests.",
-                "# TYPE deppy_request_errors_total counter",
-                f"deppy_request_errors_total {self.errors}",
-                "# HELP deppy_solve_seconds_total Wall-clock seconds spent solving.",
-                "# TYPE deppy_solve_seconds_total counter",
-                f"deppy_solve_seconds_total {self.solve_seconds}",
-                "# HELP deppy_engine_steps_total Engine iterations (tests, decisions, backtracks).",
-                "# TYPE deppy_engine_steps_total counter",
-                f"deppy_engine_steps_total {self.engine_steps}",
+                "# HELP deppy_auto_engine_usable Auto routing verdict:"
+                " 1 = tensor engine, 0 = host fallback.",
+                "# TYPE deppy_auto_engine_usable gauge",
+                f"deppy_auto_engine_usable {int(usable)}",
             ]
-            # Auto-routing verdict at scrape time: 1 tensor engine, 0
-            # host fallback (accelerator unusable), absent while no
-            # verdict exists yet.  Makes the outage→recovery routing
-            # upgrade (DEPPY_TPU_REPROBE) observable on a dashboard.
-            from .sat import solver as _solver
-
-            usable = _solver._ENGINE_USABLE
-            if usable is not None:
-                lines += [
-                    "# HELP deppy_auto_engine_usable Auto routing verdict:"
-                    " 1 = tensor engine, 0 = host fallback.",
-                    "# TYPE deppy_auto_engine_usable gauge",
-                    f"deppy_auto_engine_usable {int(usable)}",
-                ]
-            if self.leader is not None:
-                lines += [
-                    "# HELP deppy_leader HA election verdict: 1 = holding"
-                    " the lease (serving), 0 = standby.",
-                    "# TYPE deppy_leader gauge",
-                    f"deppy_leader {int(self.leader)}",
-                ]
+        if self.leader is not None:
+            lines += [
+                "# HELP deppy_leader HA election verdict: 1 = holding"
+                " the lease (serving), 0 = standby.",
+                "# TYPE deppy_leader gauge",
+                f"deppy_leader {int(self.leader)}",
+            ]
         return "\n".join(lines) + "\n"
 
 
@@ -232,7 +263,8 @@ class Server:
             outcomes[r["status"]] += 1
             rendered.append(r)
         self.metrics.observe_batch(outcomes, time.perf_counter() - t0,
-                                   steps=resolver.last_steps)
+                                   steps=resolver.last_steps,
+                                   report=resolver.last_report)
         return 200, {"results": rendered}
 
     def _on_leader_change(self, leading: bool) -> None:

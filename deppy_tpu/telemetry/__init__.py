@@ -1,0 +1,49 @@
+"""deppy_tpu.telemetry — pipeline-wide observability (ISSUE 1).
+
+A dependency-free span/counter/histogram registry plus the structured
+per-batch :class:`SolveReport`, threaded through encode → pad/pack →
+device transfer → solve → decode.  The service's ``/metrics`` endpoint,
+the ``deppy stats`` CLI, the JSONL event sink, and the benchmark BENCH
+rows all read from here.  See docs/observability.md for the metric/span
+name table and the JSONL event schema.
+"""
+
+from .registry import (
+    RATIO_BUCKETS,
+    SECONDS_BUCKETS,
+    STAGE_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    Span,
+    configure_sink,
+    default_registry,
+    set_default_registry,
+)
+from .report import (
+    SolveReport,
+    begin_report,
+    current_report,
+    end_report,
+    last_report,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "Span",
+    "SolveReport",
+    "RATIO_BUCKETS",
+    "SECONDS_BUCKETS",
+    "STAGE_BUCKETS",
+    "begin_report",
+    "configure_sink",
+    "current_report",
+    "default_registry",
+    "end_report",
+    "last_report",
+    "set_default_registry",
+]

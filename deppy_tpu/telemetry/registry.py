@@ -1,0 +1,401 @@
+"""Lightweight span/counter/histogram registry — no third-party deps.
+
+The observability backbone of the solve pipeline (SURVEY.md §5): the
+engine driver, the SAT facades, the service, and the benchmarks all
+record into a :class:`Registry`, which renders the Prometheus text
+exposition format (the same surface the reference's controller-runtime
+metrics registry serves, /root/reference/main.go:63-64) and can mirror
+every span to a JSONL event sink for offline analysis.
+
+Design constraints, in order:
+
+  * **Cheap when idle.**  Counters are one lock + one add; spans are two
+    ``perf_counter`` calls and a dict.  With no sink configured nothing
+    is formatted or written — the pipeline's telemetry overhead must
+    stay within noise (ISSUE acceptance: ≤5% on the bench suite).
+  * **Thread-safe.**  The service observes from request-handler threads
+    while ``/metrics`` renders concurrently.
+  * **Deterministic exposition.**  Families render in registration
+    order, labeled samples in sorted label order, so scrapes diff
+    cleanly and tests can pin exact lines.
+
+The JSONL sink (``DEPPY_TPU_TELEMETRY_FILE`` or ``--telemetry-file``)
+receives one object per event::
+
+    {"ts": 1722700000.123, "kind": "span", "name": "driver.pad_pack",
+     "dur_s": 0.0123, "attrs": {"problems": 64, "lanes": 64}}
+    {"ts": ..., "kind": "report", "report": {...SolveReport...}}
+
+See docs/observability.md for the full event schema and metric table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Default histogram buckets for wall-clock seconds: sub-ms dispatch
+# overheads through minutes-long giant-catalog solves.
+SECONDS_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+# Ratio buckets (fill / waste ratios live in [0, 1]).
+RATIO_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+# Escalation stages: 0 = single-stage, 1 = stage-1 sufficed, 2 = stage-2.
+STAGE_BUCKETS = (0.0, 1.0, 2.0, 3.0)
+
+
+def _fmt(v) -> str:
+    """Sample-value formatting: ints stay ints, floats render via str()
+    (matching the service's historical f-string rendering, so pinned
+    scrape lines like ``deppy_solve_seconds_total 0.5`` are preserved)."""
+    return str(v)
+
+
+def _fmt_le(bound: float) -> str:
+    """Bucket bound label: Prometheus convention ('%g': 0.005, 1, +Inf)."""
+    if bound == float("inf"):
+        return "+Inf"
+    return "%g" % bound
+
+
+class Counter:
+    """Monotonic counter, optionally labeled by one label name.
+
+    Unlabeled: ``inc(n)``.  Labeled: ``inc(n, label_value)``.  Values
+    keep their Python numeric type (int stays int) so exposition matches
+    the historical hand-rendered lines byte for byte.
+    """
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str, lock,
+                 labelname: Optional[str] = None, initial=0):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self.labelname = labelname
+        self._value = initial
+        self._labeled: Dict[str, int] = {}
+
+    def inc(self, n=1, label: Optional[str] = None) -> None:
+        with self._lock:
+            if label is None:
+                self._value = self._value + n
+            else:
+                self._labeled[label] = self._labeled.get(label, 0) + n
+
+    def preset(self, *labels: str) -> "Counter":
+        """Pre-register label values at 0 so they render before first
+        increment (the service's outcome counters always expose all
+        three outcomes)."""
+        with self._lock:
+            for lab in labels:
+                self._labeled.setdefault(lab, 0)
+        return self
+
+    @property
+    def value(self):
+        with self._lock:
+            if self.labelname is None:
+                return self._value
+            return dict(self._labeled)
+
+    def _render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        if self.labelname is None:
+            lines.append(f"{self.name} {_fmt(self._value)}")
+        else:
+            for lab, n in sorted(self._labeled.items()):
+                lines.append(
+                    f'{self.name}{{{self.labelname}="{lab}"}} {_fmt(n)}'
+                )
+        return lines
+
+
+class Gauge:
+    """Last-write-wins gauge.  Renders only once set (the service's
+    verdict gauges are absent until a verdict exists)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str, lock):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self._value = None
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def _render(self) -> List[str]:
+        if self._value is None:
+            return []
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} gauge",
+                f"{self.name} {_fmt(self._value)}"]
+
+
+class Histogram:
+    """Fixed-bucket histogram with cumulative (monotonic) bucket counts,
+    rendered as the standard ``_bucket``/``_sum``/``_count`` series."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, lock,
+                 buckets: Sequence[float] = SECONDS_BUCKETS):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def cumulative(self) -> List[Tuple[str, int]]:
+        """(le_label, cumulative_count) per bucket, +Inf last."""
+        out = []
+        with self._lock:
+            running = 0
+            for b, c in zip(self.buckets, self._counts):
+                running += c
+                out.append((_fmt_le(b), running))
+            out.append((_fmt_le(float("inf")), running + self._counts[-1]))
+        return out
+
+    def _render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        for le, n in self.cumulative():
+            lines.append(f'{self.name}_bucket{{le="{le}"}} {n}')
+        lines.append(f"{self.name}_sum {_fmt(self._sum)}")
+        lines.append(f"{self.name}_count {self._count}")
+        return lines
+
+
+class Span:
+    """One timed pipeline stage, used as a context manager.
+
+    Attributes set during the span (``sp[\"stage\"] = 2`` or
+    ``sp.set(lanes=64)``) ride along into the JSONL event.  Duration is
+    available as ``sp.dur_s`` after exit.
+    """
+
+    __slots__ = ("name", "attrs", "_registry", "_t0", "dur_s")
+
+    def __init__(self, registry: "Registry", name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self._registry = registry
+        self._t0 = 0.0
+        self.dur_s = 0.0
+
+    def __setitem__(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.dur_s = time.perf_counter() - self._t0
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        self._registry._record_span(self)
+
+
+class Registry:
+    """Metric families + span stream, with optional JSONL sink.
+
+    One lock guards every family (contention is negligible at the
+    pipeline's per-batch observation rate, and a single lock keeps
+    render atomic).
+    """
+
+    def __init__(self, sink_path: Optional[str] = None):
+        # RLock: render_lines holds it across every family's _render so a
+        # scrape is one consistent snapshot (no torn histograms, no
+        # dict-changed-during-iteration from a concurrent first-time
+        # label), while the family accessors re-enter it freely.
+        self._lock = threading.RLock()
+        self._families: Dict[str, object] = {}
+        self._order: List[str] = []
+        self._sink_lock = threading.Lock()
+        self._sink_path = sink_path
+        self._sink_file = None
+        # Bounded in-memory span tail for `deppy stats` on a live
+        # process and for tests; not a durable record (the sink is).
+        self._recent_spans: List[dict] = []
+        self._recent_cap = 256
+
+    # ------------------------------------------------------------ families
+
+    def _family(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = cls(name, help, self._lock, **kw)
+                self._families[name] = fam
+                self._order.append(name)
+            elif not isinstance(fam, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind}"
+                )
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labelname: Optional[str] = None, initial=0) -> Counter:
+        return self._family(Counter, name, help, labelname=labelname,
+                            initial=initial)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._family(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = SECONDS_BUCKETS) -> Histogram:
+        return self._family(Histogram, name, help, buckets=buckets)
+
+    # -------------------------------------------------------------- spans
+
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def _record_span(self, span: Span) -> None:
+        event = {"ts": round(time.time(), 3), "kind": "span",
+                 "name": span.name, "dur_s": round(span.dur_s, 6),
+                 "attrs": span.attrs}
+        with self._sink_lock:
+            self._recent_spans.append(event)
+            if len(self._recent_spans) > self._recent_cap:
+                del self._recent_spans[: -self._recent_cap]
+        self.emit(event)
+
+    def recent_spans(self) -> List[dict]:
+        with self._sink_lock:
+            return list(self._recent_spans)
+
+    # --------------------------------------------------------------- sink
+
+    def configure_sink(self, path: Optional[str]) -> None:
+        """Point the JSONL sink at ``path`` (None disables).  The file is
+        opened lazily on first event and appended to, one JSON object
+        per line."""
+        with self._sink_lock:
+            if self._sink_file is not None:
+                try:
+                    self._sink_file.close()
+                except OSError:
+                    pass
+                self._sink_file = None
+            self._sink_path = path
+
+    @property
+    def sink_path(self) -> Optional[str]:
+        return self._sink_path
+
+    def emit(self, event: dict) -> None:
+        """Append one event object to the sink, if configured.  Sink I/O
+        failures disable the sink rather than failing the solve — the
+        pipeline must never die to observability."""
+        if self._sink_path is None:
+            return
+        with self._sink_lock:
+            if self._sink_path is None:
+                return
+            try:
+                if self._sink_file is None:
+                    self._sink_file = open(self._sink_path, "a",
+                                           encoding="utf-8")
+                self._sink_file.write(json.dumps(event) + "\n")
+                self._sink_file.flush()
+            except OSError:
+                self._sink_path = None
+                self._sink_file = None
+
+    # ------------------------------------------------------------- render
+
+    def render_lines(self) -> List[str]:
+        with self._lock:
+            lines: List[str] = []
+            for name in self._order:
+                lines.extend(self._families[name]._render())
+            return lines
+
+    def render(self) -> str:
+        return "\n".join(self.render_lines()) + "\n"
+
+    def snapshot(self) -> dict:
+        """Plain-dict view of every family (for JSON output / tests)."""
+        out: Dict[str, object] = {}
+        with self._lock:
+            families = [(n, self._families[n]) for n in self._order]
+        for name, fam in families:
+            if isinstance(fam, Histogram):
+                out[name] = {"count": fam.count, "sum": fam.sum}
+            else:
+                out[name] = fam.value
+        return out
+
+
+_DEFAULT: Optional[Registry] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_registry() -> Registry:
+    """The process-wide registry the pipeline instruments against.  Its
+    sink is configured from ``DEPPY_TPU_TELEMETRY_FILE`` at creation;
+    ``configure_sink`` / ``--telemetry-file`` can override later."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = Registry(
+                    sink_path=os.environ.get("DEPPY_TPU_TELEMETRY_FILE")
+                    or None
+                )
+    return _DEFAULT
+
+
+def set_default_registry(registry: Optional[Registry]) -> Optional[Registry]:
+    """Swap the process-default registry (tests); returns the previous."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        prev, _DEFAULT = _DEFAULT, registry
+    return prev
+
+
+def configure_sink(path: Optional[str]) -> None:
+    """Point the default registry's JSONL sink at ``path``."""
+    default_registry().configure_sink(path)

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Metrics smoke test (`make metrics-smoke`, ISSUE 1 satellite).
+
+Boots the batch-resolution service on an ephemeral port, resolves the
+golden e2e problem file (test/e2e/problem.json), scrapes ``/metrics``,
+and asserts the scrape carries a nonzero ``deppy_resolutions_total``
+plus the ISSUE 1 histogram families.  Fast on purpose: host backend, no
+device compile — the full device pass is `make e2e`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from http.client import HTTPConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+GOLDEN = os.path.join(REPO, "test", "e2e", "problem.json")
+
+REQUIRED_FAMILIES = (
+    "deppy_solve_seconds_bucket",
+    "deppy_batch_fill_ratio_bucket",
+    "deppy_escalation_stage_bucket",
+)
+
+
+def request(port: int, method: str, path: str, body=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    headers = {"Content-Type": "application/json"} if body is not None else {}
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def main() -> int:
+    from deppy_tpu.service import Server
+
+    with open(GOLDEN, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host")
+    srv.start()
+    try:
+        status, _ = request(srv.probe_port, "GET", "/healthz")
+        assert status == 200, f"/healthz returned {status}"
+        status, data = request(srv.api_port, "POST", "/v1/resolve", doc)
+        assert status == 200, f"/v1/resolve returned {status}: {data!r}"
+        status, data = request(srv.api_port, "GET", "/metrics")
+        assert status == 200, f"/metrics returned {status}"
+        text = data.decode()
+
+        resolved = 0
+        for line in text.splitlines():
+            if line.startswith("deppy_resolutions_total{"):
+                resolved += int(float(line.rsplit(" ", 1)[1]))
+        assert resolved > 0, (
+            f"deppy_resolutions_total is zero after a resolve:\n{text}"
+        )
+        missing = [f for f in REQUIRED_FAMILIES if f not in text]
+        assert not missing, f"histogram families missing: {missing}"
+        print(f"metrics-smoke: PASS ({resolved} resolutions scraped; "
+              f"{len(REQUIRED_FAMILIES)} histogram families present)")
+        return 0
+    finally:
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

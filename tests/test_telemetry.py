@@ -1,0 +1,735 @@
+"""Observability subsystem tests (ISSUE 1).
+
+Covers the telemetry registry primitives, the JSONL event sink, the
+driver pipeline's span/report instrumentation, escalation-stage
+accounting end to end (SolveReport AND the /metrics scrape), the
+Prometheus exposition contract (every # TYPE/# HELP pair, monotonic
+histogram buckets), the StatsTracer counters, and the `deppy stats` CLI.
+"""
+
+import json
+
+import pytest
+
+from deppy_tpu import telemetry
+from deppy_tpu.telemetry.registry import Registry
+
+pytestmark = pytest.mark.telemetry
+
+
+# ------------------------------------------------------------- primitives
+
+
+class TestRegistry:
+    def test_counter_render_and_types(self):
+        r = Registry()
+        c = r.counter("x_total", "Things.")
+        c.inc()
+        c.inc(2)
+        assert "x_total 3" in r.render()
+        # Int stays int; float add flips to float rendering.
+        f = r.counter("y_total", "Seconds.", initial=0.0)
+        f.inc(0.5)
+        assert "y_total 0.5" in r.render()
+
+    def test_labeled_counter_sorted_and_preset(self):
+        r = Registry()
+        c = r.counter("o_total", "Outcomes.", labelname="outcome")
+        c.preset("sat", "unsat", "incomplete")
+        c.inc(2, label="sat")
+        lines = [l for l in r.render_lines() if l.startswith("o_total{")]
+        assert lines == [
+            'o_total{outcome="incomplete"} 0',
+            'o_total{outcome="sat"} 2',
+            'o_total{outcome="unsat"} 0',
+        ]
+
+    def test_gauge_absent_until_set(self):
+        r = Registry()
+        g = r.gauge("verdict", "A verdict.")
+        assert "verdict" not in r.render()
+        g.set(1)
+        assert "verdict 1" in r.render()
+
+    def test_histogram_cumulative_monotonic(self):
+        r = Registry()
+        h = r.histogram("lat", "Latency.", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        cum = h.cumulative()
+        assert cum == [("0.1", 1), ("1", 3), ("10", 4), ("+Inf", 5)]
+        counts = [n for _, n in cum]
+        assert counts == sorted(counts)  # cumulative => monotonic
+        assert h.count == 5
+        assert h.sum == pytest.approx(56.05)
+        text = r.render()
+        assert 'lat_bucket{le="+Inf"} 5' in text
+        assert "lat_count 5" in text
+
+    def test_family_kind_conflict_raises(self):
+        r = Registry()
+        r.counter("dup", "x")
+        with pytest.raises(ValueError, match="already registered"):
+            r.histogram("dup", "x")
+
+    def test_span_records_duration_and_attrs(self):
+        r = Registry()
+        with r.span("stage", items=3) as sp:
+            sp["extra"] = 1
+        assert sp.dur_s >= 0
+        (ev,) = r.recent_spans()
+        assert ev["name"] == "stage"
+        assert ev["attrs"] == {"items": 3, "extra": 1}
+
+
+class TestSink:
+    def test_span_and_emit_to_jsonl(self, tmp_path):
+        path = tmp_path / "telemetry.jsonl"
+        r = Registry(sink_path=str(path))
+        with r.span("a", k=1):
+            pass
+        r.emit({"kind": "custom", "v": 2})
+        events = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [e["kind"] for e in events] == ["span", "custom"]
+        assert events[0]["name"] == "a" and events[0]["attrs"] == {"k": 1}
+
+    def test_no_sink_is_silent(self, tmp_path):
+        r = Registry()
+        with r.span("a"):
+            pass
+        r.emit({"kind": "x"})  # no path configured: must not raise
+        assert r.sink_path is None
+
+    def test_env_configures_default_registry(self, tmp_path, monkeypatch):
+        path = tmp_path / "t.jsonl"
+        monkeypatch.setenv("DEPPY_TPU_TELEMETRY_FILE", str(path))
+        prev = telemetry.set_default_registry(None)
+        try:
+            reg = telemetry.default_registry()
+            assert reg.sink_path == str(path)
+        finally:
+            telemetry.set_default_registry(prev)
+
+    def test_sink_failure_disables_not_raises(self, tmp_path):
+        r = Registry(sink_path=str(tmp_path / "no" / "dir" / "t.jsonl"))
+        r.emit({"kind": "x"})
+        assert r.sink_path is None  # disabled after the failed open
+
+
+# ----------------------------------------------------------- solve report
+
+
+class TestSolveReport:
+    def test_ratios(self):
+        rep = telemetry.SolveReport()
+        rep.record_batch(live_lanes=3, batch_lanes=4, live_cells=30,
+                         pad_cells=120, n_chunks=2)
+        assert rep.batch_fill_ratio == pytest.approx(0.75)
+        assert rep.pad_waste_ratio == pytest.approx(0.75)
+        d = rep.to_dict()
+        assert d["n_chunks"] == 2 and d["n_buckets"] == 1
+        assert "escalation stage" in rep.format_table()
+
+    def test_nested_begin_merges(self):
+        rep, owns = telemetry.begin_report(backend="tpu", n_problems=2)
+        assert owns
+        try:
+            inner, inner_owns = telemetry.begin_report(n_problems=3)
+            assert inner is rep and not inner_owns
+            assert rep.n_problems == 5
+            telemetry.end_report(inner, inner_owns)
+            assert telemetry.current_report() is rep
+        finally:
+            telemetry.end_report(rep, owns)
+        assert telemetry.current_report() is None
+        assert telemetry.last_report() is rep
+
+
+# ------------------------------------------------- driver instrumentation
+
+
+def _problems(n=4):
+    from deppy_tpu.models import random_instance
+    from deppy_tpu.sat.encode import encode
+
+    return [encode(random_instance(length=12, seed=s)) for s in range(n)]
+
+
+@pytest.fixture()
+def fresh_registry(tmp_path):
+    """Default registry swapped for a fresh one with a JSONL sink."""
+    path = tmp_path / "telemetry.jsonl"
+    reg = Registry(sink_path=str(path))
+    prev = telemetry.set_default_registry(reg)
+    yield reg, path
+    telemetry.set_default_registry(prev)
+
+
+def test_driver_spans_and_report_on_real_batch(fresh_registry):
+    from deppy_tpu.engine import driver
+
+    reg, path = fresh_registry
+    results = driver.solve_problems(_problems())
+    assert len(results) == 4
+
+    events = [json.loads(l) for l in path.read_text().splitlines()]
+    span_names = {e["name"] for e in events if e["kind"] == "span"}
+    # The acceptance quartet: pad/pack, device put, solve, escalation.
+    assert {"driver.pad_pack", "driver.device_put", "driver.solve",
+            "driver.escalation"} <= span_names
+    reports = [e["report"] for e in events if e["kind"] == "report"]
+    assert len(reports) == 1
+    rep = reports[0]
+    assert rep["n_problems"] == 4
+    assert sum(rep["outcomes"].values()) == 4
+    assert 0 < rep["batch_fill_ratio"] <= 1.0
+    assert 0 <= rep["pad_waste_ratio"] < 1.0
+    assert rep["steps"] > 0
+
+    snap = reg.snapshot()
+    assert snap["deppy_solve_seconds"]["count"] == 1
+    assert snap["deppy_batch_fill_ratio"]["count"] >= 1
+    assert snap["deppy_live_cells_total"] > 0
+    assert snap["deppy_pad_cells_total"] >= snap["deppy_live_cells_total"]
+
+    # The thread-local report matches what went to the sink.
+    live = telemetry.last_report()
+    assert live is not None and live.to_dict() == rep
+
+
+def _scripted_escalation_batch():
+    """Seven trivial problems (2 steps each) plus one search-heavy one
+    (5 steps): under a stage-1 budget of 3 the straggler fraction is 1/8
+    ≤ STAGE1_MAX_STRAGGLERS, forcing the compacted stage-2 redo."""
+    from deppy_tpu.sat import conflict, dependency, mandatory, variable
+    from deppy_tpu.sat.encode import encode
+
+    batch = [encode([variable(f"t{i}", mandatory())]) for i in range(7)]
+    batch.append(encode([
+        variable("x", mandatory(), dependency("y", "z")),
+        variable("y", dependency("w")),
+        variable("z"),
+        variable("w", conflict("z")),
+    ]))
+    return batch
+
+
+def test_escalation_stage_in_report(fresh_registry, monkeypatch):
+    """Satellite: a scripted batch where the stage-1 budget strands a
+    straggler and stage 2 resolves it must report escalation_stage=2."""
+    from deppy_tpu.engine import core, driver
+
+    monkeypatch.setattr(driver, "STAGE1_MIN_BATCH", 2)
+    monkeypatch.setattr(driver, "STAGE1_STEPS", 3)
+    batch = _scripted_escalation_batch()
+    base = driver.solve_problems(batch)
+    assert all(int(r.outcome) == core.SAT for r in base)
+    rep2 = telemetry.last_report()
+    assert rep2.escalation_stage == 2
+    # Escalation stays result-invisible while being observable.
+    monkeypatch.setattr(driver, "STAGE1_STEPS", 0)
+    single = driver.solve_problems(batch)
+    assert telemetry.last_report().escalation_stage == 0
+    assert [int(r.outcome) for r in base] == [int(r.outcome) for r in single]
+
+
+def test_escalation_stage1_sufficient(fresh_registry, monkeypatch):
+    from deppy_tpu.engine import driver
+
+    monkeypatch.setattr(driver, "STAGE1_MIN_BATCH", 2)
+    monkeypatch.setattr(driver, "STAGE1_STEPS", 1 << 20)  # ample stage 1
+    driver.solve_problems(_problems(4))
+    assert telemetry.last_report().escalation_stage == 1
+
+
+def test_host_fallback_rows_counted(fresh_registry, monkeypatch):
+    """Rows routed to the host spec engine for core extraction must show
+    up in both the counter and the report."""
+    from deppy_tpu.engine import driver
+    from deppy_tpu.sat import conflict, mandatory, variable
+    from deppy_tpu.sat.encode import encode
+
+    # An UNSAT problem whose n_cons exceeds the (monkeypatched) host-core
+    # threshold routes its deletion sweep to the host engine.
+    monkeypatch.setattr(driver, "HOST_CORE_NCONS", 1)
+    unsat = encode([
+        variable("a", mandatory(), conflict("b")),
+        variable("b", mandatory()),
+    ])
+    (res,) = driver.solve_problems([unsat])
+    assert int(res.outcome) == -1  # UNSAT
+    rep = telemetry.last_report()
+    assert rep.host_fallback_rows == 1
+    reg, _ = fresh_registry
+    assert reg.snapshot()["deppy_host_fallback_rows_total"] == 1
+
+
+# ------------------------------------------------------------ facades
+
+
+def test_batch_resolver_attaches_report_tpu():
+    from deppy_tpu.resolution.facade import BatchResolver
+    from deppy_tpu.sat import dependency, mandatory, variable
+
+    resolver = BatchResolver(backend="tpu")
+    results = resolver.solve([
+        [variable("a", mandatory(), dependency("b", "c")),
+         variable("b"), variable("c")],
+        [variable("x", mandatory())],
+    ])
+    assert len(results) == 2
+    rep = resolver.last_report
+    assert rep is not None and rep.backend == "tpu"
+    assert rep.outcomes["sat"] == 2
+    assert rep.n_problems == 2
+    assert rep.steps == resolver.last_steps
+
+
+def test_host_backend_reaches_sink(fresh_registry):
+    """The documented --telemetry-file contract holds on the host
+    backend too: the batch report (and a facade span) land in the JSONL
+    sink even though no device pipeline runs."""
+    from deppy_tpu.resolution.facade import BatchResolver
+    from deppy_tpu.sat import mandatory, variable
+
+    _, path = fresh_registry
+    BatchResolver(backend="host").solve([[variable("a", mandatory())]])
+    events = [json.loads(l) for l in path.read_text().splitlines()]
+    assert {e["kind"] for e in events} == {"span", "report"}
+    (rep,) = [e["report"] for e in events if e["kind"] == "report"]
+    assert rep["backend"] == "host" and rep["outcomes"]["sat"] == 1
+    assert any(e.get("name") == "facade.host_solve" for e in events)
+    assert telemetry.last_report().backend == "host"
+
+
+def test_report_from_dict_round_trip():
+    rep = telemetry.SolveReport(backend="tpu", n_problems=8)
+    rep.record_batch(live_lanes=8, batch_lanes=16, live_cells=100,
+                     pad_cells=400, n_chunks=2)
+    rep.note_escalation(2)
+    rep.count_outcome("sat", 7)
+    rep.count_outcome("unsat", 1)
+    rep.steps, rep.backtracks = 123, 4
+    rep.add_wall("solve", 0.5)
+    back = telemetry.SolveReport.from_dict(rep.to_dict())
+    assert back.to_dict() == rep.to_dict()
+    assert back.format_table() == rep.format_table()
+    # Tolerates sparse dicts from older sink files.
+    sparse = telemetry.SolveReport.from_dict({"backend": "host"})
+    assert sparse.batch_fill_ratio == 1.0 and sparse.escalation_stage == 0
+
+
+def test_stats_default_tracer_skips_position_snapshot():
+    """The default StatsTracer must not cost a position snapshot per
+    backtrack (it never reads it) — custom tracers still get real
+    positions."""
+    from deppy_tpu.sat.host import _EMPTY_POSITION, HostEngine
+    from deppy_tpu.sat import conflict, dependency, mandatory, variable
+    from deppy_tpu.sat.encode import encode
+
+    # The preferred candidate b is doomed one guess deeper than unit
+    # propagation sees, so the search must backtrack out of its subtree
+    # (the tracer-parity suite's backtracking instance).
+    problem = encode([
+        variable("a", mandatory(), dependency("b", "c")),
+        variable("c"),
+        variable("b", dependency("x", "y"), dependency("w", "z")),
+        variable("x", conflict("w"), conflict("z")),
+        variable("y", conflict("w"), conflict("z")),
+        variable("w"),
+        variable("z"),
+    ])
+    eng = HostEngine(problem)
+    assert eng._trace_wants_position is False
+    eng.solve()
+    assert eng.tracer.backtracks == eng.backtracks > 0
+
+    seen = []
+
+    class Spy:
+        def trace(self, position):
+            seen.append(position)
+
+    eng = HostEngine(problem, tracer=Spy())
+    assert eng._trace_wants_position is True
+    eng.solve()
+    assert seen and all(p is not _EMPTY_POSITION for p in seen)
+    assert seen[0].variables()  # real snapshot, not the shared sentinel
+
+
+def test_batch_resolver_attaches_report_host():
+    from deppy_tpu.resolution.facade import BatchResolver
+    from deppy_tpu.sat import dependency, mandatory, prohibited, variable
+
+    resolver = BatchResolver(backend="host")
+    resolver.solve([
+        [variable("a", mandatory(), dependency("b", "c")),
+         variable("b"), variable("c")],
+        [variable("x", mandatory(), prohibited())],
+    ])
+    rep = resolver.last_report
+    assert rep is not None and rep.backend == "host"
+    assert rep.outcomes == {"sat": 1, "unsat": 1, "incomplete": 0}
+    # Host engine counts real decisions/propagation rounds (satellite).
+    assert rep.propagation_rounds > 0
+    assert rep.steps == resolver.last_steps
+
+
+def test_solver_attaches_report_both_backends():
+    from deppy_tpu.sat import Solver, dependency, mandatory, variable
+
+    vs = [variable("a", mandatory(), dependency("b", "c")),
+          variable("b"), variable("c")]
+    for backend in ("host", "tpu"):
+        s = Solver(vs, backend=backend)
+        installed = s.solve()
+        assert [v.identifier for v in installed] == ["a", "b"]
+        assert s.report is not None
+        assert s.report.outcomes["sat"] == 1
+        assert s.report.steps == s.steps > 0
+
+
+# ------------------------------------------------------- stats tracer
+
+
+class TestStatsTracer:
+    def _search_problem(self):
+        from deppy_tpu.sat import conflict, dependency, mandatory, variable
+        from deppy_tpu.sat.encode import encode
+
+        return encode([
+            variable("x", mandatory(), dependency("y", "z")),
+            variable("y", dependency("w")),
+            variable("z"),
+            variable("w", conflict("z")),
+        ])
+
+    def test_default_tracer_is_stats(self):
+        from deppy_tpu.sat.host import HostEngine
+        from deppy_tpu.sat.tracer import StatsTracer
+
+        eng = HostEngine(self._search_problem())
+        assert isinstance(eng.tracer, StatsTracer)
+        eng.solve()
+        assert eng.tracer.decisions == eng.decisions > 0
+        assert eng.tracer.propagation_rounds == eng.propagation_rounds > 0
+
+    def test_explicit_stats_tracer_counts(self):
+        from deppy_tpu.sat.host import HostEngine
+        from deppy_tpu.sat.tracer import StatsTracer
+
+        t = StatsTracer()
+        eng = HostEngine(self._search_problem(), tracer=t)
+        eng.solve()
+        assert t.decisions > 0
+        assert t.propagation_rounds > 0
+        assert t.as_dict() == {
+            "backtracks": t.backtracks,
+            "decisions": t.decisions,
+            "propagation_rounds": t.propagation_rounds,
+        }
+
+    def test_custom_tracer_without_hooks_still_works(self):
+        from deppy_tpu.sat.host import HostEngine
+
+        class Bare:
+            calls = 0
+
+            def trace(self, position):
+                Bare.calls += 1
+
+        eng = HostEngine(self._search_problem(), tracer=Bare())
+        eng.solve()
+        # Engine-side counters still advance without the optional hooks.
+        assert eng.decisions > 0 and eng.propagation_rounds > 0
+
+
+# ------------------------------------------------------------- service
+
+
+def _scrape(server):
+    from tests.test_service import request
+
+    status, data = request(server.api_port, "GET", "/metrics")
+    assert status == 200
+    return data.decode()
+
+
+@pytest.fixture()
+def host_server():
+    from deppy_tpu.service import Server
+
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host")
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_metrics_probe_is_injectable():
+    """Satellite: Metrics.render must not import the solver module when
+    a probe is injected — the verdict gauge follows the callback."""
+    from deppy_tpu.service import Metrics
+
+    m = Metrics(engine_usable_probe=lambda: None)
+    assert "deppy_auto_engine_usable" not in m.render()
+    m = Metrics(engine_usable_probe=lambda: True)
+    assert "deppy_auto_engine_usable 1" in m.render()
+    m = Metrics(engine_usable_probe=lambda: False)
+    assert "deppy_auto_engine_usable 0" in m.render()
+
+    def boom():
+        raise RuntimeError("probe died")
+
+    m = Metrics(engine_usable_probe=boom)
+    text = m.render()  # a broken probe must not break scrapes
+    assert "deppy_auto_engine_usable" not in text
+
+
+def test_metrics_histograms_observe_report():
+    from deppy_tpu.service import Metrics
+
+    m = Metrics(engine_usable_probe=lambda: None)
+    rep = telemetry.SolveReport()
+    rep.record_batch(live_lanes=1, batch_lanes=4, live_cells=10,
+                     pad_cells=100)
+    rep.note_escalation(2)
+    m.observe_batch({"sat": 1}, 0.05, steps=7, report=rep)
+    text = m.render()
+    assert 'deppy_batch_fill_ratio_bucket{le="0.25"} 1' in text
+    assert 'deppy_escalation_stage_bucket{le="1"} 0' in text
+    assert 'deppy_escalation_stage_bucket{le="2"} 1' in text
+    assert "deppy_solve_seconds_count 1" in text
+    assert "deppy_engine_steps_total 7" in text
+
+
+def test_escalation_stage_reaches_metrics_scrape(monkeypatch):
+    """Satellite end-to-end: stage-1 fails, stage-2 succeeds, and the
+    /metrics scrape carries the observation in deppy_escalation_stage."""
+    from deppy_tpu.engine import driver
+    from deppy_tpu.service import Server
+    from tests.test_service import request
+
+    monkeypatch.setattr(driver, "STAGE1_MIN_BATCH", 2)
+    monkeypatch.setattr(driver, "STAGE1_STEPS", 3)
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="tpu")
+    srv.start()
+    try:
+        # One trivial problem plus one search-heavy straggler (needs >3
+        # steps): stage 1 strands the straggler, stage 2 resolves it.
+        problems = [
+            {"variables": [{"id": f"t{i}", "constraints":
+                            [{"type": "mandatory"}]}]}
+            for i in range(7)
+        ]
+        problems.append({"variables": [
+            {"id": "x", "constraints": [
+                {"type": "mandatory"},
+                {"type": "dependency", "ids": ["y", "z"]}]},
+            {"id": "y", "constraints": [{"type": "dependency",
+                                         "ids": ["w"]}]},
+            {"id": "z"},
+            {"id": "w", "constraints": [{"type": "conflict", "id": "z"}]},
+        ]})
+        status, data = request(srv.api_port, "POST", "/v1/resolve",
+                               {"problems": problems})
+        assert status == 200
+        assert all(r["status"] == "sat"
+                   for r in json.loads(data)["results"])
+        rep = srv.metrics._esc_hist
+        assert rep.count == 1
+        text = _scrape(srv)
+        # One batch observed at stage 2: the le="1" bucket must exclude
+        # it, the le="2" bucket must include it.
+        assert 'deppy_escalation_stage_bucket{le="1"} 0' in text
+        assert 'deppy_escalation_stage_bucket{le="2"} 1' in text
+        assert "deppy_escalation_stage_count 1" in text
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------- prometheus exposition parse
+
+
+def parse_exposition(text):
+    """Minimal Prometheus text-format parser: returns
+    (families {name: (type, help)}, samples [(name, labels, value)])."""
+    families = {}
+    helps = {}
+    samples = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            helps[name] = help_text
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert kind in ("counter", "gauge", "histogram"), line
+            families[name] = kind
+        elif line.startswith("#"):
+            raise AssertionError(f"unknown comment line: {line}")
+        else:
+            name, _, value = line.rpartition(" ")
+            labels = {}
+            if "{" in name:
+                name, _, labelpart = name.partition("{")
+                for pair in labelpart.rstrip("}").split(","):
+                    k, _, v = pair.partition("=")
+                    labels[k] = v.strip('"')
+            samples.append((name, labels, float(value)))
+    return families, helps, samples
+
+
+def _family_of(sample_name, families):
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if families.get(base) == "histogram":
+                return base
+    return sample_name
+
+
+def test_exposition_contract(host_server):
+    """Satellite: scrape-and-parse.  Every sample belongs to a family
+    with both # TYPE and # HELP; histogram buckets are monotonic and
+    +Inf equals _count."""
+    from tests.test_service import request
+
+    # Drive one real batch through so counters and histograms are live.
+    request(host_server.api_port, "POST", "/v1/resolve", {
+        "problems": [
+            {"variables": [{"id": "a",
+                            "constraints": [{"type": "mandatory"}]}]},
+            {"variables": [{"id": "b", "constraints": [
+                {"type": "mandatory"}, {"type": "prohibited"}]}]},
+        ]
+    })
+    text = _scrape(host_server)
+    families, helps, samples = parse_exposition(text)
+
+    # Every # TYPE has a # HELP and vice versa.
+    assert set(families) == set(helps)
+    for name, help_text in helps.items():
+        assert help_text.strip(), f"empty HELP for {name}"
+
+    # Every sample maps to a declared family.
+    for name, labels, value in samples:
+        fam = _family_of(name, families)
+        assert fam in families, f"sample {name} has no # TYPE"
+
+    # At least the three ISSUE 1 histogram families are present.
+    hist_names = {n for n, k in families.items() if k == "histogram"}
+    assert {"deppy_solve_seconds", "deppy_batch_fill_ratio",
+            "deppy_escalation_stage"} <= hist_names
+
+    # Histogram invariants: buckets monotonic, +Inf == _count.
+    for hname in hist_names:
+        buckets = [(labels["le"], value) for name, labels, value in samples
+                   if name == f"{hname}_bucket"]
+        assert buckets, f"no buckets for {hname}"
+        values = [v for _, v in buckets]
+        assert values == sorted(values), f"{hname} buckets not monotonic"
+        assert buckets[-1][0] == "+Inf"
+        (count,) = [v for name, _, v in samples
+                    if name == f"{hname}_count"]
+        assert buckets[-1][1] == count
+
+
+def test_exposition_pinned_lines_preserved(host_server):
+    """The historical counter lines must survive the registry rebuild
+    byte for byte (dashboards and the e2e script grep for them)."""
+    text = _scrape(host_server)
+    for line in (
+        "# HELP deppy_resolutions_total Problems resolved by outcome.",
+        "# TYPE deppy_resolutions_total counter",
+        'deppy_resolutions_total{outcome="sat"} 0',
+        "deppy_batches_total 0",
+        "deppy_request_errors_total 0",
+        "deppy_solve_seconds_total 0.0",
+        "deppy_engine_steps_total 0",
+    ):
+        assert line in text, f"missing pinned line: {line}"
+
+
+# ------------------------------------------------------------------ CLI
+
+
+class TestStatsCLI:
+    def _write_events(self, path):
+        events = [
+            {"ts": 1.0, "kind": "span", "name": "driver.pad_pack",
+             "dur_s": 0.002, "attrs": {"problems": 4}},
+            {"ts": 1.1, "kind": "span", "name": "driver.solve",
+             "dur_s": 0.5, "attrs": {"problems": 4}},
+            {"ts": 1.2, "kind": "span", "name": "driver.solve",
+             "dur_s": 0.3, "attrs": {"problems": 4}},
+            {"ts": 1.3, "kind": "report", "report": {
+                "backend": "tpu", "n_problems": 4,
+                "outcomes": {"sat": 4, "unsat": 0, "incomplete": 0},
+                "steps": 120, "backtracks": 3, "decisions": 0,
+                "propagation_rounds": 0, "batch_fill_ratio": 1.0,
+                "live_lanes": 4, "batch_lanes": 4,
+                "pad_waste_ratio": 0.4, "escalation_stage": 2,
+                "host_fallback_rows": 0,
+                "wall_s": {"solve": 0.8}}},
+            "not json at all",
+        ]
+        path.write_text("\n".join(
+            e if isinstance(e, str) else json.dumps(e) for e in events
+        ) + "\n")
+
+    def test_text_output(self, tmp_path, capsys):
+        from deppy_tpu.cli import main
+
+        path = tmp_path / "t.jsonl"
+        self._write_events(path)
+        assert main(["stats", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "driver.solve" in out
+        assert "escalation stage:  2" in out
+        assert "1 malformed lines skipped" in out
+
+    def test_json_output(self, tmp_path, capsys):
+        from deppy_tpu.cli import main
+
+        path = tmp_path / "t.jsonl"
+        self._write_events(path)
+        assert main(["stats", str(path), "--output", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["spans"]["driver.solve"]["count"] == 2
+        assert doc["spans"]["driver.solve"]["total_s"] == pytest.approx(0.8)
+        assert doc["last_report"]["escalation_stage"] == 2
+
+    def test_missing_file_is_usage_error(self, tmp_path, capsys,
+                                         monkeypatch):
+        from deppy_tpu.cli import main
+
+        monkeypatch.delenv("DEPPY_TPU_TELEMETRY_FILE", raising=False)
+        assert main(["stats"]) == 2
+        assert main(["stats", str(tmp_path / "nope.jsonl")]) == 2
+
+    def test_resolve_telemetry_file_writes_events(self, tmp_path, capsys):
+        from deppy_tpu.cli import main
+
+        doc = {"variables": [{"id": "a",
+                              "constraints": [{"type": "mandatory"}]}]}
+        problem = tmp_path / "p.json"
+        problem.write_text(json.dumps(doc))
+        sink = tmp_path / "t.jsonl"
+        prev = telemetry.set_default_registry(None)
+        try:
+            rc = main(["resolve", str(problem), "--backend", "tpu",
+                       "--telemetry-file", str(sink), "--report"])
+        finally:
+            telemetry.set_default_registry(prev)
+        assert rc == 0
+        captured = capsys.readouterr()
+        assert "resolution set: a" in captured.out
+        assert "solve report" in captured.err  # --report table on stderr
+        events = [json.loads(l) for l in sink.read_text().splitlines()]
+        kinds = {e["kind"] for e in events}
+        assert kinds == {"span", "report"}
