@@ -44,6 +44,14 @@ metrics-smoke: ## Boot the service on an ephemeral port, resolve the golden prob
 test-telemetry: ## Observability subsystem tests only (the `telemetry` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m telemetry
 
+.PHONY: chaos-smoke
+chaos-smoke: ## Inject device faults into the live service: assert retry recovery, breaker trip to host-only, and fault telemetry (ISSUE 2 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_smoke.py
+
+.PHONY: test-chaos
+test-chaos: ## Fault-domain subsystem tests only (the `chaos` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m chaos
+
 ##@ Benchmarks
 
 .PHONY: bench

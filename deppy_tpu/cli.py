@@ -83,6 +83,23 @@ def _build_parser() -> argparse.ArgumentParser:
         help="print the per-batch solve report (padding occupancy, "
         "escalation stage, host fallback) on stderr after resolving",
     )
+    p_resolve.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="wall-clock budget for the whole resolve: problems not "
+        "dispatched before it expires report incomplete instead of the "
+        "batch aborting (also via DEPPY_TPU_BATCH_DEADLINE_S)",
+    )
+    p_resolve.add_argument(
+        "--fault-plan",
+        default=None,
+        metavar="SPEC",
+        help="arm the fault-injection harness: inline JSON, @FILE, or a "
+        "path to a JSON fault plan (also via DEPPY_TPU_FAULT_PLAN; see "
+        "docs/robustness.md)",
+    )
 
     p_bench = sub.add_parser(
         "bench", help="run the headline benchmark (one JSON line on stdout)"
@@ -121,6 +138,17 @@ def _build_parser() -> argparse.ArgumentParser:
         help="append every pipeline span and per-batch solve report as "
         "JSONL events to FILE (also via DEPPY_TPU_TELEMETRY_FILE)",
     )
+    p_serve.add_argument(
+        "--request-deadline", type=float, default=None, metavar="SECONDS",
+        help="default wall-clock budget per /v1/resolve request; clients "
+        "override with the X-Deppy-Deadline-S header (also via "
+        "DEPPY_TPU_REQUEST_DEADLINE_S)",
+    )
+    p_serve.add_argument(
+        "--fault-plan", default=None, metavar="SPEC",
+        help="arm the fault-injection harness for the whole service "
+        "(inline JSON, @FILE, or a path; also via DEPPY_TPU_FAULT_PLAN)",
+    )
 
     p_stats = sub.add_parser(
         "stats",
@@ -156,6 +184,7 @@ _CONFIG_KEYS = {
     "healthProbeBindAddress": ("probe_address", str),
     "backend": ("backend", str),
     "maxSteps": ("max_steps", int),
+    "requestDeadlineSeconds": ("request_deadline_s", float),
 }
 
 
@@ -189,11 +218,27 @@ def _load_serve_config(path: str) -> dict:
     return out
 
 
+def _arm_fault_plan(spec) -> int:
+    """Install a --fault-plan spec; returns 0 or a usage-error code."""
+    if not spec:
+        return 0
+    from . import faults
+
+    try:
+        faults.configure_plan(faults.plan_from_spec(spec))
+    except (OSError, ValueError) as e:
+        print(f"error: invalid fault plan: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
 def _cmd_resolve(args) -> int:
     if args.telemetry_file:
         from .telemetry import configure_sink
 
         configure_sink(args.telemetry_file)
+    if _arm_fault_plan(args.fault_plan):
+        return 2
     try:
         problems, is_batch = problem_io.load_document(args.file)
     except FileNotFoundError:
@@ -210,7 +255,7 @@ def _cmd_resolve(args) -> int:
 
     resolver = BatchResolver(
         backend=args.backend, max_steps=args.max_steps,
-        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_dir=args.checkpoint_dir, deadline_s=args.deadline,
     )
     try:
         results = resolver.solve(problems)
@@ -274,7 +319,10 @@ def _cmd_stats(args) -> int:
     n_events = 0
     n_bad = 0
     try:
-        with open(path, "r", encoding="utf-8") as fh:
+        # errors="replace": a torn write can leave invalid UTF-8 on the
+        # final line of a live sink file — it must count as one malformed
+        # line, not raise UnicodeDecodeError mid-summary.
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
             for line in fh:
                 line = line.strip()
                 if not line:
@@ -346,6 +394,8 @@ def _cmd_serve(args) -> int:
         from .telemetry import configure_sink
 
         configure_sink(args.telemetry_file)
+    if _arm_fault_plan(args.fault_plan):
+        return 2
 
     # Precedence: built-in defaults < --config file < explicit flags
     # (the reference's flag-vs-ControllerManagerConfig behavior).  Flags
@@ -355,6 +405,7 @@ def _cmd_serve(args) -> int:
         "probe_address": ":8081",
         "backend": "auto",
         "max_steps": None,
+        "request_deadline_s": None,
     }
     try:
         if args.config:
@@ -364,6 +415,7 @@ def _cmd_serve(args) -> int:
             ("probe_address", args.health_probe_bind_address),
             ("backend", args.backend),
             ("max_steps", args.max_steps),
+            ("request_deadline_s", args.request_deadline),
         ):
             if val is not None:
                 kwargs[key] = val

@@ -30,6 +30,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .. import faults
 from ..sat.encode import Problem
 from . import core, driver
 
@@ -58,11 +59,31 @@ def _group_path(ckpt_dir: str, i: int) -> str:
     return os.path.join(ckpt_dir, f"group_{i:05d}.npz")
 
 
+def _pad_to(a: np.ndarray, shape: tuple) -> np.ndarray:
+    """Zero-pad ``a`` up to ``shape`` (same rank).  Decode reads masks by
+    live index (< n_vars / n_cons), so zero padding is outcome-neutral."""
+    if a.shape == shape:
+        return a
+    out = np.zeros(shape, dtype=a.dtype)
+    out[tuple(slice(0, s) for s in a.shape)] = a
+    return out
+
+
 def _save_group(ckpt_dir: str, i: int, results: List[core.SolveResult]) -> None:
-    arrays = {
-        f: np.stack([np.asarray(getattr(r, f)) for r in results])
-        for f in core.SolveResult._fields
-    }
+    # fault point: a scripted crash here models the real failure this
+    # module exists for — the process dying between completed groups
+    # (tests/test_checkpoint.py resumes across exactly this).
+    faults.inject("checkpoint.save_group")
+    arrays = {}
+    for f in core.SolveResult._fields:
+        vals = [np.asarray(getattr(r, f)) for r in results]
+        # Results within one group normally share their bucket's padded
+        # dims, but the fault layer can split a failing group or route
+        # part of it to the host engine, leaving mixed widths — pad to
+        # the widest so the stack (and the resume load) stays exact.
+        widest = tuple(max(v.shape[k] for v in vals)
+                       for k in range(vals[0].ndim))
+        arrays[f] = np.stack([_pad_to(v, widest) for v in vals])
     tmp = _group_path(ckpt_dir, i) + ".tmp"
     with open(tmp, "wb") as fh:
         np.savez(fh, **arrays)
@@ -129,15 +150,28 @@ def solve_problems_checkpointed(
 
     out: List[Optional[core.SolveResult]] = [None] * len(problems)
     resumed = 0
-    for gi, lo in enumerate(range(0, len(problems), group)):
-        chunk = list(problems[lo: lo + group])
-        cached = _load_group(ckpt_dir, gi, len(chunk)) if meta_ok else None
-        if cached is None:
-            cached = driver.solve_problems(chunk, max_steps=max_steps, mesh=mesh)
-            _save_group(ckpt_dir, gi, cached)
-        else:
-            resumed += len(chunk)
-        out[lo: lo + len(chunk)] = cached
+    # ambient_deadline here (not just inside each driver call) so the
+    # per-group persistence check below sees the env-configured batch
+    # deadline too, not only a caller-installed scope.
+    with faults.ambient_deadline() as dl:
+        for gi, lo in enumerate(range(0, len(problems), group)):
+            chunk = list(problems[lo: lo + group])
+            cached = (_load_group(ckpt_dir, gi, len(chunk))
+                      if meta_ok else None)
+            if cached is None:
+                cached = driver.solve_problems(chunk, max_steps=max_steps,
+                                               mesh=mesh)
+                # A group computed after the batch deadline expired may
+                # be deadline-degraded (Incomplete with zero work done)
+                # — never persist it: the meta key covers the step
+                # budget but not the wall clock, and a resume without
+                # the deadline must re-solve these groups, not inherit
+                # their degradation.
+                if dl is None or not dl.expired():
+                    _save_group(ckpt_dir, gi, cached)
+            else:
+                resumed += len(chunk)
+            out[lo: lo + len(chunk)] = cached
     if resumed:
         import sys
 

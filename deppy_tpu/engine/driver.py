@@ -28,7 +28,7 @@ from typing import List, Optional, Sequence, Union
 import jax
 import numpy as np
 
-from .. import telemetry
+from .. import faults, telemetry
 from ..sat.constraints import Variable
 from ..sat.encode import Problem, encode
 from ..sat.errors import Incomplete, InternalSolverError, NotSatisfiable
@@ -709,6 +709,7 @@ def _solve_monolith(problems, budget, mesh, trace_cap) -> List[core.SolveResult]
         pts_np = pad_stack(problems, d, d.B, pack=False)
     _telem_record_pad(problems, d.B, d, n_chunks=1, dur_s=sp.dur_s)
     with reg.span("driver.device_put", lanes=int(d.B)) as sp:
+        faults.inject("driver.device_put")
         pts = _put_chunk(pts_np, mesh, d,
                          full=True if not host_core else None)
     if rep is not None:
@@ -803,6 +804,7 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
     # per-chunk path shards each chunk's batch axis instead (a single
     # upload would fix the whole batch onto one device).
     with reg.span("driver.device_put", lanes=total, chunks=n_chunks) as sp:
+        faults.inject("driver.device_put")
         if mesh is None:
             pts_all = _put_compact(pts_np)
             pts_dev = [_derive_planes(_rows(pts_all, sl), d)
@@ -1035,10 +1037,183 @@ def _record_escalation(stage: int, stragglers: int = 0) -> None:
         rep.note_escalation(stage)
 
 
+# ------------------------------------------------------------- fault domain
+#
+# ISSUE 2 tentpole: the dispatch path must survive a dying accelerator.
+# Every dispatch-group impl call (_solve_monolith / _solve_split, via
+# _solve_escalating) runs under _recovering(), which owns the policy:
+# retry with backoff, split a group that keeps failing, route to the
+# host engine as the last line, and feed the accelerator circuit
+# breaker.  The fault-injection harness (faults.inject) scripts device
+# failures at the named points so all of this runs in CI on CPU.
+
+
+def _fault_results_host(problems, budget, reason: str) -> List[core.SolveResult]:
+    """Solve one dispatch group entirely on the host engine (fault-path
+    fallback: the device dispatch failed or the breaker is open).
+
+    Results are device-shaped — installed/core masks padded to the
+    group's bucketed dims so checkpoint stacking and decode see exactly
+    what a device dispatch would have produced; the step budget carries
+    over, so budget-exhausted lanes still read Incomplete."""
+    from ..sat.host import HostEngine
+
+    faults.inject("driver.host_fallback")
+    reg = telemetry.default_registry()
+    faults.fault_counter("deppy_fault_host_routed_total").inc(len(problems))
+    reg.event("fault", fault="host_fallback", reason=reason,
+              problems=len(problems))
+    rep = telemetry.current_report()
+    if rep is not None:
+        rep.fault_host_routed += len(problems)
+    d = _Dims(problems, max(len(problems), 1))
+    out: List[core.SolveResult] = []
+    dl = faults.current_deadline()
+    with reg.span("driver.fault_host_fallback", problems=len(problems),
+                  reason=reason):
+        for i, p in enumerate(problems):
+            # The serial fallback honors the batch deadline between
+            # problems like the facade's host loop: solved problems keep
+            # their answers, the remainder degrades to Incomplete
+            # instead of running minutes past the request's budget.
+            if dl is not None and dl.expired():
+                faults.note_deadline_exceeded("driver.host_fallback",
+                                              len(problems) - i)
+                out.extend(_deadline_results(problems[i:]))
+                break
+            installed = np.zeros(d.NV, bool)
+            cmask = np.zeros(d.NCON, bool)
+            eng = HostEngine(p, max_steps=int(budget))
+            outcome = core.RUNNING
+            try:
+                _, idx = eng.solve()
+                installed[idx] = True
+                outcome = core.SAT
+            except NotSatisfiable as e:
+                # solve() already ran the deletion sweep; the exception
+                # carries the very objects of p.applied, so the mask
+                # rebuilds by identity — re-running unsat_core_mask here
+                # would double the step charge and could flip an
+                # in-budget UNSAT to Incomplete.
+                core_ids = {id(c) for c in e.constraints}
+                cmask[: p.n_cons] = [id(c) in core_ids for c in p.applied]
+                outcome = core.UNSAT
+            except Incomplete:
+                outcome = core.RUNNING
+            out.append(core.SolveResult(
+                np.int32(outcome), installed, cmask, np.int64(eng.steps),
+                np.zeros((0, 0), np.int32), np.int32(eng.backtracks)))
+    return out
+
+
+def _deadline_results(problems) -> List[core.SolveResult]:
+    """Incomplete results for a group whose batch deadline expired before
+    it could dispatch — completed batchmates keep their answers, these
+    lanes report exactly what a budget-exhausted solve would."""
+    d = _Dims(problems, max(len(problems), 1))
+    return [
+        core.SolveResult(np.int32(core.RUNNING), np.zeros(d.NV, bool),
+                         np.zeros(d.NCON, bool), np.int64(0),
+                         np.zeros((0, 0), np.int32), np.int32(0))
+        for _ in problems
+    ]
+
+
+def _recovering(impl):
+    """Wrap a dispatch-group impl with the fault-domain policy.
+
+    Order of recovery for a failing group: (1) retry up to
+    ``RetryPolicy.max_attempts`` with exponential backoff + jitter,
+    (2) split the group in half and recurse (a single poison problem —
+    e.g. one that triggers the oversized-program worker crash —
+    isolates in log2 steps while its groupmates stay on device),
+    (3) host-engine fallback.  Semantic outcomes (NotSatisfiable /
+    Incomplete / InternalSolverError) and admission errors pass
+    through untouched — only unexpected failures are device faults.
+
+    The breaker sees every failure and success; once open, groups route
+    straight to the host engine without paying an attempt, until the
+    cooldown's half-open probe dispatch."""
+
+    def run(problems, budget, mesh, trace_cap):
+        policy = faults.RetryPolicy.from_env()
+        breaker = faults.default_breaker()
+        reg = telemetry.default_registry()
+        dl = faults.current_deadline()
+        if dl is not None and dl.expired():
+            faults.note_deadline_exceeded("driver.dispatch", len(problems))
+            return _deadline_results(problems)
+        if not breaker.allow():
+            return _fault_results_host(problems, budget,
+                                       reason="breaker_open")
+        attempt = 0
+        while True:
+            t0 = _time.monotonic()
+            try:
+                faults.inject("driver.dispatch")
+                results = impl(problems, budget, mesh, trace_cap)
+            except (InternalSolverError, NotSatisfiable, Incomplete,
+                    faults.DeadlineExceeded):
+                # Not a device verdict: if this attempt was the breaker's
+                # half-open probe, hand the slot back so the next
+                # dispatch can probe (a leaked slot would silently deny
+                # the device forever).
+                breaker.abandon_probe()
+                raise
+            except Exception as e:
+                attempt += 1
+                breaker.record_failure()
+                faults.fault_counter("deppy_fault_failures_total").inc()
+                reg.event("fault", fault="dispatch_failed",
+                          error=type(e).__name__, attempt=attempt,
+                          problems=len(problems), breaker=breaker.state())
+                if dl is not None and dl.expired():
+                    faults.note_deadline_exceeded("driver.dispatch",
+                                                  len(problems))
+                    return _deadline_results(problems)
+                if attempt < policy.max_attempts and not breaker.blocks_device():
+                    faults.fault_counter("deppy_fault_retries").inc()
+                    back = policy.backoff_s(attempt)
+                    if dl is not None:
+                        back = min(back, max(dl.remaining(), 0.0))
+                    if back > 0:
+                        _time.sleep(back)
+                    continue
+                if (len(problems) > 1 and policy.split_failed_groups
+                        and not breaker.blocks_device()):
+                    reg.event("fault", fault="group_split",
+                              problems=len(problems))
+                    mid = (len(problems) + 1) // 2
+                    return (run(list(problems[:mid]), budget, mesh, trace_cap)
+                            + run(list(problems[mid:]), budget, mesh,
+                                  trace_cap))
+                return _fault_results_host(problems, budget,
+                                           reason=type(e).__name__)
+            else:
+                dur = _time.monotonic() - t0
+                if (policy.chunk_deadline_s > 0
+                        and dur > policy.chunk_deadline_s):
+                    # A dispatch that ran this long is the crash class
+                    # the driver documents (minutes-long single
+                    # executions wedge the tunneled worker): keep the
+                    # valid result, but count it and charge the breaker
+                    # so a streak of them trips to host-only.
+                    faults.note_deadline_exceeded("driver.chunk",
+                                                  len(problems))
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()
+                return results
+
+    return run
+
+
 def _solve_escalating(impl, problems, budget, mesh, trace_cap):
     """Run ``impl`` in two budget stages when profitable; transparent
     fallbacks otherwise.  Tracing disables escalation (stage-2 re-runs
-    would re-record trace buffers from scratch)."""
+    would re-record trace buffers from scratch).  Every impl call is
+    wrapped by the fault-domain recovery policy (:func:`_recovering`)."""
+    impl = _recovering(impl)
     reg = telemetry.default_registry()
     if (
         STAGE1_STEPS <= 0
@@ -1067,8 +1242,25 @@ def _solve_escalating(impl, problems, budget, mesh, trace_cap):
             return results
         sp["stage"] = 2
         _record_escalation(2, stragglers=len(stragglers))
+        dl = faults.current_deadline()
+        if dl is not None and dl.expired():
+            # The batch deadline expired during stage 1: the redo would
+            # only hit the recovery wrapper's expired-deadline fast path
+            # again (degrading the same lanes and double-counting
+            # deppy_deadline_exceeded) — the stage-1 results already
+            # carry the right Incomplete verdicts.
+            return results
         if len(stragglers) > STAGE1_MAX_STRAGGLERS * len(problems):
-            return impl(problems, budget, mesh, trace_cap)
+            redo = impl(problems, budget, mesh, trace_cap)
+            # A lane the redo left undecided (fault/deadline degradation
+            # inside the recovery wrapper) keeps its stage-1 decision:
+            # completed lanes must never be un-solved by a redo that was
+            # only ever about the stragglers.
+            return [
+                r1 if (int(r2.outcome) == core.RUNNING
+                       and int(r1.outcome) != core.RUNNING) else r2
+                for r1, r2 in zip(results, redo)
+            ]
         sub = impl([problems[i] for i in stragglers], budget, mesh, 0)
         for i, r in zip(stragglers, sub):
             # Each lane reports the steps of the run that produced its
@@ -1114,7 +1306,12 @@ def solve_problems(
     reg = telemetry.default_registry()
     t0 = _time.perf_counter()
     try:
-        with reg.span("driver.solve", problems=len(problems)):
+        # Ambient batch deadline: the caller's deadline_scope when one is
+        # active (service request / CLI --deadline), else
+        # DEPPY_TPU_BATCH_DEADLINE_S from the environment.  Expiry never
+        # aborts the batch — groups past the deadline decode Incomplete.
+        with faults.ambient_deadline(), \
+                reg.span("driver.solve", problems=len(problems)):
             results = _solve_problems_inner(
                 problems, max_steps, mesh, trace_cap, split_phases,
                 bucketing,

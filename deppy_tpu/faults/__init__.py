@@ -1,0 +1,108 @@
+"""deppy_tpu.faults — the fault-domain layer (ISSUE 2).
+
+PR 1 gave the pipeline eyes (telemetry); this package gives it reflexes.
+Three pieces, consumed by the engine driver, the resolution facade, and
+the service:
+
+  * **policy** — :class:`RetryPolicy` (exponential backoff + jitter for
+    failed device dispatches) and :class:`Deadline` (wall-clock budgets,
+    per batch and per chunk) carried on a thread-local scope so the
+    driver's pinned internal signatures stay untouched;
+  * **breaker** — the accelerator :class:`CircuitBreaker`: N consecutive
+    device failures trip the whole process to host-only solving, a
+    cooldown later one half-open probe dispatch decides whether to
+    close it again;
+  * **inject** — the deterministic fault-injection harness
+    (``DEPPY_TPU_FAULT_PLAN`` / ``--fault-plan``): named fault points in
+    the driver, checkpoint writer, and service raise or stall on a
+    scripted schedule so every recovery path runs in CI on CPU.
+
+Metric families (ISSUE 2 acceptance): ``deppy_fault_retries``,
+``deppy_breaker_state``, ``deppy_deadline_exceeded`` — registered on
+:func:`deppy_tpu.telemetry.default_registry`, mirrored into the
+service's ``/metrics`` scrape via :func:`render_metric_lines`, and
+emitted as ``fault`` / ``breaker`` events on the JSONL sink.  See
+docs/robustness.md for the fault matrix.
+"""
+
+from .breaker import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    default_breaker,
+    set_default_breaker,
+)
+from .metrics import FAMILIES, fault_counter
+from .inject import (
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    configure_plan,
+    current_plan,
+    inject,
+    plan_from_env,
+    plan_from_spec,
+)
+from .policy import (
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    ambient_deadline,
+    current_deadline,
+    deadline_scope,
+    env_float,
+    note_deadline_exceeded,
+)
+
+__all__ = [
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceeded",
+    "FaultPlan",
+    "FaultRule",
+    "InjectedFault",
+    "RetryPolicy",
+    "ambient_deadline",
+    "configure_plan",
+    "current_deadline",
+    "current_plan",
+    "deadline_scope",
+    "default_breaker",
+    "env_float",
+    "fault_counter",
+    "FAMILIES",
+    "inject",
+    "note_deadline_exceeded",
+    "plan_from_env",
+    "plan_from_spec",
+    "render_metric_lines",
+    "set_default_breaker",
+]
+
+
+def render_metric_lines() -> list:
+    """Prometheus exposition lines for every fault-domain family
+    (docs/observability.md's table), for a service ``Metrics.render`` to
+    append — the same injection pattern as ``deppy_auto_engine_usable``.
+    Reads the pipeline-global state, so every server in the process
+    reports the one real breaker.  The breaker gauge is synthesized from
+    the live breaker (always present, cooldown edge included); the
+    counters render from their ``default_registry`` families — declared
+    once in :mod:`deppy_tpu.faults.metrics`, registered here at zero
+    when nothing has incremented them yet."""
+    from .. import telemetry
+    from .metrics import BREAKER_STATE_HELP, FAMILIES, fault_counter
+
+    lines = [
+        f"# HELP deppy_breaker_state {BREAKER_STATE_HELP}",
+        "# TYPE deppy_breaker_state gauge",
+        f"deppy_breaker_state {default_breaker().state_code()}",
+    ]
+    for name in FAMILIES:
+        fault_counter(name)  # ensure registered (zero) before rendering
+    return lines + telemetry.default_registry().render_families(
+        list(FAMILIES))

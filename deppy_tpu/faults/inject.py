@@ -1,0 +1,230 @@
+"""Deterministic fault injection at named pipeline points.
+
+Every recovery path in the fault-domain layer (retry, group split, host
+fallback, breaker trip, checkpoint resume, request drain) must be
+exercisable in CI on CPU, where the accelerator never actually fails.
+This harness scripts the failures: a **fault plan** — JSON from
+``DEPPY_TPU_FAULT_PLAN`` or ``--fault-plan`` — lists rules matched
+against named **fault points** the pipeline calls :func:`inject` at.
+
+Fault points wired in this PR:
+
+  ==========================  ================================================
+  point                       where
+  ==========================  ================================================
+  ``driver.dispatch``         entry of every device dispatch attempt (the
+                              recovery wrapper, so retries re-hit it)
+  ``driver.device_put``       host→device upload of a dispatch group
+  ``driver.host_fallback``    entry of the host-engine fallback (latency
+                              injection; an error here propagates — the host
+                              engine is the last line of defense, faults
+                              there must fail loud)
+  ``checkpoint.save_group``   before a completed group's npz write
+  ``service.resolve``         entry of one ``/v1/resolve`` request body
+  ==========================  ================================================
+
+Plan format — an object ``{"faults": [...]}`` or a bare list of rules::
+
+    [{"point": "driver.device_put", "kind": "error", "times": 1},
+     {"point": "driver.dispatch", "kind": "latency", "latency_s": 0.02,
+      "times": -1},
+     {"point": "driver.dispatch", "kind": "error", "period": 2, "times": 1}]
+
+Rule fields: ``point`` (exact name or fnmatch glob, e.g. ``driver.*``),
+``kind`` (``error`` | ``latency``, default ``error``), ``times`` (total
+firings, -1 = unlimited, default 1), ``after`` (skip the first K hits),
+``period`` (when > 0, fire on the first ``times`` hits of every
+``period``-hit cycle — "every first chunk attempt" is
+``{"period": 2, "times": 1}`` under a 2-attempt retry policy), and
+``latency_s`` / ``message``.  Hit counting is per rule, under one lock —
+deterministic for a given call sequence.
+
+Errors raise :class:`InjectedFault` (a ``RuntimeError``), which the
+recovery wrapper treats exactly like a real device failure.  Injections
+count ``deppy_faults_injected_total{point=}`` and emit ``fault`` events
+to the telemetry sink.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from fnmatch import fnmatch
+from typing import List, Optional, Union
+
+
+class InjectedFault(RuntimeError):
+    """The scripted failure raised at an ``error`` fault point."""
+
+
+class FaultRule:
+    """One scripted fault: where, what, and on which hits."""
+
+    __slots__ = ("point", "kind", "times", "after", "period", "latency_s",
+                 "message", "hits", "fired")
+
+    def __init__(self, point: str, kind: str = "error", times: int = 1,
+                 after: int = 0, period: int = 0, latency_s: float = 0.0,
+                 message: str = ""):
+        if kind not in ("error", "latency"):
+            raise ValueError(f"fault rule kind must be 'error' or "
+                             f"'latency', got {kind!r}")
+        self.point = str(point)
+        self.kind = kind
+        self.times = int(times)
+        self.after = max(int(after), 0)
+        self.period = max(int(period), 0)
+        self.latency_s = float(latency_s)
+        self.message = message or f"injected fault at {point}"
+        self.hits = 0       # matching inject() calls seen
+        self.fired = 0      # times this rule actually fired
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultRule":
+        if not isinstance(d, dict) or "point" not in d:
+            raise ValueError(f"fault rule must be an object with a "
+                             f"'point' key, got {d!r}")
+        unknown = set(d) - {"point", "kind", "times", "after", "period",
+                            "latency_s", "message"}
+        if unknown:
+            raise ValueError(
+                f"unknown fault rule keys {sorted(unknown)} in {d!r}")
+        return cls(
+            point=d["point"], kind=d.get("kind", "error"),
+            times=d.get("times", 1), after=d.get("after", 0),
+            period=d.get("period", 0), latency_s=d.get("latency_s", 0.0),
+            message=d.get("message", ""),
+        )
+
+    def should_fire(self, consume: bool = True) -> bool:
+        """Advance this rule's hit counter and decide; caller holds the
+        plan lock.  ``consume=False`` still advances the schedule but
+        leaves the ``times`` budget untouched — used for an error rule
+        shadowed by an earlier one on the same hit, so its scripted
+        firing isn't silently spent without ever raising."""
+        self.hits += 1
+        idx = self.hits - 1  # 0-based hit index
+        if idx < self.after:
+            return False
+        idx -= self.after
+        if self.period > 0:
+            fire = (idx % self.period) < max(self.times, 0) or self.times < 0
+        else:
+            fire = self.times < 0 or self.fired < self.times
+        if fire and consume:
+            self.fired += 1
+        return fire and consume
+
+
+class FaultPlan:
+    """A parsed, hit-counting set of fault rules."""
+
+    def __init__(self, rules: List[FaultRule]):
+        self.rules = rules
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_doc(cls, doc: Union[dict, list]) -> "FaultPlan":
+        if isinstance(doc, dict):
+            doc = doc.get("faults", [])
+        if not isinstance(doc, list):
+            raise ValueError(
+                "fault plan must be a list of rules or "
+                '{"faults": [...]}')
+        return cls([FaultRule.from_dict(r) for r in doc])
+
+    def check(self, point: str) -> None:
+        """Match ``point`` against every rule; sleep for latency rules,
+        raise :class:`InjectedFault` for the first error rule that
+        fires.  Latency rules evaluated before the error raise, so a
+        slow-then-dead fault composes in one plan."""
+        sleep_s = 0.0
+        error: Optional[FaultRule] = None
+        with self._lock:
+            for rule in self.rules:
+                if rule.point != point and not fnmatch(point, rule.point):
+                    continue
+                consume = rule.kind == "latency" or error is None
+                if not rule.should_fire(consume=consume):
+                    continue
+                if rule.kind == "latency":
+                    sleep_s += rule.latency_s
+                else:
+                    error = rule
+        if sleep_s > 0.0:
+            _record(point, "latency", sleep_s=sleep_s)
+            time.sleep(sleep_s)
+        if error is not None:
+            _record(point, "error")
+            raise InjectedFault(error.message)
+
+
+def _record(point: str, kind: str, **attrs) -> None:
+    from .. import telemetry
+    from .metrics import fault_counter
+
+    fault_counter("deppy_faults_injected_total").inc(1, label=point)
+    telemetry.default_registry().event(
+        "fault", fault="injected", point=point, fault_kind=kind, **attrs)
+
+
+# ------------------------------------------------------------ plan plumbing
+
+_PLAN: Optional[FaultPlan] = None
+_PLAN_LOCK = threading.Lock()
+_ENV_LOADED = False
+
+
+def plan_from_spec(spec: str) -> FaultPlan:
+    """Parse a plan from inline JSON, ``@file``, or a plain file path
+    (anything not starting with ``[`` / ``{`` is treated as a path)."""
+    spec = spec.strip()
+    if spec.startswith("@"):
+        spec = spec[1:]
+    if spec and spec[0] not in "[{":
+        with open(spec, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    else:
+        doc = json.loads(spec)
+    return FaultPlan.from_doc(doc)
+
+
+def plan_from_env() -> Optional[FaultPlan]:
+    """Parse ``DEPPY_TPU_FAULT_PLAN`` (inline JSON or a file path);
+    unset/empty → None.  A malformed plan raises — a chaos run that
+    silently injects nothing would report green without testing
+    anything."""
+    raw = os.environ.get("DEPPY_TPU_FAULT_PLAN", "").strip()
+    if not raw:
+        return None
+    return plan_from_spec(raw)
+
+
+def configure_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install the active plan (None disarms); returns the previous."""
+    global _PLAN, _ENV_LOADED
+    with _PLAN_LOCK:
+        prev, _PLAN = _PLAN, plan
+        _ENV_LOADED = True  # explicit configuration overrides the env
+        return prev
+
+
+def current_plan() -> Optional[FaultPlan]:
+    """The active plan, loading ``DEPPY_TPU_FAULT_PLAN`` on first call."""
+    global _PLAN, _ENV_LOADED
+    if not _ENV_LOADED:
+        with _PLAN_LOCK:
+            if not _ENV_LOADED:
+                _PLAN = plan_from_env()
+                _ENV_LOADED = True
+    return _PLAN
+
+
+def inject(point: str) -> None:
+    """The pipeline's fault hook.  No active plan → one global read and
+    return; the hot paths never pay more than that."""
+    plan = current_plan()
+    if plan is not None:
+        plan.check(point)

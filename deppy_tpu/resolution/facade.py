@@ -15,7 +15,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Union
 
-from .. import telemetry
+from .. import faults, telemetry
 from ..entity.entity import EntityID
 from ..entity.source import EntityQuerier
 from ..sat.constraints import Variable
@@ -102,10 +102,16 @@ class BatchResolver:
         max_steps: Optional[int] = None,
         mesh=None,
         checkpoint_dir: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
         self.mesh = mesh  # jax.sharding.Mesh from deppy_tpu.parallel
+        # Wall-clock budget for one solve call (ISSUE 2): problems not
+        # dispatched before it expires come back Incomplete instead of
+        # the batch aborting; the service threads each request's
+        # deadline through here.
+        self.deadline_s = deadline_s
         # Group-wise resume for fleet-scale batches: completed groups of a
         # crashed run are loaded instead of re-solved (tensor backend only;
         # see deppy_tpu.engine.checkpoint).
@@ -120,6 +126,17 @@ class BatchResolver:
         self.last_report: Optional[telemetry.SolveReport] = None
 
     def solve(
+        self, problems: Sequence[Sequence[Variable]]
+    ) -> List[Union[Solution, NotSatisfiable, Incomplete]]:
+        # ambient_deadline picks up DEPPY_TPU_BATCH_DEADLINE_S when no
+        # explicit deadline is active — here rather than only in the
+        # tensor driver, so the env knob also bounds the host-backend
+        # serial loop (including auto degraded to host by the breaker).
+        with faults.deadline_scope(self.deadline_s), \
+                faults.ambient_deadline():
+            return self._solve_inner(problems)
+
+    def _solve_inner(
         self, problems: Sequence[Sequence[Variable]]
     ) -> List[Union[Solution, NotSatisfiable, Incomplete]]:
         from ..sat.solver import resolve_backend
@@ -148,7 +165,23 @@ class BatchResolver:
             reg = telemetry.default_registry()
             try:
                 with reg.span("facade.host_solve", problems=len(problems)):
-                    for variables in problems:
+                    dl = faults.current_deadline()
+                    for i, variables in enumerate(problems):
+                        # The host loop honors the batch deadline between
+                        # problems: completed batchmates keep their
+                        # answers, the rest degrade to Incomplete — the
+                        # serial mirror of the driver's per-group check
+                        # (one counted event for the whole remainder,
+                        # matching the driver's per-group accounting).
+                        if dl is not None and dl.expired():
+                            remaining = len(problems) - i
+                            faults.note_deadline_exceeded(
+                                "facade.host_solve", remaining)
+                            batch_rep.count_outcome("incomplete",
+                                                    remaining)
+                            out.extend(Incomplete()
+                                       for _ in range(remaining))
+                            break
                         solver = Solver(
                             variables, backend="host",
                             max_steps=self.max_steps,

@@ -120,9 +120,25 @@ def resolve_backend(backend: str, *, batch: bool = True) -> str:
     host engine beats the device on every single-problem workload measured
     (BASELINE.md config 1: 67/s host vs 11/s device on the tunneled TPU).
     The tensor engine's win is batch parallelism; ``auto`` reserves it for
-    batches.  Explicit ``"tpu"`` still forces the device path."""
+    batches.  Explicit ``"tpu"`` still forces the device path.
+
+    An **open accelerator circuit breaker** (ISSUE 2: N consecutive
+    device dispatch failures) also degrades ``auto`` to the host engine
+    — without re-probing — until the breaker's cooldown elapses; the
+    driver's half-open probe dispatch then decides whether device
+    routing resumes.  Explicit ``"tpu"`` still resolves to the tensor
+    *path* here, but it does not override the breaker: while it is open
+    the driver's dispatch-level recovery host-routes every group (loud:
+    ``deppy_fault_host_routed_total``, ``fault`` sink events), and the
+    service refuses explicit-tpu requests outright with 503 +
+    Retry-After.  Exact answers either way; device *timing* is only
+    measurable with the breaker closed."""
     if backend == "auto":
         if not batch:
+            return "host"
+        from .. import faults
+
+        if faults.default_breaker().blocks_device():
             return "host"
         return "tpu" if _engine_usable() else "host"
     if backend in ("host", "tpu"):
@@ -181,7 +197,15 @@ def reprobe_engine() -> bool:
     with _ENGINE_USABLE_LOCK:
         fresh = _probe_verdict()
         _ENGINE_USABLE = fresh
-        return fresh
+    if fresh:
+        # A successful subprocess probe (init + compute + engine import)
+        # is independent evidence the accelerator recovered: close the
+        # circuit breaker so auto routing doesn't stay host-only for a
+        # full cooldown after the worker comes back.
+        from .. import faults
+
+        faults.default_breaker().reset()
+    return fresh
 
 
 def _engine_usable() -> bool:

@@ -32,6 +32,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
+from . import faults
 from . import io as problem_io
 from . import telemetry
 from .sat.errors import DuplicateIdentifier, InternalSolverError
@@ -171,6 +172,10 @@ class Metrics:
                 "# TYPE deppy_leader gauge",
                 f"deppy_leader {int(self.leader)}",
             ]
+        # Fault-domain families (ISSUE 2): breaker state + retry/deadline
+        # counters are pipeline-global (one accelerator, one breaker),
+        # appended here so every scrape sees them.
+        lines += faults.render_metric_lines()
         return "\n".join(lines) + "\n"
 
 
@@ -186,6 +191,8 @@ class Server:
         max_steps: Optional[int] = None,
         max_body_bytes: int = 8 * 1024 * 1024,
         elector=None,
+        request_deadline_s: Optional[float] = None,
+        drain_s: Optional[float] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -193,6 +200,25 @@ class Server:
         self.metrics = Metrics()
         self.ready = threading.Event()
         self._stop = threading.Event()
+        # Fault-domain knobs (ISSUE 2).  request_deadline_s: default
+        # wall-clock budget per /v1/resolve (clients override per request
+        # via the X-Deppy-Deadline-S header; None = unbounded).  drain_s
+        # bounds the graceful-shutdown wait for in-flight requests —
+        # defaulting to the request deadline, since no request should
+        # legitimately outlive one.
+        if request_deadline_s is None:
+            request_deadline_s = faults.env_float(
+                "DEPPY_TPU_REQUEST_DEADLINE_S", None, warn=True)
+        self.request_deadline_s = request_deadline_s
+        if drain_s is None:
+            drain_s = faults.env_float("DEPPY_TPU_DRAIN_S", None, warn=True)
+        if drain_s is None:
+            drain_s = request_deadline_s if request_deadline_s else 10.0
+        self._drain_s = max(float(drain_s), 0.0)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
         # Optional active-passive HA (the reference manager's leader
         # election, main.go:51,62-69): when DEPPY_HA_LEASE names a Lease,
         # only the holder reports ready, so a hot-standby pair exposes
@@ -238,8 +264,36 @@ class Server:
     def probe_port(self) -> int:
         return self._probe.server_address[1]
 
-    def resolve_document(self, doc) -> Tuple[int, dict]:
-        """Resolve one request body; returns (http_status, response_doc)."""
+    def admission_retry_after(self,
+                              deadline_s: Optional[float]) -> Optional[float]:
+        """Degraded-mode gate for one request: seconds the client should
+        wait before retrying, or None to admit.  Two unmeetable cases:
+        the request's deadline is already spent (a proxy-propagated
+        budget of <= 0), or the caller insists on the device backend
+        while the accelerator breaker is open."""
+        breaker = faults.default_breaker()
+        if deadline_s is not None and deadline_s <= 0:
+            faults.note_deadline_exceeded("service.resolve")
+            return max(breaker.remaining_s(), 1.0)
+        if self.backend == "tpu" and breaker.blocks_device():
+            return max(breaker.remaining_s(), 1.0)
+        return None
+
+    def resolve_document(self, doc,
+                         deadline_s: Optional[float] = None) -> Tuple[int, dict]:
+        """Resolve one request body; returns (http_status, response_doc).
+        A 503 response carries ``retry_after_s`` (the handler mirrors it
+        into a ``Retry-After`` header)."""
+        faults.inject("service.resolve")
+        if deadline_s is None:
+            deadline_s = self.request_deadline_s
+        retry_after = self.admission_retry_after(deadline_s)
+        if retry_after is not None:
+            self.metrics.observe_error()
+            return 503, {
+                "error": "degraded: request deadline cannot be met",
+                "retry_after_s": round(retry_after, 3),
+            }
         try:
             problems = problem_io.problems_from_document(doc)
         except problem_io.ProblemFormatError as e:
@@ -248,7 +302,9 @@ class Server:
 
         from .resolution.facade import BatchResolver
 
-        resolver = BatchResolver(backend=self.backend, max_steps=self.max_steps)
+        resolver = BatchResolver(backend=self.backend,
+                                 max_steps=self.max_steps,
+                                 deadline_s=deadline_s)
         t0 = time.perf_counter()
         try:
             results = resolver.solve(problems)
@@ -279,6 +335,23 @@ class Server:
         if not self.ready.is_set():
             return False
         return self.elector is None or self.elector.is_leader
+
+    def degraded(self) -> bool:
+        """True while the accelerator breaker is open: the service still
+        serves (host engine), but /readyz says so and operators should
+        expect host-engine latency."""
+        return faults.default_breaker().blocks_device()
+
+    def _enter_request(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+            self._idle.clear()
+
+    def _exit_request(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._idle.set()
 
     def start(self) -> None:
         """Start both listeners on daemon threads (non-blocking)."""
@@ -321,9 +394,18 @@ class Server:
             threading.Thread(target=_prewarm, daemon=True).start()
         self.ready.set()
 
-    def shutdown(self) -> None:
+    def shutdown(self, drain_s: Optional[float] = None) -> None:
+        """Graceful stop: flip /readyz, wait (bounded by the drain
+        budget — itself derived from the request-deadline machinery) for
+        in-flight /v1/resolve requests to finish, then close the
+        listeners.  A request slower than the drain budget is abandoned
+        — by construction it has also blown its deadline."""
         self.ready.clear()
         self._stop.set()
+        if drain_s is None:
+            drain_s = self._drain_s
+        if drain_s > 0:
+            self._idle.wait(drain_s)
         if self.elector is not None:
             # Release the lease BEFORE closing the listeners: the standby
             # flips to ready on its next tick, shrinking the failover
@@ -371,16 +453,26 @@ def _api_handler(server: Server):
         def log_message(self, fmt, *args):  # keep the library print-free
             pass
 
-        def _send(self, status: int, body: str, ctype: str) -> None:
+        def _send(self, status: int, body: str, ctype: str,
+                  extra_headers: Optional[dict] = None) -> None:
             data = body.encode()
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
         def _send_json(self, status: int, doc: dict) -> None:
-            self._send(status, json.dumps(doc), "application/json")
+            headers = None
+            if status == 503 and "retry_after_s" in doc:
+                # Degraded mode (ISSUE 2): tell well-behaved clients when
+                # the breaker's half-open probe is due.
+                headers = {"Retry-After":
+                           str(max(int(doc["retry_after_s"] + 0.5), 1))}
+            self._send(status, json.dumps(doc), "application/json",
+                       headers)
 
         def do_GET(self):
             if self.path == "/metrics":
@@ -393,6 +485,32 @@ def _api_handler(server: Server):
             if self.path != "/v1/resolve":
                 self._send_json(404, {"error": "not found"})
                 return
+            server._enter_request()
+            try:
+                self._resolve_request()
+            finally:
+                server._exit_request()
+
+        def _resolve_request(self):
+            # Per-request deadline override: seconds of wall-clock budget
+            # the client grants this resolve (proxy chains decrement it).
+            deadline_s = None
+            raw_deadline = self.headers.get("X-Deppy-Deadline-S")
+            if raw_deadline is not None:
+                import math
+
+                try:
+                    deadline_s = float(raw_deadline)
+                except ValueError:
+                    deadline_s = None
+                # NaN would sail past every <= comparison (no 503, no
+                # deadline at all) and inf would silently mean
+                # "unbounded": both violate the header's contract.
+                if deadline_s is None or not math.isfinite(deadline_s):
+                    server.metrics.observe_error()
+                    self._send_json(
+                        400, {"error": "invalid X-Deppy-Deadline-S header"})
+                    return
             try:
                 length = int(self.headers.get("Content-Length", 0))
             except ValueError:
@@ -419,7 +537,8 @@ def _api_handler(server: Server):
                 self._send_json(400, {"error": f"invalid JSON body: {e}"})
                 return
             try:
-                status, resp = server.resolve_document(doc)
+                status, resp = server.resolve_document(doc,
+                                                       deadline_s=deadline_s)
             except Exception as e:  # solver/runtime failure → a real 500,
                 # visible to the caller and the error counter, instead of a
                 # dropped connection from the handler's default traceback.
@@ -439,6 +558,11 @@ def _probe_handler(server: Server):
             if self.path in ("/healthz", "/readyz"):
                 ok = self.path == "/healthz" or server.serving()
                 body = b"ok" if ok else b"not ready"
+                if ok and self.path == "/readyz" and server.degraded():
+                    # Still ready — the host engine serves — but say so:
+                    # operators watching the probe see the degradation
+                    # without waiting for a metrics scrape.
+                    body = b"ok (degraded: accelerator breaker open)"
                 self.send_response(200 if ok else 503)
                 self.send_header("Content-Type", "text/plain")
                 self.send_header("Content-Length", str(len(body)))
@@ -456,15 +580,18 @@ def serve(
     probe_address: str = ":8081",
     backend: str = "auto",
     max_steps: Optional[int] = None,
+    request_deadline_s: Optional[float] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
     stops the shipped Deployment's pods) as well as Ctrl-C: readiness is
-    cleared and both listeners drain via ``shutdown()`` instead of dying
-    mid-request."""
+    cleared, in-flight requests drain (bounded by the request-deadline
+    machinery), and both listeners close via ``shutdown()`` instead of
+    dying mid-request."""
     import signal
 
-    srv = Server(bind_address, probe_address, backend, max_steps)
+    srv = Server(bind_address, probe_address, backend, max_steps,
+                 request_deadline_s=request_deadline_s)
     srv.start()
     stop = threading.Event()
 

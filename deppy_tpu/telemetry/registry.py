@@ -306,6 +306,15 @@ class Registry:
         with self._sink_lock:
             return list(self._recent_spans)
 
+    def event(self, kind: str, **fields) -> None:
+        """Emit one ad-hoc event to the JSONL sink (no-op without a
+        sink).  The fault-domain layer (ISSUE 2) uses this for
+        ``fault`` and ``breaker`` events; ``kind`` becomes the event's
+        ``kind`` field alongside the usual ``ts``."""
+        if self._sink_path is None:
+            return
+        self.emit({"ts": round(time.time(), 3), "kind": kind, **fields})
+
     # --------------------------------------------------------------- sink
 
     def configure_sink(self, path: Optional[str]) -> None:
@@ -351,6 +360,20 @@ class Registry:
             lines: List[str] = []
             for name in self._order:
                 lines.extend(self._families[name]._render())
+            return lines
+
+    def render_families(self, names: Sequence[str]) -> List[str]:
+        """Exposition lines for just the named families, in the given
+        order (absent names skipped) — one consistent snapshot, like
+        :meth:`render_lines`.  Lets another surface (the service's
+        ``/metrics``) mirror a subset of this registry without reaching
+        into family internals."""
+        with self._lock:
+            lines: List[str] = []
+            for name in names:
+                fam = self._families.get(name)
+                if fam is not None:
+                    lines.extend(fam._render())
             return lines
 
     def render(self) -> str:

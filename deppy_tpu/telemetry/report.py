@@ -57,6 +57,11 @@ class SolveReport:
     # Rows whose unsat-core extraction routed to the host spec engine
     # (driver.HOST_CORE_NCONS) — the "silent host fallback" made loud.
     host_fallback_rows: int = 0
+    # Problems the FAULT layer solved on the host engine (device dispatch
+    # failed or the breaker was open; ISSUE 2) — distinct from the
+    # core-extraction routing above, mirroring the
+    # deppy_fault_host_routed_total counter.
+    fault_host_routed: int = 0
     # Wall-clock per pipeline stage, seconds: pad_pack, device_put,
     # solve (whole driver call), plus anything a caller adds.
     wall: Dict[str, float] = field(default_factory=dict)
@@ -115,7 +120,7 @@ class SolveReport:
                            "propagation_rounds", "batch_lanes",
                            "live_lanes", "pad_cells", "live_cells",
                            "n_chunks", "n_buckets", "escalation_stage",
-                           "host_fallback_rows"):
+                           "host_fallback_rows", "fault_host_routed"):
             setattr(rep, field_name, int(d.get(field_name, 0) or 0))
         walls = d.get("wall_s")
         if isinstance(walls, dict):
@@ -141,6 +146,7 @@ class SolveReport:
             "n_buckets": self.n_buckets,
             "escalation_stage": self.escalation_stage,
             "host_fallback_rows": self.host_fallback_rows,
+            "fault_host_routed": self.fault_host_routed,
             "wall_s": {k: round(v, 6) for k, v in self.wall.items()},
         }
 
@@ -161,7 +167,8 @@ class SolveReport:
             f"  padding waste:     {d['pad_waste_ratio']:.3f}"
             f"  ({d['live_cells']}/{d['pad_cells']} clause cells live)",
             f"  escalation stage:  {d['escalation_stage']}",
-            f"  host fallback:     {d['host_fallback_rows']} rows",
+            f"  host fallback:     {d['host_fallback_rows']} rows"
+            f"  (fault-routed problems: {d['fault_host_routed']})",
         ]
         if d["wall_s"]:
             walls = "  ".join(

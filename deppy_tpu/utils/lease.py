@@ -39,9 +39,11 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
@@ -83,10 +85,15 @@ class LeaseConfig:
     ca_path: Optional[str] = None
     lease_seconds: int = 15
     renew_seconds: float = 0.0  # 0 → lease_seconds / 3
+    # Random fraction of the renew interval added to each tick's wait: a
+    # hot-standby pair whose pods started together would otherwise renew
+    # in lockstep and hammer the API server at the same instants forever.
+    renew_jitter: float = 0.2
 
     def __post_init__(self) -> None:
         if self.renew_seconds <= 0:
             self.renew_seconds = max(self.lease_seconds / 3.0, 0.2)
+        self.renew_jitter = min(max(self.renew_jitter, 0.0), 1.0)
 
     @property
     def url(self) -> str:
@@ -272,11 +279,25 @@ class LeaseElector:
                 except Exception:
                     pass  # observer errors must not break election
 
+    def _renew_wait(self, elapsed: float,
+                    rng=random.random) -> float:
+        """Sleep before the next tick: the renew interval plus up to
+        ``renew_jitter`` of it at random (desynchronizing hot-standby
+        pairs), minus the time the tick itself took.  Clamped to a small
+        floor so a tick that overruns its interval (slow/flapping API
+        server) degrades to closely spaced renews instead of a
+        negative-wait hot loop — and the schedule doesn't drift by the
+        tick's own latency."""
+        base = self.config.renew_seconds
+        jitter = base * self.config.renew_jitter * rng()
+        return max(base + jitter - max(elapsed, 0.0), base * 0.05)
+
     def start(self) -> None:
         def _loop():
             while not self._stop.is_set():
+                t0 = time.monotonic()
                 self.tick()
-                self._stop.wait(self.config.renew_seconds)
+                self._stop.wait(self._renew_wait(time.monotonic() - t0))
 
         self._thread = threading.Thread(target=_loop, daemon=True)
         self._thread.start()

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Chaos smoke test (`make chaos-smoke`, ISSUE 2 acceptance scenario).
+
+End-to-end on CPU, against the real service + dispatch pipeline:
+
+  1. **Retry**: arm a fault plan that kills every *first* dispatch
+     attempt (`period: 2, times: 1`); a full batch resolved through
+     ``POST /v1/resolve`` (tensor backend) must still come back correct,
+     with ``deppy_fault_retries`` > 0 and the breaker still closed.
+  2. **Trip + host fallback**: re-arm with an unlimited device fault and
+     a 2-failure breaker; the next resolve must still return correct
+     results (host-engine fallback), the breaker must read open in
+     ``/metrics`` (``deppy_breaker_state 2``) and on ``/readyz``
+     (degraded), and the JSONL telemetry sink must carry the ``fault``
+     and ``breaker`` events.
+
+Fast on purpose: small batch, host-sized problems.  The markered unit
+suite is `make test-chaos`; this is the wired-through-HTTP sibling of
+`make metrics-smoke`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from http.client import HTTPConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DEPPY_TPU_FAULT_BACKOFF_S", "0.001")
+
+BATCH = {"problems": [
+    {"variables": [
+        {"id": f"a{i}", "constraints": [
+            {"type": "mandatory"},
+            {"type": "dependency", "ids": [f"b{i}", f"c{i}"]}]},
+        {"id": f"b{i}"}, {"id": f"c{i}"},
+    ]}
+    for i in range(6)
+]}
+WANT = [["a%d" % i, "b%d" % i] for i in range(6)]
+
+
+def request(port: int, method: str, path: str, body=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=60)
+    headers = {"Content-Type": "application/json"} if body is not None else {}
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def assert_resolves_correctly(port: int) -> None:
+    status, data = request(port, "POST", "/v1/resolve", BATCH)
+    assert status == 200, f"/v1/resolve returned {status}: {data!r}"
+    results = json.loads(data)["results"]
+    got = [r.get("selected") for r in results]
+    assert got == WANT, f"wrong resolutions under faults: {got}"
+
+
+def main() -> int:
+    from deppy_tpu import faults, telemetry
+    from deppy_tpu.service import Server
+
+    sink = tempfile.NamedTemporaryFile(
+        mode="r", suffix=".jsonl", prefix="chaos_smoke_", delete=False)
+    telemetry.configure_sink(sink.name)
+
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="tpu")
+    srv.start()
+    try:
+        # Phase 1: every first dispatch attempt dies; retries recover.
+        faults.set_default_breaker(
+            faults.CircuitBreaker(failure_threshold=50, reset_after_s=600))
+        faults.configure_plan(faults.plan_from_spec(
+            '[{"point": "driver.dispatch", "kind": "error",'
+            ' "period": 2, "times": 1}]'))
+        assert_resolves_correctly(srv.api_port)
+        _, data = request(srv.api_port, "GET", "/metrics")
+        text = data.decode()
+        retries = [l for l in text.splitlines()
+                   if l.startswith("deppy_fault_retries ")]
+        assert retries and int(retries[0].split()[1]) > 0, (
+            f"no retries recorded:\n{text}")
+        assert "deppy_breaker_state 0" in text, "breaker tripped too early"
+
+        # Phase 2: device permanently dead; breaker trips, host serves.
+        faults.set_default_breaker(
+            faults.CircuitBreaker(failure_threshold=2, reset_after_s=600))
+        faults.configure_plan(faults.plan_from_spec(
+            '[{"point": "driver.dispatch", "kind": "error", "times": -1}]'))
+        assert_resolves_correctly(srv.api_port)
+        _, data = request(srv.api_port, "GET", "/metrics")
+        text = data.decode()
+        assert "deppy_breaker_state 2" in text, (
+            f"breaker did not trip:\n{text}")
+        status, body = request(srv.probe_port, "GET", "/readyz")
+        assert status == 200 and b"degraded" in body, (status, body)
+
+        # The sink saw the whole story.
+        kinds = set()
+        with open(sink.name, "r", encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    kinds.add(json.loads(line).get("kind"))
+                except ValueError:
+                    pass
+        assert "fault" in kinds and "breaker" in kinds, (
+            f"sink missing fault/breaker events: {kinds}")
+        print(f"chaos-smoke: PASS ({int(retries[0].split()[1])} retries, "
+              "breaker tripped to host-only, fault+breaker events in "
+              "sink)")
+        return 0
+    finally:
+        faults.configure_plan(None)
+        faults.set_default_breaker(None)
+        srv.shutdown()
+        telemetry.configure_sink(None)
+        try:
+            os.unlink(sink.name)
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

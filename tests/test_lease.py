@@ -268,3 +268,58 @@ def test_readyz_gated_on_leadership(api):
     finally:
         sa.shutdown() if sa._threads else None
         sb.shutdown() if sb._threads else None
+
+
+class TestRenewWaitJitter:
+    """ISSUE 2 satellite: jittered renew interval (hot-standby pairs must
+    not synchronize their API-server writes) and overrun clamping."""
+
+    def _elector(self):
+        return LeaseElector(LeaseConfig(
+            name="r", namespace="ns", identity="a",
+            api_base="http://127.0.0.1:1", lease_seconds=15))
+
+    def test_wait_jittered_within_bounds(self):
+        e = self._elector()
+        base = e.config.renew_seconds
+        lo = e._renew_wait(elapsed=0.0, rng=lambda: 0.0)
+        hi = e._renew_wait(elapsed=0.0, rng=lambda: 1.0)
+        assert lo == pytest.approx(base)
+        assert hi == pytest.approx(base * (1 + e.config.renew_jitter))
+        # Randomized draws stay inside [base, base * (1 + jitter)].
+        for _ in range(50):
+            w = e._renew_wait(elapsed=0.0)
+            assert base <= w <= base * (1 + e.config.renew_jitter) + 1e-9
+
+    def test_tick_latency_subtracted_not_drifting(self):
+        e = self._elector()
+        base = e.config.renew_seconds
+        w = e._renew_wait(elapsed=base / 2, rng=lambda: 0.0)
+        assert w == pytest.approx(base / 2)
+
+    def test_overrunning_tick_clamped_to_floor(self):
+        """A tick slower than the interval (wedged API server) must not
+        produce a negative/zero wait hot loop."""
+        e = self._elector()
+        base = e.config.renew_seconds
+        w = e._renew_wait(elapsed=base * 10, rng=lambda: 1.0)
+        assert w == pytest.approx(base * 0.05)
+        assert w > 0
+
+    def test_jitter_disabled_when_zero(self):
+        e = LeaseElector(LeaseConfig(
+            name="r", namespace="ns", identity="a",
+            api_base="http://127.0.0.1:1", lease_seconds=15,
+            renew_jitter=0.0))
+        assert (e._renew_wait(elapsed=0.0, rng=lambda: 1.0)
+                == pytest.approx(e.config.renew_seconds))
+
+    def test_jitter_config_clamped(self):
+        cfg = LeaseConfig(name="r", namespace="ns", identity="a",
+                          api_base="http://127.0.0.1:1",
+                          renew_jitter=5.0)
+        assert cfg.renew_jitter == 1.0
+        cfg = LeaseConfig(name="r", namespace="ns", identity="a",
+                          api_base="http://127.0.0.1:1",
+                          renew_jitter=-1.0)
+        assert cfg.renew_jitter == 0.0
