@@ -52,6 +52,14 @@ chaos-smoke: ## Inject device faults into the live service: assert retry recover
 test-chaos: ## Fault-domain subsystem tests only (the `chaos` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m chaos
 
+.PHONY: sched-smoke
+sched-smoke: ## Threaded clients against a CPU-backed server: assert request coalescing + cache hits (ISSUE 3 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/sched_smoke.py
+
+.PHONY: test-sched
+test-sched: ## Scheduler/cache subsystem tests only (the `sched` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m sched
+
 ##@ Benchmarks
 
 .PHONY: bench

@@ -149,6 +149,31 @@ def _build_parser() -> argparse.ArgumentParser:
         help="arm the fault-injection harness for the whole service "
         "(inline JSON, @FILE, or a path; also via DEPPY_TPU_FAULT_PLAN)",
     )
+    p_serve.add_argument(
+        "--sched", choices=["on", "off"], default=None,
+        help="cross-request continuous-batching scheduler (default on; "
+        "also via DEPPY_TPU_SCHED).  'off' restores per-request "
+        "dispatch — responses are byte-identical either way",
+    )
+    p_serve.add_argument(
+        "--sched-max-wait-ms", type=float, default=None, metavar="MS",
+        help="scheduler flush policy: max milliseconds a queued problem "
+        "waits for batchmates before dispatching (default 5; also via "
+        "DEPPY_TPU_SCHED_MAX_WAIT_MS) — a lone request keeps low "
+        "latency, a burst coalesces",
+    )
+    p_serve.add_argument(
+        "--sched-max-fill", type=int, default=None, metavar="N",
+        help="scheduler flush policy: dispatch as soon as a size class "
+        "has N problems queued (default 256; also via "
+        "DEPPY_TPU_SCHED_MAX_FILL)",
+    )
+    p_serve.add_argument(
+        "--cache-size", type=int, default=None, metavar="N",
+        help="canonical-form result-cache capacity in entries (default "
+        "1024, 0 disables; also via DEPPY_TPU_CACHE_SIZE) — repeated "
+        "identical problems are answered without a dispatch",
+    )
 
     p_stats = sub.add_parser(
         "stats",
@@ -185,6 +210,10 @@ _CONFIG_KEYS = {
     "backend": ("backend", str),
     "maxSteps": ("max_steps", int),
     "requestDeadlineSeconds": ("request_deadline_s", float),
+    "sched": ("sched", str),
+    "schedMaxWaitMs": ("sched_max_wait_ms", float),
+    "schedMaxFill": ("sched_max_fill", int),
+    "cacheSize": ("cache_size", int),
 }
 
 
@@ -406,6 +435,10 @@ def _cmd_serve(args) -> int:
         "backend": "auto",
         "max_steps": None,
         "request_deadline_s": None,
+        "sched": None,
+        "sched_max_wait_ms": None,
+        "sched_max_fill": None,
+        "cache_size": None,
     }
     try:
         if args.config:
@@ -416,6 +449,10 @@ def _cmd_serve(args) -> int:
             ("backend", args.backend),
             ("max_steps", args.max_steps),
             ("request_deadline_s", args.request_deadline),
+            ("sched", args.sched),
+            ("sched_max_wait_ms", args.sched_max_wait_ms),
+            ("sched_max_fill", args.sched_max_fill),
+            ("cache_size", args.cache_size),
         ):
             if val is not None:
                 kwargs[key] = val

@@ -1512,6 +1512,19 @@ def solve_batch(
     if stats is not None:
         stats["steps"] = int(sum(int(r.steps) for r in results))
         stats["report"] = telemetry.last_report()
+    return decode_results(problems, results)
+
+
+def decode_results(
+    problems: Sequence[Problem], results: Sequence[core.SolveResult]
+) -> List[Union[dict, NotSatisfiable, Incomplete]]:
+    """Decode per-problem :class:`core.SolveResult`\\ s back to the
+    facade vocabulary: a Solution dict (every entity id → selected?),
+    the problem's :class:`NotSatisfiable` core, or an
+    :class:`Incomplete` marker.  Shared by :func:`solve_batch` and the
+    request scheduler (:mod:`deppy_tpu.sched`), which dispatches
+    pre-encoded problems and decodes per lane — the two paths cannot
+    drift."""
     out: List[Union[dict, NotSatisfiable, Incomplete]] = []
     for p, res in zip(problems, results):
         if res.outcome == core.SAT:

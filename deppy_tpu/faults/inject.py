@@ -21,6 +21,10 @@ Fault points wired in this PR:
                               there must fail loud)
   ``checkpoint.save_group``   before a completed group's npz write
   ``service.resolve``         entry of one ``/v1/resolve`` request body
+  ``sched.dispatch``          entry of one coalesced scheduler dispatch
+                              (ISSUE 3; before backend resolution, so an
+                              error here fails every coalesced request
+                              and latency stalls the whole flush)
   ==========================  ================================================
 
 Plan format — an object ``{"faults": [...]}`` or a bare list of rules::

@@ -31,7 +31,7 @@ import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass
-from typing import Callable, Iterator, Optional
+from typing import Callable, Iterator, Optional, Union
 
 
 def env_float(name: str, default: Optional[float],
@@ -143,15 +143,23 @@ def current_deadline() -> Optional[Deadline]:
 
 
 @contextmanager
-def deadline_scope(seconds: Optional[float]) -> Iterator[Optional[Deadline]]:
+def deadline_scope(
+    seconds: Optional[Union[float, Deadline]],
+) -> Iterator[Optional[Deadline]]:
     """Make a batch deadline active for the enclosed solve.  ``None`` is
     a no-op scope.  Nested scopes keep whichever deadline expires first
-    (an inner, looser deadline must not extend the request's)."""
+    (an inner, looser deadline must not extend the request's).
+
+    Accepts either seconds (a fresh :class:`Deadline` starts now) or an
+    existing :class:`Deadline` — the request scheduler captures each
+    request's deadline on its submitting thread and re-installs the SAME
+    clock on the dispatch-loop thread, so coalescing never restarts a
+    request's budget."""
     prev = current_deadline()
     if seconds is None:
         yield prev
         return
-    dl = Deadline(seconds)
+    dl = seconds if isinstance(seconds, Deadline) else Deadline(seconds)
     if prev is not None and prev.remaining() < dl.remaining():
         dl = prev
     _TLS.deadline = dl

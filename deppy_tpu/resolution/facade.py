@@ -103,10 +103,19 @@ class BatchResolver:
         mesh=None,
         checkpoint_dir: Optional[str] = None,
         deadline_s: Optional[float] = None,
+        scheduler=None,
     ):
         self.backend = backend
         self.max_steps = max_steps
         self.mesh = mesh  # jax.sharding.Mesh from deppy_tpu.parallel
+        # Cross-request continuous batching (ISSUE 3): when a
+        # deppy_tpu.sched.Scheduler is given, solve() routes through its
+        # shared queue + result cache instead of dispatching privately —
+        # concurrent resolvers coalesce into shared device dispatches.
+        # The scheduler owns backend routing then (it was built with its
+        # own backend); mesh/checkpoint_dir stay private-dispatch-only
+        # features and are ignored on the scheduled path.
+        self.scheduler = scheduler
         # Wall-clock budget for one solve call (ISSUE 2): problems not
         # dispatched before it expires come back Incomplete instead of
         # the batch aborting; the service threads each request's
@@ -128,6 +137,19 @@ class BatchResolver:
     def solve(
         self, problems: Sequence[Sequence[Variable]]
     ) -> List[Union[Solution, NotSatisfiable, Incomplete]]:
+        if self.scheduler is not None:
+            # Scheduled path: the shared queue coalesces this batch with
+            # concurrent callers' problems and serves cache hits without
+            # dispatching; submit() applies the same deadline scoping
+            # (explicit + ambient) the private path does below.
+            stats: dict = {}
+            try:
+                return self.scheduler.submit(
+                    problems, deadline_s=self.deadline_s,
+                    max_steps=self.max_steps, stats=stats)
+            finally:
+                self.last_steps = stats.get("steps", 0)
+                self.last_report = stats.get("report")
         # ambient_deadline picks up DEPPY_TPU_BATCH_DEADLINE_S when no
         # explicit deadline is active — here rather than only in the
         # tensor driver, so the env knob also bounds the host-backend

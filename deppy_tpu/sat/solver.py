@@ -22,6 +22,7 @@ Usage::
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import List, Optional, Sequence
@@ -110,7 +111,8 @@ class Solver:
             self.report = rep
 
 
-def resolve_backend(backend: str, *, batch: bool = True) -> str:
+def resolve_backend(backend: str, *, batch: bool = True,
+                    block: bool = True) -> str:
     """Resolve a backend name to ``"host"`` or ``"tpu"``: the single place
     the ``auto`` policy lives (shared by :class:`Solver` and the resolution
     facade).  Raises on unknown names.
@@ -132,13 +134,25 @@ def resolve_backend(backend: str, *, batch: bool = True) -> str:
     ``deppy_fault_host_routed_total``, ``fault`` sink events), and the
     service refuses explicit-tpu requests outright with 503 +
     Retry-After.  Exact answers either way; device *timing* is only
-    measurable with the breaker closed."""
+    measurable with the breaker closed.
+
+    ``block=False`` marks a caller that must not stall on the first-use
+    engine probe (the request scheduler's dispatch loop: a 75s probe
+    there would freeze every queued request behind it).  While no
+    verdict exists yet — and the platform isn't pinned to CPU, where the
+    in-process probe is instant — ``auto`` answers ``"host"`` instead of
+    probing; the service's startup pre-warm (or any blocking caller)
+    establishes the verdict and subsequent dispatches route normally."""
     if backend == "auto":
         if not batch:
             return "host"
         from .. import faults
 
         if faults.default_breaker().blocks_device():
+            return "host"
+        if (not block and _ENGINE_USABLE is None
+                and (os.environ.get("JAX_PLATFORMS") or "").strip()
+                != "cpu"):
             return "host"
         return "tpu" if _engine_usable() else "host"
     if backend in ("host", "tpu"):

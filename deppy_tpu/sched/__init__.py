@@ -1,0 +1,40 @@
+"""deppy_tpu.sched — cross-request continuous batching (ISSUE 3).
+
+The paper's headline claim is throughput: thousands of independent
+resolutions sharded across one device mesh.  PR 1 made the waste of the
+per-request dispatch model visible (batch-fill histograms near zero
+under concurrent traffic: every ``/v1/resolve`` paid its own pad/pack +
+``device_put`` + kernel launch); PR 2 made dispatches survivable.  This
+package makes them *shared* — the same move continuous batching makes in
+inference serving:
+
+  * **scheduler** — :class:`Scheduler`: a size-class-aware micro-batch
+    queue (reusing the engine driver's ``partition_buckets`` cost
+    proxies so a giant catalog problem never inflates a burst of tiny
+    ones) with a max-wait / max-fill flush policy, drained by one
+    dispatch-loop thread through the existing fault-domain recovery path
+    (``driver._recovering``: retry → split → host fallback, breaker
+    charging).  Each request's deadline rides along on its lanes; an
+    expired lane degrades to ``Incomplete`` without poisoning its
+    coalesced batchmates, and an open accelerator breaker routes the
+    queue to the host engine instead of rejecting traffic.
+  * **cache** — :class:`ResultCache` + :func:`fingerprint`: problems are
+    fingerprinted after encoding (sorted clause tensor hash + budget);
+    hits bypass the queue entirely, entries are invalidated on budget
+    escalation, and hit/miss/evict counters land in telemetry.
+
+Metric families (registered on the scheduler's registry — the service
+passes its ``/metrics`` registry): ``deppy_sched_queue_depth``,
+``deppy_sched_coalesced_batch_size``, ``deppy_sched_dispatches_total``,
+``deppy_sched_flushes_total``, ``deppy_cache_hit_ratio`` and the
+``deppy_cache_*_total`` counters.  See docs/serving.md.
+"""
+
+from .cache import ResultCache, fingerprint
+from .scheduler import Scheduler
+
+__all__ = [
+    "ResultCache",
+    "Scheduler",
+    "fingerprint",
+]

@@ -1,0 +1,508 @@
+"""Cross-request continuous-batching scheduler (ISSUE 3 tentpole).
+
+One :class:`Scheduler` sits between request producers (the service's
+``/v1/resolve`` handler threads, ``BatchResolver`` callers) and the
+engine driver.  Producers call :meth:`Scheduler.submit` and block; a
+single dispatch-loop thread drains the queue into coalesced device
+dispatches, so concurrent traffic shares one pad/pack + ``device_put`` +
+kernel launch instead of paying one each — continuous batching, applied
+to constraint resolution.
+
+Design points, in the order the issue states them:
+
+  * **Size-class-aware micro-batch queue.**  Each submit becomes one
+    *group* (its problems never split across dispatches, so per-request
+    semantics — escalation staging, report shape — match the
+    unscheduled path).  Groups carry a size class — the power-of-two
+    bucket of their largest :func:`engine.driver._cost_proxy` value, the
+    same cost proxy ``driver.partition_buckets`` splits on — and a flush
+    coalesces only same-class, same-budget groups, so one giant catalog
+    problem never inflates every lane of a burst of tiny ones.
+  * **Max-wait / max-fill flush.**  A flush fires when the oldest
+    group has waited ``max_wait_ms`` (a lone request keeps low latency)
+    or the head's class has ``max_fill`` lanes queued (a burst fills
+    lanes).  Dispatches run through the driver's existing fault-domain
+    recovery (``_recovering``: retry → split → host fallback, breaker
+    charging) — the scheduler adds no new failure semantics.
+  * **Deadlines.**  Each lane carries its request's
+    :class:`faults.Deadline` object (captured on the submitting thread,
+    ambient env deadline included).  Expired lanes degrade to
+    ``Incomplete`` at triage — their coalesced batchmates dispatch
+    unharmed — and the dispatch itself runs under the *loosest* live
+    lane's deadline scope, so no batchmate is cut short by a stranger's
+    tighter budget.
+  * **Result cache.**  Misses queue; hits (see :mod:`.cache`) bypass the
+    queue entirely and cost zero engine steps.
+  * **Admission.**  :meth:`admission_retry_after` converts queue depth
+    beyond ``max_depth`` into the service's 503 + Retry-After machinery;
+    an open accelerator breaker does NOT reject the queue — backend
+    resolution degrades ``auto`` to the host engine and the queue keeps
+    draining (host-only mode).
+
+The dispatch loop resolves the backend with ``block=False``: it must
+never stall every queued request behind a first-use 75s engine probe
+(the service pre-warm owns that probe).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from .. import faults, telemetry
+from ..sat.constraints import Variable
+from ..sat.encode import Problem, encode
+from ..sat.errors import Incomplete, InternalSolverError, NotSatisfiable
+from .cache import MISS, ResultCache, fingerprint
+
+# Knob defaults + env mirrors (CLI flags --sched-max-wait-ms,
+# --sched-max-fill, --cache-size override; see deppy_tpu.cli).
+DEFAULT_MAX_WAIT_MS = 5.0
+DEFAULT_MAX_FILL = 256
+DEFAULT_CACHE_SIZE = 1024
+DEFAULT_MAX_DEPTH = 4096
+
+
+def _env_int(name: str, default: int) -> int:
+    v = faults.env_float(name, float(default), warn=True)
+    return int(v if v is not None else default)
+
+
+class _Lane:
+    """One problem awaiting dispatch, plus its result slot."""
+
+    __slots__ = ("problem", "key", "max_steps", "budget", "deadline",
+                 "result", "steps")
+
+    def __init__(self, problem: Problem, key: str,
+                 max_steps: Optional[int], budget: int, deadline):
+        self.problem = problem
+        self.key = key
+        self.max_steps = max_steps
+        self.budget = budget
+        self.deadline = deadline  # faults.Deadline or None
+        self.result = None
+        self.steps = 0
+
+
+class _Group:
+    """All queued lanes of one submit() call — flushed atomically."""
+
+    __slots__ = ("lanes", "enq_t", "size_class", "budget", "event",
+                 "error", "report")
+
+    def __init__(self, lanes: List[_Lane], size_class: int, budget: int):
+        self.lanes = lanes
+        self.enq_t = time.monotonic()
+        self.size_class = size_class
+        self.budget = budget
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.report = None
+
+
+class Scheduler:
+    """Coalesce concurrent resolve requests into shared dispatches."""
+
+    def __init__(
+        self,
+        backend: str = "auto",
+        max_steps: Optional[int] = None,
+        max_wait_ms: Optional[float] = None,
+        max_fill: Optional[int] = None,
+        cache_size: Optional[int] = None,
+        max_depth: Optional[int] = None,
+        registry: Optional[telemetry.Registry] = None,
+    ):
+        self.backend = backend
+        self.max_steps = max_steps
+        if max_wait_ms is None:
+            max_wait_ms = faults.env_float(
+                "DEPPY_TPU_SCHED_MAX_WAIT_MS", DEFAULT_MAX_WAIT_MS,
+                warn=True)
+        self.max_wait_s = max(float(max_wait_ms), 0.0) / 1000.0
+        if max_fill is None:
+            max_fill = _env_int("DEPPY_TPU_SCHED_MAX_FILL",
+                                DEFAULT_MAX_FILL)
+        self.max_fill = max(int(max_fill), 1)
+        if max_depth is None:
+            max_depth = _env_int("DEPPY_TPU_SCHED_MAX_DEPTH",
+                                 DEFAULT_MAX_DEPTH)
+        self.max_depth = int(max_depth)
+        if cache_size is None:
+            cache_size = _env_int("DEPPY_TPU_CACHE_SIZE",
+                                  DEFAULT_CACHE_SIZE)
+        self._registry = registry if registry is not None \
+            else telemetry.default_registry()
+        self.cache = ResultCache(cache_size, registry=self._registry)
+        reg = self._registry
+        self._g_depth = reg.gauge(
+            "deppy_sched_queue_depth",
+            "Problems queued for a coalesced dispatch right now.")
+        self._g_depth.set(0)
+        self._h_coalesced = reg.histogram(
+            "deppy_sched_coalesced_batch_size",
+            "Problems per coalesced scheduler dispatch.",
+            buckets=telemetry.LANE_BUCKETS)
+        self._c_dispatches = reg.counter(
+            "deppy_sched_dispatches_total",
+            "Coalesced dispatch groups drained from the queue.")
+        self._c_requests = reg.counter(
+            "deppy_sched_coalesced_requests_total",
+            "Requests (submit calls) served per drained dispatch.")
+        self._c_flushes = reg.counter(
+            "deppy_sched_flushes_total",
+            "Queue flushes by trigger (wait = max-wait elapsed, fill = "
+            "lane target reached, drain = shutdown, inline = loop not "
+            "running).", labelname="reason")
+        self._cv = threading.Condition()
+        self._queue: List[_Group] = []
+        self._depth = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # EWMA of dispatch wall clock, seeding the Retry-After estimate.
+        self._dispatch_ewma_s = 0.05
+
+    # -------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Start the dispatch-loop thread (idempotent)."""
+        with self._cv:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._loop, name="deppy-sched", daemon=True)
+            self._thread.start()
+        self._prewarm_backend()
+
+    def _prewarm_backend(self) -> None:
+        """The dispatch loop resolves the backend with ``block=False``
+        (it must never stall the queue behind the 75s engine probe), so
+        ``auto`` answers "host" until SOMETHING establishes the
+        usability verdict.  The service's startup pre-warm owns that on
+        the served path; a standalone Scheduler (library callers) would
+        otherwise route host forever on a device platform — kick one
+        background probe here so auto routing upgrades once it lands."""
+        import os
+
+        if self.backend != "auto":
+            return
+        from ..sat import solver as sat_solver
+
+        if (sat_solver._ENGINE_USABLE is not None
+                or (os.environ.get("JAX_PLATFORMS") or "").strip()
+                == "cpu"):
+            return
+        threading.Thread(target=lambda: sat_solver.resolve_backend("auto"),
+                         name="deppy-sched-prewarm", daemon=True).start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop the loop; queued groups drain (dispatch) first so no
+        submitter is left hanging.  Submits after stop dispatch inline."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+        with self._cv:
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive() and not self._stop
+
+    # ------------------------------------------------------------- admission
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return self._depth
+
+    def admission_retry_after(self) -> Optional[float]:
+        """Seconds a client should back off when the queue is over
+        ``max_depth``, or None to admit — the service mirrors this into
+        its 503 + Retry-After response.  The estimate is the number of
+        flushes needed to drain the backlog times the recent dispatch
+        wall clock (EWMA), floored at 1s."""
+        if self.max_depth <= 0:
+            return None
+        with self._cv:
+            depth = self._depth
+        if depth < self.max_depth:
+            return None
+        flushes = max(depth / float(self.max_fill), 1.0)
+        return max(flushes * self._dispatch_ewma_s, 1.0)
+
+    # ---------------------------------------------------------------- submit
+
+    def submit(
+        self,
+        problem_vars: Sequence[Sequence[Variable]],
+        deadline_s: Optional[float] = None,
+        max_steps: Optional[int] = None,
+        stats: Optional[dict] = None,
+    ) -> List[object]:
+        """Resolve ``problem_vars`` through the shared queue; blocks
+        until every problem has an answer and returns them in input
+        order (Solution dict / NotSatisfiable / Incomplete — the
+        BatchResolver contract).  ``stats`` receives ``{"steps": N,
+        "report": SolveReport-or-None}`` like the driver's entry points.
+
+        Raises what the unscheduled path raises: DuplicateIdentifier
+        from encoding, InternalSolverError for unresolvable references
+        (screened here, per lane, BEFORE anything queues — a malformed
+        request must never abort a coalesced batchmate's dispatch)."""
+        from ..engine.driver import _budget
+
+        if max_steps is None:
+            max_steps = self.max_steps
+        budget = int(_budget(max_steps))
+        problems = [encode(vs) for vs in problem_vars]
+        for p in problems:
+            if p.errors:
+                raise InternalSolverError(p.errors)
+        # Capture the request's effective deadline (explicit scope,
+        # enclosing scope, or ambient env) as an OBJECT: its clock keeps
+        # ticking across the thread hop to the dispatch loop.
+        with faults.deadline_scope(deadline_s), faults.ambient_deadline():
+            dl = faults.current_deadline()
+        results: List[object] = [None] * len(problems)
+        pending: List[tuple] = []
+        for i, p in enumerate(problems):
+            key = fingerprint(p)
+            hit = self.cache.lookup(key, budget)
+            if hit is not MISS:
+                results[i] = hit  # bypasses the queue entirely
+            else:
+                pending.append((i, _Lane(p, key, max_steps, budget, dl)))
+        steps = 0
+        report = None
+        if pending:
+            group = self._make_group([lane for _, lane in pending], budget)
+            self._enqueue(group)
+            group.event.wait()
+            if group.error is not None:
+                raise group.error
+            report = group.report
+            for i, lane in pending:
+                results[i] = lane.result
+                steps += lane.steps
+        if stats is not None:
+            stats["steps"] = steps
+            stats["report"] = report
+        return results
+
+    def _make_group(self, lanes: List[_Lane], budget: int) -> _Group:
+        from ..engine.driver import _bucket, _cost_proxy
+
+        size_class = _bucket(max(_cost_proxy(l.problem) for l in lanes))
+        return _Group(lanes, size_class, budget)
+
+    def _enqueue(self, group: _Group) -> None:
+        with self._cv:
+            if self.running:
+                self._queue.append(group)
+                self._depth += len(group.lanes)
+                self._g_depth.set(self._depth)
+                self._cv.notify_all()
+                return
+        # No loop thread (library use, or post-shutdown stragglers):
+        # dispatch on the caller's thread — same code path, no queue.
+        self._dispatch([group], reason="inline")
+
+    # --------------------------------------------------------- dispatch loop
+
+    def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        finally:
+            # A normal stop drains the queue through dispatches; this
+            # only fires on an unexpected loop crash — fail any still-
+            # queued groups loudly so no submitter waits forever.
+            with self._cv:
+                orphans, self._queue = self._queue, []
+                self._depth = 0
+                self._g_depth.set(0)
+            for g in orphans:
+                if not g.event.is_set():
+                    g.error = RuntimeError(
+                        "scheduler dispatch loop exited unexpectedly")
+                    g.event.set()
+
+    def _loop_inner(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait()
+                if not self._queue:
+                    return  # stopped and drained
+                groups, reason = self._drain_locked(force=self._stop)
+                if not groups:
+                    head_due = self._queue[0].enq_t + self.max_wait_s
+                    delay = head_due - time.monotonic()
+                    self._cv.wait(timeout=max(delay, 0.001))
+                    continue
+            self._dispatch(groups, reason)
+
+    def _drain_locked(self, force: bool = False):
+        """Pick the flushable group set (caller holds the lock): the
+        oldest group plus every queued group in its size class and
+        budget, up to ``max_fill`` lanes.  Returns ([], None) when no
+        flush is due yet."""
+        head = self._queue[0]
+        take = [head]
+        lanes = len(head.lanes)
+        for g in self._queue[1:]:
+            if lanes >= self.max_fill:
+                break
+            if (g.size_class == head.size_class
+                    and g.budget == head.budget
+                    and lanes + len(g.lanes) <= self.max_fill):
+                take.append(g)
+                lanes += len(g.lanes)
+        if force:
+            reason = "drain"
+        elif lanes >= self.max_fill:
+            reason = "fill"
+        elif time.monotonic() - head.enq_t >= self.max_wait_s:
+            reason = "wait"
+        else:
+            return [], None
+        taken = set(map(id, take))
+        self._queue = [g for g in self._queue if id(g) not in taken]
+        self._depth -= lanes
+        self._g_depth.set(self._depth)
+        return take, reason
+
+    def _dispatch(self, groups: List[_Group], reason: str) -> None:
+        lanes = [lane for g in groups for lane in g.lanes]
+        t0 = time.monotonic()
+        report = None
+        # Everything — telemetry included — runs inside the try: the
+        # finally below is the only thing standing between a failure
+        # here and submitters parked forever on their group events.
+        try:
+            self._c_flushes.inc(label=reason)
+            self._c_dispatches.inc()
+            self._c_requests.inc(len(groups))
+            self._h_coalesced.observe(len(lanes))
+            faults.inject("sched.dispatch")
+            report = self._solve_lanes(lanes)
+            for lane in lanes:
+                self._maybe_cache(lane)
+        except BaseException as e:  # noqa: BLE001 — re-raised per request
+            for g in groups:
+                g.error = e
+        finally:
+            dur = time.monotonic() - t0
+            self._dispatch_ewma_s = (0.8 * self._dispatch_ewma_s
+                                     + 0.2 * dur)
+            for g in groups:
+                g.report = report
+                g.event.set()
+
+    def _maybe_cache(self, lane: _Lane) -> None:
+        r = lane.result
+        if isinstance(r, (dict, NotSatisfiable)):
+            self.cache.store(lane.key, lane.budget, r)
+        elif isinstance(r, Incomplete) and lane.deadline is None:
+            # Budget exhaustion is reproducible; deadline degradation
+            # is not — only the former may be cached.
+            self.cache.store(lane.key, lane.budget, r)
+
+    # -------------------------------------------------------------- solving
+
+    def _solve_lanes(self, lanes: List[_Lane]):
+        """Solve one coalesced lane set; fills each lane's result/steps
+        and returns the dispatch's SolveReport."""
+        from ..sat.solver import resolve_backend
+
+        live: List[_Lane] = []
+        for lane in lanes:
+            if lane.deadline is not None and lane.deadline.expired():
+                # Expired at triage: degrade THIS lane only — its
+                # batchmates dispatch unharmed.
+                faults.note_deadline_exceeded("sched.dispatch")
+                lane.result = Incomplete()
+                lane.steps = 0
+            else:
+                live.append(lane)
+        if not live:
+            return None
+        # The dispatch runs under the LOOSEST live deadline (the driver
+        # degrades whole groups past the scope's expiry, and a
+        # stranger's tighter budget must not cut a batchmate short).
+        # Any unbounded lane means an unbounded dispatch.
+        scope = None
+        deadlines = [lane.deadline for lane in live]
+        if all(d is not None for d in deadlines):
+            scope = max(deadlines, key=lambda d: d.remaining())
+        backend = resolve_backend(self.backend, block=False)
+        rep, owns = telemetry.begin_report(backend=backend,
+                                           n_problems=len(live))
+        try:
+            with faults.deadline_scope(scope):
+                if backend == "host":
+                    self._solve_host(live, rep)
+                else:
+                    self._solve_device(live)
+        finally:
+            telemetry.end_report(rep, owns)
+        return rep
+
+    def _solve_device(self, live: List[_Lane]) -> None:
+        from ..engine import driver
+
+        problems = [lane.problem for lane in live]
+        # All live lanes share one normalized budget (the flush policy
+        # only coalesces equal-budget groups).  solve_problems runs
+        # every dispatch group under the fault-domain recovery wrapper
+        # and merges its telemetry into the report begun above.
+        results = driver.solve_problems(problems,
+                                        max_steps=live[0].max_steps)
+        decoded = driver.decode_results(problems, results)
+        for lane, res, dec in zip(live, results, decoded):
+            lane.steps = int(res.steps)
+            lane.result = dec
+
+    def _solve_host(self, live: List[_Lane], rep) -> None:
+        """Serial host-engine drain — the breaker's host-only mode and
+        the explicit host backend.  Mirrors the facade's host loop
+        (per-problem engine, same telemetry folds) but honors each
+        LANE's own deadline between problems: completed lanes keep
+        their answers, expired ones degrade individually."""
+        from ..sat.host import HostEngine
+
+        reg = telemetry.default_registry()
+        with reg.span("sched.host_solve", problems=len(live)):
+            for lane in live:
+                if lane.deadline is not None and lane.deadline.expired():
+                    faults.note_deadline_exceeded("sched.host_solve")
+                    rep.count_outcome("incomplete")
+                    lane.result = Incomplete()
+                    continue
+                eng = HostEngine(lane.problem, max_steps=lane.max_steps)
+                outcome = "incomplete"
+                try:
+                    installed, _ = eng.solve()
+                    solution = {v.identifier: False
+                                for v in lane.problem.variables}
+                    for v in installed:
+                        solution[v.identifier] = True
+                    lane.result = solution
+                    outcome = "sat"
+                except NotSatisfiable as e:
+                    lane.result = e
+                    outcome = "unsat"
+                except Incomplete as e:
+                    lane.result = e
+                finally:
+                    lane.steps = eng.steps
+                    rep.count_outcome(outcome)
+                    rep.steps += eng.steps
+                    rep.decisions += eng.decisions
+                    rep.propagation_rounds += eng.propagation_rounds
+                    rep.backtracks += eng.backtracks

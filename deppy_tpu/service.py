@@ -193,6 +193,10 @@ class Server:
         elector=None,
         request_deadline_s: Optional[float] = None,
         drain_s: Optional[float] = None,
+        sched: Optional[str] = None,
+        sched_max_wait_ms: Optional[float] = None,
+        sched_max_fill: Optional[int] = None,
+        cache_size: Optional[int] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -200,6 +204,25 @@ class Server:
         self.metrics = Metrics()
         self.ready = threading.Event()
         self._stop = threading.Event()
+        # Cross-request continuous batching + result cache (ISSUE 3):
+        # concurrent /v1/resolve requests coalesce into shared device
+        # dispatches through one Scheduler instead of each paying a
+        # private pad/pack + device_put + launch.  Default on;
+        # DEPPY_TPU_SCHED=off (or sched="off") restores the historical
+        # per-request dispatch path — responses are byte-identical
+        # either way.  The scheduler registers its queue/cache metric
+        # families on this server's registry, so they ride /metrics.
+        if sched is None:
+            sched = os.environ.get("DEPPY_TPU_SCHED", "on")
+        self.scheduler = None
+        if str(sched).strip().lower() not in ("off", "0", "false", "no"):
+            from .sched import Scheduler
+
+            self.scheduler = Scheduler(
+                backend=backend, max_steps=max_steps,
+                max_wait_ms=sched_max_wait_ms, max_fill=sched_max_fill,
+                cache_size=cache_size,
+                registry=self.metrics.registry)
         # Fault-domain knobs (ISSUE 2).  request_deadline_s: default
         # wall-clock budget per /v1/resolve (clients override per request
         # via the X-Deppy-Deadline-S header; None = unbounded).  drain_s
@@ -264,19 +287,30 @@ class Server:
     def probe_port(self) -> int:
         return self._probe.server_address[1]
 
-    def admission_retry_after(self,
-                              deadline_s: Optional[float]) -> Optional[float]:
-        """Degraded-mode gate for one request: seconds the client should
-        wait before retrying, or None to admit.  Two unmeetable cases:
-        the request's deadline is already spent (a proxy-propagated
-        budget of <= 0), or the caller insists on the device backend
-        while the accelerator breaker is open."""
+    def admission_retry_after(
+            self, deadline_s: Optional[float]
+    ) -> Optional[Tuple[float, str]]:
+        """Degraded-mode gate for one request: (seconds the client
+        should wait before retrying, error text), or None to admit.
+        Three unmeetable cases: the request's deadline is already spent
+        (a proxy-propagated budget of <= 0), the caller insists on the
+        device backend while the accelerator breaker is open, or the
+        scheduler queue is over its depth limit (ISSUE 3: queue depth
+        feeds the same 503 + Retry-After machinery).  An open breaker
+        alone does NOT shed auto/host traffic — the scheduler's queue
+        drains on the host engine in that mode."""
         breaker = faults.default_breaker()
         if deadline_s is not None and deadline_s <= 0:
             faults.note_deadline_exceeded("service.resolve")
-            return max(breaker.remaining_s(), 1.0)
+            return (max(breaker.remaining_s(), 1.0),
+                    "degraded: request deadline cannot be met")
         if self.backend == "tpu" and breaker.blocks_device():
-            return max(breaker.remaining_s(), 1.0)
+            return (max(breaker.remaining_s(), 1.0),
+                    "degraded: accelerator breaker open")
+        if self.scheduler is not None:
+            retry = self.scheduler.admission_retry_after()
+            if retry is not None:
+                return retry, "overloaded: scheduler queue full"
         return None
 
     def resolve_document(self, doc,
@@ -287,11 +321,12 @@ class Server:
         faults.inject("service.resolve")
         if deadline_s is None:
             deadline_s = self.request_deadline_s
-        retry_after = self.admission_retry_after(deadline_s)
-        if retry_after is not None:
+        gate = self.admission_retry_after(deadline_s)
+        if gate is not None:
+            retry_after, msg = gate
             self.metrics.observe_error()
             return 503, {
-                "error": "degraded: request deadline cannot be met",
+                "error": msg,
                 "retry_after_s": round(retry_after, 3),
             }
         try:
@@ -300,14 +335,26 @@ class Server:
             self.metrics.observe_error()
             return 400, {"error": str(e)}
 
-        from .resolution.facade import BatchResolver
-
-        resolver = BatchResolver(backend=self.backend,
-                                 max_steps=self.max_steps,
-                                 deadline_s=deadline_s)
         t0 = time.perf_counter()
         try:
-            results = resolver.solve(problems)
+            if self.scheduler is not None:
+                # Scheduled path (ISSUE 3): this request's problems join
+                # the shared queue (coalescing with concurrent requests)
+                # or are served straight from the result cache.
+                stats: dict = {}
+                results = self.scheduler.submit(
+                    problems, deadline_s=deadline_s, stats=stats)
+                steps = stats.get("steps", 0)
+                report = stats.get("report")
+            else:
+                from .resolution.facade import BatchResolver
+
+                resolver = BatchResolver(backend=self.backend,
+                                         max_steps=self.max_steps,
+                                         deadline_s=deadline_s)
+                results = resolver.solve(problems)
+                steps = resolver.last_steps
+                report = resolver.last_report
         except (DuplicateIdentifier, InternalSolverError) as e:
             self.metrics.observe_error()
             return 400, {"error": str(e)}
@@ -319,8 +366,7 @@ class Server:
             outcomes[r["status"]] += 1
             rendered.append(r)
         self.metrics.observe_batch(outcomes, time.perf_counter() - t0,
-                                   steps=resolver.last_steps,
-                                   report=resolver.last_report)
+                                   steps=steps, report=report)
         return 200, {"results": rendered}
 
     def _on_leader_change(self, leading: bool) -> None:
@@ -355,6 +401,8 @@ class Server:
 
     def start(self) -> None:
         """Start both listeners on daemon threads (non-blocking)."""
+        if self.scheduler is not None:
+            self.scheduler.start()
         if self.elector is not None:
             self.elector.start()
         for srv in (self._api, self._probe):
@@ -406,6 +454,13 @@ class Server:
             drain_s = self._drain_s
         if drain_s > 0:
             self._idle.wait(drain_s)
+        if self.scheduler is not None:
+            # After the drain: in-flight requests are parked on their
+            # queue groups, and stopping first would orphan them.  A
+            # request that outlived the drain budget dispatches inline
+            # on its own handler thread instead (the scheduler's
+            # fallback), so nothing hangs.
+            self.scheduler.stop()
         if self.elector is not None:
             # Release the lease BEFORE closing the listeners: the standby
             # flips to ready on its next tick, shrinking the failover
@@ -581,6 +636,10 @@ def serve(
     backend: str = "auto",
     max_steps: Optional[int] = None,
     request_deadline_s: Optional[float] = None,
+    sched: Optional[str] = None,
+    sched_max_wait_ms: Optional[float] = None,
+    sched_max_fill: Optional[int] = None,
+    cache_size: Optional[int] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -591,7 +650,9 @@ def serve(
     import signal
 
     srv = Server(bind_address, probe_address, backend, max_steps,
-                 request_deadline_s=request_deadline_s)
+                 request_deadline_s=request_deadline_s, sched=sched,
+                 sched_max_wait_ms=sched_max_wait_ms,
+                 sched_max_fill=sched_max_fill, cache_size=cache_size)
     srv.start()
     stop = threading.Event()
 

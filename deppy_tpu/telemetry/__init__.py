@@ -9,6 +9,7 @@ name table and the JSONL event schema.
 """
 
 from .registry import (
+    LANE_BUCKETS,
     RATIO_BUCKETS,
     SECONDS_BUCKETS,
     STAGE_BUCKETS,
@@ -36,6 +37,7 @@ __all__ = [
     "Registry",
     "Span",
     "SolveReport",
+    "LANE_BUCKETS",
     "RATIO_BUCKETS",
     "SECONDS_BUCKETS",
     "STAGE_BUCKETS",

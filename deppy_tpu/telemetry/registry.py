@@ -45,6 +45,10 @@ SECONDS_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 RATIO_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 # Escalation stages: 0 = single-stage, 1 = stage-1 sufficed, 2 = stage-2.
 STAGE_BUCKETS = (0.0, 1.0, 2.0, 3.0)
+# Lane-count buckets (coalesced batch sizes, queue drains): powers of two
+# up to the widest probed dispatch width (scripts/lane_probe.py).
+LANE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                512.0, 1024.0, 2048.0, 4096.0)
 
 
 def _fmt(v) -> str:

@@ -33,16 +33,25 @@ sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("DEPPY_TPU_FAULT_BACKOFF_S", "0.001")
 
-BATCH = {"problems": [
-    {"variables": [
-        {"id": f"a{i}", "constraints": [
-            {"type": "mandatory"},
-            {"type": "dependency", "ids": [f"b{i}", f"c{i}"]}]},
-        {"id": f"b{i}"}, {"id": f"c{i}"},
+# Distinct ids PER PHASE: the request scheduler's canonical-form result
+# cache (ISSUE 3) would otherwise serve phase 2 from phase 1's answers
+# without touching the device — correct, but this smoke exists to drive
+# the fault path, so each phase must present fresh problems.
+def batch(tag: str) -> dict:
+    return {"problems": [
+        {"variables": [
+            {"id": f"a{tag}{i}", "constraints": [
+                {"type": "mandatory"},
+                {"type": "dependency", "ids": [f"b{tag}{i}",
+                                               f"c{tag}{i}"]}]},
+            {"id": f"b{tag}{i}"}, {"id": f"c{tag}{i}"},
+        ]}
+        for i in range(6)
     ]}
-    for i in range(6)
-]}
-WANT = [["a%d" % i, "b%d" % i] for i in range(6)]
+
+
+def want(tag: str) -> list:
+    return [[f"a{tag}{i}", f"b{tag}{i}"] for i in range(6)]
 
 
 def request(port: int, method: str, path: str, body=None):
@@ -57,12 +66,12 @@ def request(port: int, method: str, path: str, body=None):
     return resp.status, data
 
 
-def assert_resolves_correctly(port: int) -> None:
-    status, data = request(port, "POST", "/v1/resolve", BATCH)
+def assert_resolves_correctly(port: int, tag: str) -> None:
+    status, data = request(port, "POST", "/v1/resolve", batch(tag))
     assert status == 200, f"/v1/resolve returned {status}: {data!r}"
     results = json.loads(data)["results"]
     got = [r.get("selected") for r in results]
-    assert got == WANT, f"wrong resolutions under faults: {got}"
+    assert got == want(tag), f"wrong resolutions under faults: {got}"
 
 
 def main() -> int:
@@ -83,7 +92,7 @@ def main() -> int:
         faults.configure_plan(faults.plan_from_spec(
             '[{"point": "driver.dispatch", "kind": "error",'
             ' "period": 2, "times": 1}]'))
-        assert_resolves_correctly(srv.api_port)
+        assert_resolves_correctly(srv.api_port, "p1")
         _, data = request(srv.api_port, "GET", "/metrics")
         text = data.decode()
         retries = [l for l in text.splitlines()
@@ -97,7 +106,7 @@ def main() -> int:
             faults.CircuitBreaker(failure_threshold=2, reset_after_s=600))
         faults.configure_plan(faults.plan_from_spec(
             '[{"point": "driver.dispatch", "kind": "error", "times": -1}]'))
-        assert_resolves_correctly(srv.api_port)
+        assert_resolves_correctly(srv.api_port, "p2")
         _, data = request(srv.api_port, "GET", "/metrics")
         text = data.decode()
         assert "deppy_breaker_state 2" in text, (

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Scheduler smoke test (`make sched-smoke`, ISSUE 3 satellite).
+
+Boots the batch-resolution service on an ephemeral port with a generous
+coalescing window, fires N concurrent ``/v1/resolve`` clients from
+threads, and asserts the ISSUE 3 acceptance surface end to end:
+
+  * coalescing — fewer scheduler dispatches than requests, observed on
+    the ``/metrics`` scrape (``deppy_sched_dispatches_total``);
+  * correctness — every response carries its own problem's solution;
+  * cache — repeating the full client wave is served from the
+    canonical-form result cache without a single new dispatch
+    (``deppy_cache_hits_total``, ``deppy_cache_hit_ratio``).
+
+Fast on purpose: host backend, no device compile — the full subsystem
+suite is ``make test-sched`` (tests/test_sched.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from http.client import HTTPConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_CLIENTS = 12
+
+
+def request(port: int, method: str, path: str, body=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    headers = {"Content-Type": "application/json"} if body is not None else {}
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def metric(text: str, name: str):
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def wave(port: int, docs):
+    out = [None] * len(docs)
+
+    def go(i):
+        out[i] = request(port, "POST", "/v1/resolve", docs[i])
+
+    threads = [threading.Thread(target=go, args=(i,))
+               for i in range(len(docs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    return out
+
+
+def main() -> int:
+    from deppy_tpu.service import Server
+
+    docs = [
+        {"variables": [
+            {"id": f"app{i}", "constraints": [
+                {"type": "mandatory"},
+                {"type": "dependency", "ids": [f"lib{i}", "shared"]}]},
+            {"id": f"lib{i}"}, {"id": "shared"},
+        ]}
+        for i in range(N_CLIENTS)
+    ]
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host", sched_max_wait_ms=300.0)
+    srv.start()
+    try:
+        first = wave(srv.api_port, docs)
+        for i, (status, data) in enumerate(first):
+            assert status == 200, f"client {i}: {status} {data!r}"
+            r = json.loads(data)["results"][0]
+            assert r["status"] == "sat" and f"app{i}" in r["selected"], r
+        _, data = request(srv.api_port, "GET", "/metrics")
+        text = data.decode()
+        dispatches = metric(text, "deppy_sched_dispatches_total")
+        assert dispatches is not None and dispatches < N_CLIENTS, (
+            f"no coalescing: {dispatches} dispatches for "
+            f"{N_CLIENTS} concurrent requests\n{text}")
+
+        second = wave(srv.api_port, docs)
+        assert [r[1] for r in second] == [r[1] for r in first], (
+            "cached responses are not byte-identical")
+        _, data = request(srv.api_port, "GET", "/metrics")
+        text = data.decode()
+        assert metric(text, "deppy_sched_dispatches_total") == dispatches, (
+            "repeat wave paid new dispatches instead of cache hits")
+        hits = metric(text, "deppy_cache_hits_total")
+        ratio = metric(text, "deppy_cache_hit_ratio")
+        assert hits == N_CLIENTS, f"expected {N_CLIENTS} hits, got {hits}"
+        assert ratio and ratio > 0, text
+        print(f"sched-smoke: PASS ({N_CLIENTS} concurrent requests → "
+              f"{int(dispatches)} coalesced dispatch(es); repeat wave "
+              f"{int(hits)} cache hits, hit ratio {ratio})")
+        return 0
+    finally:
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

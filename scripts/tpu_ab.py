@@ -96,6 +96,12 @@ def main() -> None:
     # fused-failure guard probe: back-to-back probes burn ~2 min of a
     # heal window that tends to die minutes in.
     for name, knobs, tpu_only in VARIANTS:
+        # Consume the freshness flag at the top of EVERY iteration: it
+        # only excuses the variant immediately after the guard probe.
+        # Without this, a skip chain (skip-fused / tpu-only continues)
+        # after a fused failure would carry the stale flag forward and
+        # run a later variant without a fresh health probe.
+        probe_fresh, just_probed = just_probed, False
         if a.skip_fused and knobs.get("DEPPY_TPU_SEARCH") == "fused":
             emit({"variant": name,
                   "skipped": "mosaic compile-smoke failed this substrate"},
@@ -107,12 +113,11 @@ def main() -> None:
                   "pallas measures nothing and can blow the timeout)"},
                  a.log)
             continue
-        if not just_probed and not healthy():
+        if not probe_fresh and not healthy():
             # Nonzero so callers that read rc (the revalidation ladder's
             # stage F runs with require_stage_line=False, where ok is
             # rc==0) see an aborted A/B as a failure, not a green stage.
             sys.exit(1)
-        just_probed = False
         env = dict(os.environ)
         for k in KNOB_VARS:
             # A leftover exported knob would contaminate every variant

@@ -76,38 +76,50 @@ def _write_measured_default(backend: str, stage: str, updates: dict,
     """Merge measured-default ``updates`` for ``backend`` into the
     package-local registry (DEPPY_TPU_MEASURED_DEFAULTS overrides the
     path); other backends' rows and this backend's other keys
-    survive."""
+    survive.  The whole read-merge-write runs under an ``flock`` on a
+    sibling lock file: concurrent ladder instances (e.g. a CPU smoke
+    ladder racing a device ladder, or two heal windows overlapping)
+    would otherwise read the same base state and the second replace
+    would drop the first's rows."""
+    import fcntl
+
     path = os.environ.get(
         "DEPPY_TPU_MEASURED_DEFAULTS",
         os.path.join(ROOT, "deppy_tpu", "engine", "measured_defaults.json"))
-    try:
-        with open(path) as f:
-            data = json.load(f)
-        if not isinstance(data, dict):
-            data = {}
-    except (OSError, ValueError):
-        data = {}
-    entry = data.get(backend)
-    if not isinstance(entry, dict):
-        entry = {}
-    entry.update(updates)
-    ev = entry.get("evidence")
-    if not isinstance(ev, dict):
-        ev = {}
-    # Evidence is nested PER KEY: a later run that measures only one
-    # key must not re-stamp provenance (ts / ladder_log) on rows it
-    # never measured.
-    stamp = {**evidence, "ts": round(time.time(), 1),
-             "ladder_log": os.path.abspath(log_path) if log_path else ""}
-    for key in updates:
-        ev[key] = dict(stamp)
-    entry["evidence"] = ev
-    data[backend] = entry
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(data, f, indent=2, sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, path)
+    with open(path + ".lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                if not isinstance(data, dict):
+                    data = {}
+            except (OSError, ValueError):
+                data = {}
+            entry = data.get(backend)
+            if not isinstance(entry, dict):
+                entry = {}
+            entry.update(updates)
+            ev = entry.get("evidence")
+            if not isinstance(ev, dict):
+                ev = {}
+            # Evidence is nested PER KEY: a later run that measures only
+            # one key must not re-stamp provenance (ts / ladder_log) on
+            # rows it never measured.
+            stamp = {**evidence, "ts": round(time.time(), 1),
+                     "ladder_log":
+                     os.path.abspath(log_path) if log_path else ""}
+            for key in updates:
+                ev[key] = dict(stamp)
+            entry["evidence"] = ev
+            data[backend] = entry
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=2, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
     _emit_line({"stage": stage, "backend": backend, **updates,
                 "path": path}, log_path)
 
@@ -166,7 +178,10 @@ def _fused_beat_baseline(log_path: str, from_line: int = 0):
                 and isinstance(rec.get("rate"), (int, float))):
             rates[rec["variant"]] = float(rec["rate"])
     base, fused = rates.get("baseline"), rates.get("search-fused")
-    if base and fused and fused > base:
+    # Explicit None checks: a measured 0.0 rate is a real (terrible)
+    # measurement, not a missing one — truthiness would silently treat
+    # a zero-rate baseline as "never ran" and suppress the F2 capture.
+    if base is not None and fused is not None and fused > base:
         return base, fused
     return None
 

@@ -22,6 +22,12 @@ import pytest
 pytest.importorskip("jax")
 
 import jax  # noqa: E402
+
+# ``jax.export`` is a lazily-deprecated attribute path on some jax
+# builds: accessing it without this explicit import raises
+# AttributeError and every lowering test dies on the wrong error.
+pytest.importorskip("jax.export")
+
 import jax.numpy as jnp  # noqa: E402
 
 from deppy_tpu.engine import core, driver, pallas_search  # noqa: E402
@@ -104,6 +110,38 @@ def test_core_fused_lowers_for_tpu(n, length):
         lambda p, s, e: pallas_search._batched_core_fused(
             p, jnp.int32(1 << 20), s, e, V=d.V, NCON=d.NCON, NV=d.NV),
         pts, steps, en)
+
+
+def test_smem_scalars_lower_at_widest_probed_lane_width():
+    """B=4096 — the widest lane width ``scripts/lane_probe.py`` probes.
+
+    The fused kernels map whole per-problem ``(B, 1)`` scalar columns
+    into SMEM (``pallas_search._smem_scalars``), so their SMEM footprint
+    grows linearly with B; a kernel change that adds scalar columns can
+    silently blow SMEM capacity only at wide B.  This pins the widest
+    probed width so that growth fails in CI, not on the scarce heal
+    window.  Base (tiny-B) lowering legality is pinned by
+    ``test_search_fused_lowers_for_tpu``; when even that cannot lower on
+    the running jax build, B-growth is unmeasurable here and the case
+    skips rather than double-reporting the base failure."""
+    problems = _problems(2, 8)
+
+    def batch_at(B):
+        d = driver._Dims(problems, B)
+        assert d.B == B
+        pts = driver.pad_stack(problems, d, d.B, pack=True)
+        pts = core.ProblemTensors(*[jnp.asarray(x) for x in pts])
+        en = jnp.asarray(np.arange(d.B) < len(problems))
+        return pts, en
+
+    def fn(p, e):
+        return pallas_search._batched_search_fused(p, jnp.int32(1 << 20), e)
+
+    try:
+        _export_tpu(fn, *batch_at(8))
+    except Exception as e:  # pre-existing base failure, not SMEM growth
+        pytest.skip(f"fused search does not lower at tiny B here: {e}")
+    _export_tpu(fn, *batch_at(4096))
 
 
 def test_blockwise_lowers_for_tpu():

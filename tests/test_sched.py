@@ -1,0 +1,543 @@
+"""Cross-request continuous-batching scheduler + result cache (ISSUE 3).
+
+The acceptance contract, verbatim from the issue:
+
+  * N concurrent ``/v1/resolve`` requests drive the scheduler and (a)
+    fewer dispatch groups than requests are observed via telemetry
+    (coalescing), (b) responses are byte-identical to the unscheduled
+    path, (c) a repeated identical request is served from the cache
+    without a new dispatch;
+  * deadline and breaker behavior survive the scheduler: an
+    expired-deadline lane degrades to Incomplete without poisoning its
+    coalesced batchmates, and a tripped breaker drains the queue on the
+    host engine (exercised via the PR 2 fault-injection harness).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.client import HTTPConnection
+
+import pytest
+
+from deppy_tpu import faults, telemetry
+from deppy_tpu.sat.encode import encode
+from deppy_tpu.sat.errors import Incomplete, NotSatisfiable
+from deppy_tpu.sched import ResultCache, Scheduler, fingerprint
+from deppy_tpu.sched.cache import MISS
+from deppy_tpu.service import Server
+
+pytestmark = pytest.mark.sched
+
+
+@pytest.fixture(autouse=True)
+def fresh_fault_state():
+    """Isolate the process-global breaker, fault plan, and telemetry
+    registry per test (same contract as the chaos suite)."""
+    prev_breaker = faults.set_default_breaker(faults.CircuitBreaker())
+    prev_plan = faults.configure_plan(None)
+    prev_reg = telemetry.set_default_registry(telemetry.Registry())
+    yield
+    telemetry.set_default_registry(prev_reg)
+    faults.configure_plan(prev_plan)
+    faults.set_default_breaker(prev_breaker)
+
+
+def request(port, method, path, body=None, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    h = dict(headers or {})
+    if body is not None:
+        h["Content-Type"] = "application/json"
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=h)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _doc(i, dep=("b", "c")):
+    return {"variables": [
+        {"id": f"a{i}", "constraints": [
+            {"type": "mandatory"},
+            {"type": "dependency", "ids": list(dep)}]},
+        {"id": dep[0]}, {"id": dep[1]},
+    ]}
+
+
+def _problem(ident="a"):
+    from deppy_tpu import io as problem_io
+
+    return problem_io.problems_from_document(
+        {"variables": [{"id": ident,
+                        "constraints": [{"type": "mandatory"}]}]})[0]
+
+
+def _metric(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+# ----------------------------------------------------- acceptance: coalesce
+
+
+class TestCoalescing:
+    def test_concurrent_requests_coalesce_and_match_unscheduled(self):
+        """(a) fewer dispatch groups than requests, (b) byte-identical
+        responses to the unscheduled path, (c) a repeat is a cache hit
+        with no new dispatch."""
+        n = 8
+        # Generous max-wait so all N concurrent requests are queued
+        # before the first flush — the coalescing assertion must be
+        # deterministic, not a race.
+        sched_srv = Server(bind_address="127.0.0.1:0",
+                           probe_address="127.0.0.1:0", backend="host",
+                           sched_max_wait_ms=300.0)
+        plain_srv = Server(bind_address="127.0.0.1:0",
+                           probe_address="127.0.0.1:0", backend="host",
+                           sched="off")
+        sched_srv.start()
+        plain_srv.start()
+        try:
+            assert plain_srv.scheduler is None
+            docs = [_doc(i) for i in range(n)]
+            scheduled = [None] * n
+
+            def go(i):
+                scheduled[i] = request(sched_srv.api_port, "POST",
+                                       "/v1/resolve", docs[i])
+
+            threads = [threading.Thread(target=go, args=(i,))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30)
+            plain = [request(plain_srv.api_port, "POST", "/v1/resolve", d)
+                     for d in docs]
+            assert [s[0] for s in scheduled] == [200] * n
+            # (b) byte-identical bodies.
+            assert [s[1] for s in scheduled] == [p[1] for p in plain]
+            _, data = request(sched_srv.api_port, "GET", "/metrics")
+            text = data.decode()
+            dispatches = _metric(text, "deppy_sched_dispatches_total")
+            # (a) coalescing observed via telemetry.
+            assert dispatches is not None and dispatches < n
+            assert _metric(text, "deppy_sched_coalesced_batch_size_count") >= 1
+            assert _metric(text, "deppy_cache_misses_total") == n
+
+            # (c) repeat of an already-solved problem: served from the
+            # cache, dispatch counter unchanged.
+            status, body = request(sched_srv.api_port, "POST",
+                                   "/v1/resolve", docs[0])
+            assert status == 200
+            assert body == scheduled[0][1]  # byte-identical again
+            _, data = request(sched_srv.api_port, "GET", "/metrics")
+            text = data.decode()
+            assert _metric(text, "deppy_sched_dispatches_total") == dispatches
+            assert _metric(text, "deppy_cache_hits_total") == 1
+            assert _metric(text, "deppy_cache_hit_ratio") > 0
+        finally:
+            sched_srv.shutdown()
+            plain_srv.shutdown()
+
+    def test_unsat_and_incomplete_byte_identical(self):
+        """The non-sat renderings survive the scheduled path byte for
+        byte too (unsat cores, budget-exhausted incompletes)."""
+        unsat = {"variables": [{"id": "u", "constraints": [
+            {"type": "mandatory"}, {"type": "prohibited"}]}]}
+        hard = {"variables": [
+            {"id": "x", "constraints": [
+                {"type": "mandatory"},
+                {"type": "dependency", "ids": ["y", "z"]}]},
+            {"id": "y", "constraints": [{"type": "dependency",
+                                         "ids": ["w"]}]},
+            {"id": "z"},
+            {"id": "w", "constraints": [{"type": "conflict", "id": "z"}]},
+        ]}
+        sched_srv = Server(bind_address="127.0.0.1:0",
+                           probe_address="127.0.0.1:0", backend="host",
+                           max_steps=3)
+        plain_srv = Server(bind_address="127.0.0.1:0",
+                           probe_address="127.0.0.1:0", backend="host",
+                           max_steps=3, sched="off")
+        sched_srv.start()
+        plain_srv.start()
+        try:
+            for doc in (unsat, hard, {"problems": [unsat, hard]}):
+                s = request(sched_srv.api_port, "POST", "/v1/resolve", doc)
+                p = request(plain_srv.api_port, "POST", "/v1/resolve", doc)
+                assert s == p
+            assert json.loads(
+                request(sched_srv.api_port, "POST", "/v1/resolve",
+                        unsat)[1])["results"][0]["status"] == "unsat"
+        finally:
+            sched_srv.shutdown()
+            plain_srv.shutdown()
+
+    def test_malformed_and_unknown_reference_match_unscheduled(self):
+        bad_ref = {"variables": [{"id": "a", "constraints": [
+            {"type": "mandatory"},
+            {"type": "dependency", "ids": ["ghost"]}]}]}
+        dup = {"variables": [{"id": "a"}, {"id": "a"}]}
+        sched_srv = Server(bind_address="127.0.0.1:0",
+                           probe_address="127.0.0.1:0", backend="host")
+        plain_srv = Server(bind_address="127.0.0.1:0",
+                           probe_address="127.0.0.1:0", backend="host",
+                           sched="off")
+        sched_srv.start()
+        plain_srv.start()
+        try:
+            for doc in (bad_ref, dup):
+                s = request(sched_srv.api_port, "POST", "/v1/resolve", doc)
+                p = request(plain_srv.api_port, "POST", "/v1/resolve", doc)
+                assert s == p
+                assert s[0] == 400
+        finally:
+            sched_srv.shutdown()
+            plain_srv.shutdown()
+
+    def test_tpu_backend_through_scheduler(self):
+        """The device path coalesces too: the whole dispatch runs
+        through driver.solve_problems (and its recovery wrapper)."""
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="tpu",
+                     sched_max_wait_ms=200.0)
+        srv.start()
+        try:
+            n = 4
+            results = [None] * n
+
+            def go(i):
+                results[i] = request(srv.api_port, "POST", "/v1/resolve",
+                                     _doc(i))
+
+            threads = [threading.Thread(target=go, args=(i,))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            assert [r[0] for r in results] == [200] * n
+            for i, r in enumerate(results):
+                assert json.loads(r[1])["results"][0]["selected"] == \
+                    [f"a{i}", "b"]
+            _, data = request(srv.api_port, "GET", "/metrics")
+            assert _metric(data.decode(),
+                           "deppy_sched_dispatches_total") < n
+        finally:
+            srv.shutdown()
+
+
+# ------------------------------------------------- deadlines and the breaker
+
+
+class TestFaultDomainSurvival:
+    def test_expired_lane_degrades_without_poisoning_batchmates(self):
+        """One lane whose deadline expires while queued comes back
+        Incomplete; its coalesced batchmate still resolves sat."""
+        sched = Scheduler(backend="host", max_wait_ms=250.0,
+                          cache_size=0)
+        sched.start()
+        try:
+            out = {}
+
+            def submit(tag, deadline):
+                out[tag] = sched.submit([_problem(tag)],
+                                        deadline_s=deadline)[0]
+
+            t1 = threading.Thread(target=submit, args=("dead", 0.02))
+            t2 = threading.Thread(target=submit, args=("live", None))
+            t1.start()
+            t2.start()
+            t1.join(30)
+            t2.join(30)
+            assert isinstance(out["dead"], Incomplete)
+            assert out["live"] == {"live": True}
+            snap = telemetry.default_registry().snapshot()
+            assert snap.get("deppy_deadline_exceeded", 0) >= 1
+        finally:
+            sched.stop()
+
+    def test_tight_stranger_deadline_does_not_cut_batchmates(self):
+        """The coalesced dispatch runs under the LOOSEST live deadline:
+        a batchmate with a generous budget is never degraded by a
+        stranger's tight one."""
+        sched = Scheduler(backend="host", max_wait_ms=150.0,
+                          cache_size=0)
+        sched.start()
+        try:
+            out = {}
+
+            def submit(tag, deadline):
+                out[tag] = sched.submit([_problem(tag)],
+                                        deadline_s=deadline)[0]
+
+            threads = [
+                threading.Thread(target=submit, args=("tight", 30.0)),
+                threading.Thread(target=submit, args=("loose", 300.0)),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30)
+            assert out["tight"] == {"tight": True}
+            assert out["loose"] == {"loose": True}
+        finally:
+            sched.stop()
+
+    def test_open_breaker_drains_queue_on_host_engine(self, monkeypatch):
+        """A tripped breaker routes the queue to the host engine instead
+        of rejecting: every device dispatch is scripted to fail (PR 2
+        injection harness), the breaker trips, and queued requests keep
+        resolving — on the host — while it is open."""
+        from deppy_tpu.sat import solver as sat_solver
+
+        monkeypatch.setenv("DEPPY_TPU_FAULT_BACKOFF_S", "0.001")
+        # Pretend the engine probe said "device usable" so auto would
+        # pick the tensor path if the breaker allowed it.
+        monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", True)
+        breaker = faults.CircuitBreaker(failure_threshold=1,
+                                        reset_after_s=60.0)
+        faults.set_default_breaker(breaker)
+        faults.configure_plan(faults.plan_from_spec(
+            '[{"point": "driver.dispatch", "kind": "error",'
+            ' "times": -1}]'))
+        sched = Scheduler(backend="auto", max_wait_ms=0.0, cache_size=0)
+        sched.start()
+        try:
+            # First submit: device dispatch fails (injected), recovery
+            # falls back to the host engine, breaker records failures.
+            r1 = sched.submit([_problem("p1")])[0]
+            assert r1 == {"p1": True}
+            assert breaker.blocks_device()
+            # Breaker now open: the queue drains host-side without even
+            # attempting the device (no retry burn per group).
+            reg = telemetry.default_registry()
+            failures_before = reg.snapshot().get(
+                "deppy_fault_failures_total", 0)
+            r2 = sched.submit([_problem("p2")])[0]
+            assert r2 == {"p2": True}
+            assert reg.snapshot().get(
+                "deppy_fault_failures_total", 0) == failures_before
+            assert breaker.blocks_device()  # still open, still serving
+        finally:
+            sched.stop()
+            monkeypatch.setattr(sat_solver, "_ENGINE_USABLE", None)
+
+    def test_injected_sched_dispatch_fault_fails_coalesced_requests(self):
+        """The scheduler's own fault point: an error at sched.dispatch
+        propagates to every coalesced submitter (the service renders it
+        as a 500, like any unexpected resolver failure)."""
+        faults.configure_plan(faults.plan_from_spec(
+            '[{"point": "sched.dispatch", "kind": "error",'
+            ' "times": 1}]'))
+        sched = Scheduler(backend="host", max_wait_ms=0.0, cache_size=0)
+        sched.start()
+        try:
+            with pytest.raises(faults.InjectedFault):
+                sched.submit([_problem("x")])
+            # The plan fired once; the next submit succeeds.
+            assert sched.submit([_problem("x")])[0] == {"x": True}
+        finally:
+            sched.stop()
+
+
+# ------------------------------------------------------------------- cache
+
+
+class TestResultCache:
+    def test_fingerprint_canonicalizes_clause_order_only(self):
+        pa = encode(_problem("a"))
+        pb = encode(_problem("b"))
+        assert fingerprint(pa) == fingerprint(encode(_problem("a")))
+        # Different identifiers render different responses: never shared.
+        assert fingerprint(pa) != fingerprint(pb)
+
+    def test_definitive_hit_serves_larger_budgets_only(self):
+        cache = ResultCache(8, registry=telemetry.Registry())
+        cache.store("k", 100, {"a": True})
+        assert cache.lookup("k", 100) == {"a": True}
+        assert cache.lookup("k", 500) == {"a": True}  # deterministic
+        assert cache.lookup("k", 50) is MISS  # smaller budget: unproven
+
+    def test_hit_returns_a_fresh_copy(self):
+        cache = ResultCache(8, registry=telemetry.Registry())
+        cache.store("k", 10, {"a": True})
+        got = cache.lookup("k", 10)
+        got["a"] = False
+        assert cache.lookup("k", 10) == {"a": True}
+
+    def test_store_copies_the_callers_dict(self):
+        """The submitter holds the very dict being cached; mutating it
+        after the fact must not poison future hits."""
+        cache = ResultCache(8, registry=telemetry.Registry())
+        mine = {"a": True}
+        cache.store("k", 10, mine)
+        mine["a"] = False
+        assert cache.lookup("k", 10) == {"a": True}
+
+    def test_incomplete_entries_invalidate_on_budget_escalation(self):
+        reg = telemetry.Registry()
+        cache = ResultCache(8, registry=reg)
+        cache.store("k", 10, Incomplete())
+        assert isinstance(cache.lookup("k", 5), Incomplete)  # still stuck
+        assert isinstance(cache.lookup("k", 10), Incomplete)
+        # Escalated budget: the stale incomplete is invalidated.
+        assert cache.lookup("k", 20) is MISS
+        assert reg.snapshot()["deppy_cache_invalidations_total"] == 1
+        assert len(cache) == 0
+        # The escalated solve lands a definitive answer; it replaces.
+        cache.store("k", 20, {"a": False})
+        assert cache.lookup("k", 20) == {"a": False}
+
+    def test_lru_eviction_counts(self):
+        reg = telemetry.Registry()
+        cache = ResultCache(2, registry=reg)
+        cache.store("k1", 1, {"a": True})
+        cache.store("k2", 1, {"b": True})
+        cache.lookup("k1", 1)  # refresh k1: k2 becomes LRU
+        cache.store("k3", 1, {"c": True})
+        assert reg.snapshot()["deppy_cache_evictions_total"] == 1
+        assert cache.lookup("k2", 1) is MISS
+        assert cache.lookup("k1", 1) == {"a": True}
+
+    def test_unsat_results_cached(self):
+        from deppy_tpu import io as problem_io
+
+        doc = {"variables": [{"id": "u", "constraints": [
+            {"type": "mandatory"}, {"type": "prohibited"}]}]}
+        sched = Scheduler(backend="host", max_wait_ms=0.0)
+        vars1 = problem_io.problems_from_document(doc)
+        r1 = sched.submit(vars1)[0]
+        r2 = sched.submit(problem_io.problems_from_document(doc))[0]
+        assert isinstance(r1, NotSatisfiable)
+        assert isinstance(r2, NotSatisfiable)
+        reg = sched._registry
+        assert reg.snapshot()["deppy_cache_hits_total"] == 1
+
+    def test_deadline_degraded_results_never_cached(self):
+        sched = Scheduler(backend="host", max_wait_ms=0.0)
+        r = sched.submit([_problem("d")], deadline_s=0.0)[0]
+        assert isinstance(r, Incomplete)
+        assert len(sched.cache) == 0
+        # With the deadline gone the problem actually solves.
+        assert sched.submit([_problem("d")])[0] == {"d": True}
+
+
+# -------------------------------------------------------------- admission
+
+
+class TestAdmission:
+    def test_queue_over_depth_feeds_503_retry_after(self):
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host")
+        # Pretend a deep backlog without racing a real flood: the
+        # admission gate reads queue_depth via the scheduler.
+        srv.scheduler.max_depth = 1
+        srv.scheduler._depth = 5
+        srv.start()
+        try:
+            status, data = request(srv.api_port, "POST", "/v1/resolve",
+                                   {"variables": [{"id": "a"}]})
+            assert status == 503
+            doc = json.loads(data)
+            assert "overloaded" in doc["error"]
+            assert doc["retry_after_s"] >= 1.0
+            srv.scheduler._depth = 0
+            status, _ = request(srv.api_port, "POST", "/v1/resolve",
+                                {"variables": [{"id": "a"}]})
+            assert status == 200
+        finally:
+            srv.scheduler._depth = 0
+            srv.shutdown()
+
+    def test_inline_dispatch_when_loop_not_running(self):
+        """Library callers (no started loop) still resolve — the submit
+        dispatches inline through the same code path."""
+        sched = Scheduler(backend="host", max_wait_ms=0.0)
+        assert not sched.running
+        assert sched.submit([_problem("inline")])[0] == {"inline": True}
+
+    def test_scheduler_metrics_exposed_on_scrape(self):
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host")
+        srv.start()
+        try:
+            request(srv.api_port, "POST", "/v1/resolve",
+                    {"variables": [{"id": "a"}]})
+            _, data = request(srv.api_port, "GET", "/metrics")
+            text = data.decode()
+            for family in ("deppy_sched_queue_depth",
+                           "deppy_sched_coalesced_batch_size_bucket",
+                           "deppy_cache_hit_ratio",
+                           "deppy_cache_hits_total",
+                           "deppy_cache_misses_total",
+                           "deppy_cache_evictions_total"):
+                assert family in text, family
+        finally:
+            srv.shutdown()
+
+
+# --------------------------------------------------------------- facade
+
+
+class TestBatchResolverIntegration:
+    def test_batch_resolver_routes_through_scheduler(self):
+        from deppy_tpu.resolution.facade import BatchResolver
+
+        sched = Scheduler(backend="host", max_wait_ms=0.0)
+        resolver = BatchResolver(scheduler=sched)
+        out = resolver.solve([_problem("r1"), _problem("r2")])
+        assert out == [{"r1": True}, {"r2": True}]
+        assert resolver.last_steps > 0
+        assert resolver.last_report is not None
+        assert resolver.last_report.outcomes["sat"] == 2
+        # Second solve of the same problems: pure cache, zero steps.
+        out2 = resolver.solve([_problem("r1"), _problem("r2")])
+        assert out2 == out
+        assert resolver.last_steps == 0
+
+    def test_size_classes_do_not_mix(self):
+        """A giant problem and a burst of tiny ones flush as separate
+        dispatches (the queue reuses the driver's cost proxies), so the
+        tiny lanes never pay the giant's padded planes."""
+        from deppy_tpu import io as problem_io
+        from deppy_tpu.engine import driver as _driver
+
+        tiny = _problem("t")
+        giant = problem_io.problems_from_document({"variables": [
+            {"id": f"g{i}", "constraints": [
+                {"type": "dependency",
+                 "ids": [f"g{j}" for j in range(64) if j != i][:8]}]}
+            for i in range(64)
+        ]})[0]
+        c_tiny = _driver._bucket(_driver._cost_proxy(encode(tiny)))
+        c_giant = _driver._bucket(_driver._cost_proxy(encode(giant)))
+        assert c_tiny != c_giant  # the premise of the test
+        reg = telemetry.Registry()
+        sched = Scheduler(backend="host", max_wait_ms=200.0,
+                          cache_size=0, registry=reg)
+        sched.start()
+        try:
+            out = {}
+            threads = [
+                threading.Thread(target=lambda: out.setdefault(
+                    "tiny", sched.submit([tiny])[0])),
+                threading.Thread(target=lambda: out.setdefault(
+                    "giant", sched.submit([giant])[0])),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30)
+            assert out["tiny"] == {"t": True}
+            assert isinstance(out["giant"], dict)
+            assert reg.snapshot()["deppy_sched_dispatches_total"] == 2
+        finally:
+            sched.stop()
