@@ -60,6 +60,14 @@ sched-smoke: ## Threaded clients against a CPU-backed server: assert request coa
 test-sched: ## Scheduler/cache subsystem tests only (the `sched` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m sched
 
+.PHONY: trace-smoke
+trace-smoke: ## Two concurrent traced requests against a live server: assert /debug/traces span trees + queue-wait histogram (ISSUE 4 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/trace_smoke.py
+
+.PHONY: test-trace
+test-trace: ## Distributed-tracing subsystem tests only (the `trace` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m trace
+
 ##@ Benchmarks
 
 .PHONY: bench

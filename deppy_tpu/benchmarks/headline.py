@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import json
 
-from .harness import bench_problems, log
+from .harness import bench_problems, log, probe_wall_s
 
 
 def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
@@ -31,6 +31,7 @@ def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
 
     if platform:
         jax.config.update("jax_platforms", platform)
+    probe_s = probe_wall_s()  # time the first backend touch explicitly
     backend = jax.default_backend()
     log(f"jax backend: {backend} devices={jax.devices()}")
     problems = [
@@ -62,6 +63,11 @@ def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
         "baseline_source": "pinned" if pinned else "live",
         "host_rate_live": round(1.0 / m["host_s_per_problem"], 1),
         "host_rate_used": round(1.0 / host_s, 1),
+        # Startup attribution (ISSUE 4 satellite): backend first-touch
+        # wall and the untimed compile warm-up — the BENCH_r01-r05
+        # multi-minute probe/retry stalls were invisible without these.
+        "probe_wall_s": round(probe_s, 3),
+        "warmup_seconds": round(m["warmup_seconds"], 3),
     }
     if "telemetry" in m:
         # Occupancy and fallback columns ride in every BENCH row (ISSUE

@@ -121,6 +121,11 @@ def _bench_config(cfg: Dict, host_sample: int = 16) -> Dict:
         "device_seconds": round(m["device_seconds"], 4),
         "device_rate": round(m["device_rate"], 2),
         "speedup_vs_serial_host": round(m["device_rate"] * host_s, 3),
+        # Startup attribution (ISSUE 4 satellite): every record carries
+        # the backend first-touch wall and this config's compile
+        # warm-up, so probe/retry stalls are visible in the JSON.
+        "probe_wall_s": round(m["probe_wall_s"], 3),
+        "warmup_seconds": round(m["warmup_seconds"], 3),
         "sat": m["sat"],
         "unsat": m["unsat"],
     }
@@ -150,6 +155,9 @@ def run(quick: bool = False, out_path: Optional[str] = None,
         only: Optional[int] = None) -> List[Dict]:
     import jax
 
+    from .harness import probe_wall_s
+
+    probe_wall_s()  # time the first backend touch before anything else
     log(f"jax backend: {jax.default_backend()} devices={jax.devices()}")
     results = []
     for i, cfg in enumerate(_configs(quick)):

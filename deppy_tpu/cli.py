@@ -178,13 +178,37 @@ def _build_parser() -> argparse.ArgumentParser:
     p_stats = sub.add_parser(
         "stats",
         help="summarize a telemetry JSONL file: per-span counts/timings "
-        "and the last solve report (see docs/observability.md)",
+        "with p50/p95/p99 and the last solve report (see "
+        "docs/observability.md)",
     )
     p_stats.add_argument(
         "file", nargs="?", default=None,
         help="telemetry JSONL file (default: $DEPPY_TPU_TELEMETRY_FILE)",
     )
     p_stats.add_argument(
+        "--output", choices=["text", "json"], default="text",
+        help="output format (default: text)",
+    )
+    p_stats.add_argument(
+        "--span", default=None, metavar="NAME",
+        help="summarize only the named span (e.g. driver.solve)",
+    )
+
+    p_trace = sub.add_parser(
+        "trace",
+        help="reconstruct one request's span tree from a telemetry "
+        "JSONL sink and pretty-print it (see docs/observability.md, "
+        "Tracing)",
+    )
+    p_trace.add_argument(
+        "trace_id",
+        help="trace id or X-Deppy-Request-Id of the request",
+    )
+    p_trace.add_argument(
+        "--file", default=None, metavar="FILE",
+        help="telemetry JSONL file (default: $DEPPY_TPU_TELEMETRY_FILE)",
+    )
+    p_trace.add_argument(
         "--output", choices=["text", "json"], default="text",
         help="output format (default: text)",
     )
@@ -330,12 +354,42 @@ def _cmd_bench(args) -> int:
     return 0
 
 
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over pre-sorted values (0 on empty)."""
+    if not sorted_vals:
+        return 0.0
+    import math
+
+    idx = max(int(math.ceil(q / 100.0 * len(sorted_vals))) - 1, 0)
+    return sorted_vals[min(idx, len(sorted_vals) - 1)]
+
+
+def _iter_sink_events(path: str):
+    """Yield one item per non-empty sink line: the parsed event dict, or
+    None for a malformed line (callers count those)."""
+    # errors="replace": a torn write can leave invalid UTF-8 on the
+    # final line of a live sink file — it must count as one malformed
+    # line, not raise UnicodeDecodeError mid-summary.
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                yield None
+                continue
+            yield ev if isinstance(ev, dict) else None
+
+
 def _cmd_stats(args) -> int:
     """Summarize a telemetry JSONL file (the sink written under
     ``--telemetry-file`` / ``DEPPY_TPU_TELEMETRY_FILE``): per-span
-    count/total/mean wall clock, event totals, and the last recorded
-    solve report — the same report `deppy resolve --report` and the
-    bench harness print."""
+    count/total/mean/p50/p95/p99 wall clock, event totals, and the last
+    recorded solve report — the same report `deppy resolve --report`
+    and the bench harness print.  ``--span NAME`` narrows the summary
+    to one span family."""
     import os
 
     path = args.file or os.environ.get("DEPPY_TPU_TELEMETRY_FILE")
@@ -348,35 +402,32 @@ def _cmd_stats(args) -> int:
     n_events = 0
     n_bad = 0
     try:
-        # errors="replace": a torn write can leave invalid UTF-8 on the
-        # final line of a live sink file — it must count as one malformed
-        # line, not raise UnicodeDecodeError mid-summary.
-        with open(path, "r", encoding="utf-8", errors="replace") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
+        for ev in _iter_sink_events(path):
+            if ev is None:
+                n_bad += 1
+                continue
+            n_events += 1
+            if ev.get("kind") == "span":
+                name = ev.get("name", "?")
+                if args.span is not None and name != args.span:
+                    # Filter in the read loop: a --span run over a
+                    # long-lived sink must not buffer every family's
+                    # durations just to discard them afterwards.
                     continue
+                agg = spans.setdefault(
+                    name,
+                    {"count": 0, "total_s": 0.0, "durs": []},
+                )
+                agg["count"] += 1
                 try:
-                    ev = json.loads(line)
-                except json.JSONDecodeError:
-                    n_bad += 1
+                    dur = float(ev.get("dur_s", 0.0))
+                except (TypeError, ValueError):
                     continue
-                if not isinstance(ev, dict):
-                    n_bad += 1
-                    continue
-                n_events += 1
-                if ev.get("kind") == "span":
-                    agg = spans.setdefault(
-                        ev.get("name", "?"), {"count": 0, "total_s": 0.0}
-                    )
-                    agg["count"] += 1
-                    try:
-                        agg["total_s"] += float(ev.get("dur_s", 0.0))
-                    except (TypeError, ValueError):
-                        pass
-                elif ev.get("kind") == "report":
-                    if isinstance(ev.get("report"), dict):
-                        last_report = ev["report"]
+                agg["total_s"] += dur
+                agg["durs"].append(dur)
+            elif ev.get("kind") == "report":
+                if isinstance(ev.get("report"), dict):
+                    last_report = ev["report"]
     except FileNotFoundError:
         print(f"error: no such file: {path}", file=sys.stderr)
         return 2
@@ -386,10 +437,16 @@ def _cmd_stats(args) -> int:
 
     for agg in spans.values():
         agg["mean_s"] = agg["total_s"] / agg["count"] if agg["count"] else 0.0
+        durs = sorted(agg.pop("durs"))
+        for q in (50, 95, 99):
+            agg[f"p{q}_s"] = _percentile(durs, q)
 
     if args.output == "json":
         json.dump({"events": n_events, "malformed_lines": n_bad,
-                   "spans": spans, "last_report": last_report},
+                   "spans": spans,
+                   # --span narrows to one span family in BOTH formats.
+                   "last_report": (last_report if args.span is None
+                                   else None)},
                   sys.stdout, indent=2, sort_keys=True)
         print()
         return 0
@@ -399,20 +456,203 @@ def _cmd_stats(args) -> int:
     if spans:
         width = max(len(n) for n in spans)
         print(f"{'span'.ljust(width)}  {'count':>7}  {'total_s':>9}  "
-              f"{'mean_ms':>8}")
+              f"{'mean_ms':>8}  {'p50_ms':>8}  {'p95_ms':>8}  "
+              f"{'p99_ms':>8}")
         for name in sorted(spans):
             agg = spans[name]
             print(f"{name.ljust(width)}  {agg['count']:>7}  "
-                  f"{agg['total_s']:>9.3f}  {agg['mean_s'] * 1e3:>8.2f}")
+                  f"{agg['total_s']:>9.3f}  {agg['mean_s'] * 1e3:>8.2f}  "
+                  f"{agg['p50_s'] * 1e3:>8.2f}  "
+                  f"{agg['p95_s'] * 1e3:>8.2f}  "
+                  f"{agg['p99_s'] * 1e3:>8.2f}")
+    elif args.span is not None:
+        print(f"no span events named {args.span!r}")
     else:
         print("no span events recorded")
-    if last_report is not None:
+    if last_report is not None and args.span is None:
         from .telemetry import SolveReport
 
         print()
         # One canonical renderer: the same table `deppy resolve
         # --report` and the bench harness print.
         print("last " + SolveReport.from_dict(last_report).format_table())
+    return 0
+
+
+def _cmd_trace(args) -> int:
+    """Reconstruct one request's span tree from a telemetry JSONL sink
+    (span/fault/breaker events stamped with trace ids, plus flight-
+    recorder ``trace`` dumps) and pretty-print it — including dispatch
+    traces grafted via their span links, so a request served by a
+    coalesced dispatch shows queue-wait → dispatch (with retry/fallback
+    events) → decode as one tree."""
+    import os
+
+    path = args.file or os.environ.get("DEPPY_TPU_TELEMETRY_FILE")
+    if not path:
+        print("error: no telemetry file (pass --file or set "
+              "DEPPY_TPU_TELEMETRY_FILE)", file=sys.stderr)
+        return 2
+
+    # (trace_id, span_id) -> span event; trace_id -> [events]; the
+    # request-id alias map comes from flight-recorder dumps.
+    spans: dict = {}
+    events_by_trace: dict = {}
+    request_alias: dict = {}
+    seen_events: set = set()
+
+    def _take_span(ev):
+        tid, sid = ev.get("trace_id"), ev.get("span_id")
+        if tid and sid:
+            spans[(tid, sid)] = ev
+            # Root spans carry the request id in their attrs, so a
+            # client-chosen X-Deppy-Request-Id resolves from live sink
+            # lines alone (not just flight-recorder dumps).
+            rid = (ev.get("attrs") or {}).get("request_id")
+            if rid:
+                request_alias.setdefault(rid, tid)
+
+    def _take_event(ev):
+        tid = ev.get("trace_id")
+        if not tid:
+            return
+        # The same fault/breaker event reaches the sink twice when a
+        # flight-recorder dump follows the live stamped line (and once
+        # more per additional dump).  Stamped events carry a per-process
+        # `seq` exactly so dump copies dedupe without collapsing
+        # genuinely distinct identical-field events; pre-seq sink lines
+        # fall back to the full canonical form.
+        seq = ev.get("seq")
+        key = (tid, seq) if seq is not None \
+            else json.dumps(ev, sort_keys=True, default=str)
+        if key in seen_events:
+            return
+        seen_events.add(key)
+        events_by_trace.setdefault(tid, []).append(ev)
+
+    try:
+        for ev in _iter_sink_events(path):
+            if ev is None:
+                continue
+            kind = ev.get("kind")
+            if kind == "span":
+                _take_span(ev)
+            elif kind == "trace" and isinstance(ev.get("trace"), dict):
+                trace = ev["trace"]
+                if trace.get("request_id") and trace.get("trace_id"):
+                    request_alias[trace["request_id"]] = trace["trace_id"]
+                for sp in trace.get("spans", []):
+                    _take_span(sp)
+                for fe in trace.get("events", []):
+                    _take_event(fe)
+            elif kind in ("fault", "breaker"):
+                _take_event(ev)
+    except FileNotFoundError:
+        print(f"error: no such file: {path}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+
+    target = request_alias.get(args.trace_id, args.trace_id)
+    # Pull in traces that LINK into the target (coalesced dispatches):
+    # their root spans display under the linked request span.
+    included = {target}
+    graft = {}  # trace_id -> parent span_id to graft its roots under
+    changed = True
+    while changed:
+        changed = False
+        for (tid, sid), sp in spans.items():
+            if tid in included:
+                continue
+            for link in sp.get("links") or []:
+                if link.get("trace_id") in included:
+                    included.add(tid)
+                    graft[tid] = link.get("span_id")
+                    changed = True
+                    break
+
+    chosen = [sp for (tid, _), sp in spans.items() if tid in included]
+    if not chosen:
+        print(f"error: no spans for trace {args.trace_id!r} in {path}",
+              file=sys.stderr)
+        return 2
+    chosen.sort(key=lambda sp: sp.get("ts", 0.0))
+    fault_events = [e for tid in included
+                    for e in events_by_trace.get(tid, [])]
+
+    if args.output == "json":
+        json.dump({"trace_id": target, "spans": chosen,
+                   "events": fault_events}, sys.stdout, indent=2,
+                  default=str)
+        print()
+        return 0
+
+    by_id = {sp["span_id"]: sp for sp in chosen}
+    children: dict = {}
+    roots = []
+    for sp in chosen:
+        parent = sp.get("parent_id")
+        if sp["trace_id"] in graft and parent not in by_id:
+            parent = graft[sp["trace_id"]]  # dispatch root → link target
+        if parent in by_id:
+            children.setdefault(parent, []).append(sp)
+        else:
+            roots.append(sp)
+    notes: dict = {}
+    for e in fault_events:
+        notes.setdefault(e.get("parent_id"), []).append(e)
+
+    def _fmt_span(sp):
+        attrs = {k: v for k, v in (sp.get("attrs") or {}).items()}
+        extra = ""
+        if sp.get("links"):
+            extra += "  links=" + ",".join(
+                link.get("trace_id", "?")[:8] for link in sp["links"])
+        if attrs:
+            extra += "  " + " ".join(f"{k}={v}"
+                                     for k, v in sorted(attrs.items()))
+        return (f"{sp.get('name', '?')}  "
+                f"{float(sp.get('dur_s', 0.0)) * 1e3:.2f}ms  "
+                f"[{sp.get('span_id', '?')[:8]}]{extra}")
+
+    def _fmt_event(e):
+        detail = " ".join(
+            f"{k}={v}" for k, v in sorted(e.items())
+            if k not in ("ts", "kind", "trace_id", "parent_id"))
+        return f"({e.get('kind')}) {detail}"
+
+    def _walk(sp, prefix, is_last):
+        branch = "└─ " if is_last else "├─ "
+        print(prefix + branch + _fmt_span(sp))
+        child_prefix = prefix + ("   " if is_last else "│  ")
+        kids = children.get(sp["span_id"], [])
+        tail = notes.get(sp["span_id"], [])
+        items = [("span", k) for k in kids] + [("event", e) for e in tail]
+        for i, (kind, item) in enumerate(items):
+            last = i == len(items) - 1
+            if kind == "span":
+                _walk(item, child_prefix, last)
+            else:
+                print(child_prefix + ("└─ " if last else "├─ ")
+                      + _fmt_event(item))
+
+    print(f"trace {target}"
+          + (f" (request {args.trace_id})"
+             if args.trace_id != target else ""))
+    for i, root in enumerate(roots):
+        _walk(root, "", i == len(roots) - 1)
+    # Events whose parent span never completed (process died mid-span,
+    # or stamped with no open span) must not vanish from the text view
+    # — the JSON view includes them, and an incident reconstruction is
+    # exactly when they matter.
+    orphans = [e for pid, evs in notes.items() if pid not in by_id
+               for e in evs]
+    if orphans:
+        print("unattached events:")
+        for i, e in enumerate(orphans):
+            print(("└─ " if i == len(orphans) - 1 else "├─ ")
+                  + _fmt_event(e))
     return 0
 
 
@@ -483,6 +723,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_serve(args)
     if args.command == "stats":
         return _cmd_stats(args)
+    if args.command == "trace":
+        return _cmd_trace(args)
     if args.command == "doctor":
         from .utils.tpu_doctor import run_from_args
 

@@ -1526,14 +1526,19 @@ def decode_results(
     pre-encoded problems and decodes per lane — the two paths cannot
     drift."""
     out: List[Union[dict, NotSatisfiable, Incomplete]] = []
-    for p, res in zip(problems, results):
-        if res.outcome == core.SAT:
-            solution = {v.identifier: False for v in p.variables}
-            for v in _decode_installed(p, res.installed):
-                solution[v.identifier] = True
-            out.append(solution)
-        elif res.outcome == core.UNSAT:
-            out.append(_decode_core(p, res.core))
-        else:
-            out.append(Incomplete())
+    # Spanned (ISSUE 4): decode is the last leg of a request's timing
+    # breakdown (queue-wait → dispatch → solve → decode), and the trace
+    # tree should show it like every other stage.
+    with telemetry.default_registry().span("driver.decode",
+                                           problems=len(problems)):
+        for p, res in zip(problems, results):
+            if res.outcome == core.SAT:
+                solution = {v.identifier: False for v in p.variables}
+                for v in _decode_installed(p, res.installed):
+                    solution[v.identifier] = True
+                out.append(solution)
+            elif res.outcome == core.UNSAT:
+                out.append(_decode_core(p, res.core))
+            else:
+                out.append(Incomplete())
     return out

@@ -168,8 +168,12 @@ class CircuitBreaker:
         return self._state
 
     def _open(self) -> "Optional[dict]":
+        prev = self._state
         self._opened_at = self._clock()
-        return self._transition(BREAKER_OPEN)
+        ev = self._transition(BREAKER_OPEN)
+        if ev is not None:
+            ev["from"] = _STATE_NAMES[prev]
+        return ev
 
     def _transition(self, new_state: int) -> "Optional[dict]":
         """Mutate state only (caller holds the lock) and return the
@@ -199,6 +203,20 @@ class CircuitBreaker:
             1, label=ev["state"])
         reg.event("breaker", state=ev["state"],
                   consecutive_failures=ev["consecutive_failures"])
+        if ev["state"] == "open" and ev.get("from") == "closed":
+            # A FRESH trip (closed → open) is the incident moment: dump
+            # the flight recorder to the JSONL sink NOW (ISSUE 4) — the
+            # healthy context leading up to the trip.  Half-open probe
+            # failures re-open without re-dumping: a hard-down
+            # accelerator re-trips every cooldown, and re-dumping the
+            # whole ring each cycle would grow the sink without bound.
+            # The tripping requests themselves are still in flight
+            # here; their traces reach the sink when they complete
+            # (FlightRecorder.record writes every errored trace
+            # through).  Never raises.
+            from ..telemetry.trace import notify_breaker_open
+
+            notify_breaker_open()
 
 
 _DEFAULT: Optional[CircuitBreaker] = None

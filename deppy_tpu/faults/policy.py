@@ -186,7 +186,10 @@ def ambient_deadline() -> Iterator[Optional[Deadline]]:
 
 def note_deadline_exceeded(where: str, n_problems: int = 0) -> None:
     """Count one deadline expiry (``deppy_deadline_exceeded``) and emit a
-    ``fault`` event to the telemetry sink."""
+    ``fault`` event to the telemetry sink.  Under an active trace
+    context (ISSUE 4) the event is also stamped onto the request's span
+    tree and marks the trace errored, so the flight recorder retains
+    every deadline-degraded request in its error ring."""
     from .. import telemetry
     from .metrics import fault_counter
 

@@ -70,10 +70,15 @@ def _env_int(name: str, default: int) -> int:
 
 
 class _Lane:
-    """One problem awaiting dispatch, plus its result slot."""
+    """One problem awaiting dispatch, plus its result slot.
+
+    ``degraded`` marks a lane the deadline triage actually expired —
+    distinct from a budget-exhaustion ``Incomplete`` whose deadline
+    merely ran out by readback time (ISSUE 4: only the former is an
+    incident worth the flight recorder's error ring)."""
 
     __slots__ = ("problem", "key", "max_steps", "budget", "deadline",
-                 "result", "steps")
+                 "result", "steps", "degraded")
 
     def __init__(self, problem: Problem, key: str,
                  max_steps: Optional[int], budget: int, deadline):
@@ -84,13 +89,19 @@ class _Lane:
         self.deadline = deadline  # faults.Deadline or None
         self.result = None
         self.steps = 0
+        self.degraded = False
 
 
 class _Group:
-    """All queued lanes of one submit() call — flushed atomically."""
+    """All queued lanes of one submit() call — flushed atomically.
+
+    ``parent`` carries the submitting request's trace context across the
+    thread hop to the dispatch loop (ISSUE 4) so a coalesced dispatch
+    can link back to every request it serves; ``timing`` receives the
+    request's queue-wait/dispatch/solve/decode breakdown."""
 
     __slots__ = ("lanes", "enq_t", "size_class", "budget", "event",
-                 "error", "report")
+                 "error", "report", "parent", "timing")
 
     def __init__(self, lanes: List[_Lane], size_class: int, budget: int):
         self.lanes = lanes
@@ -100,6 +111,8 @@ class _Group:
         self.event = threading.Event()
         self.error: Optional[BaseException] = None
         self.report = None
+        self.parent = telemetry.trace.capture_parent()
+        self.timing: dict = {}
 
 
 class Scheduler:
@@ -280,6 +293,7 @@ class Scheduler:
                 pending.append((i, _Lane(p, key, max_steps, budget, dl)))
         steps = 0
         report = None
+        timing: dict = {}
         if pending:
             group = self._make_group([lane for _, lane in pending], budget)
             self._enqueue(group)
@@ -287,12 +301,29 @@ class Scheduler:
             if group.error is not None:
                 raise group.error
             report = group.report
+            timing = group.timing
             for i, lane in pending:
                 results[i] = lane.result
                 steps += lane.steps
+                if lane.degraded:
+                    # Precise error attribution (ISSUE 4): the deadline
+                    # fault event rode the shared dispatch trace, but
+                    # only THIS request's lane was triaged expired —
+                    # flag this trace, not the batchmates', and not a
+                    # budget-exhaustion Incomplete whose deadline
+                    # happened to lapse by readback time.
+                    telemetry.trace.mark_error()
+            qw = timing.get("queue_wait_s")
+            if qw is not None:
+                # Recorded on the submitting thread so the span joins
+                # THIS request's trace (the wait was measured on the
+                # dispatch loop's clock).
+                telemetry.default_registry().record_span(
+                    "sched.queue_wait", qw, lanes=len(group.lanes))
         if stats is not None:
             stats["steps"] = steps
             stats["report"] = report
+            stats["timings"] = dict(timing)
         return results
 
     def _make_group(self, lanes: List[_Lane], budget: int) -> _Group:
@@ -381,16 +412,33 @@ class Scheduler:
         lanes = [lane for g in groups for lane in g.lanes]
         t0 = time.monotonic()
         report = None
+        timing: dict = {}
         # Everything — telemetry included — runs inside the try: the
         # finally below is the only thing standing between a failure
         # here and submitters parked forever on their group events.
         try:
+            for g in groups:
+                g.timing["queue_wait_s"] = max(t0 - g.enq_t, 0.0)
             self._c_flushes.inc(label=reason)
             self._c_dispatches.inc()
             self._c_requests.inc(len(groups))
             self._h_coalesced.observe(len(lanes))
-            faults.inject("sched.dispatch")
-            report = self._solve_lanes(lanes)
+            # Trace scope (ISSUE 4): on the loop thread this is a fresh
+            # dispatch trace whose root span LINKS to every parent
+            # request — each request's flight record then contains the
+            # shared dispatch's whole span tree; inline (caller-thread)
+            # dispatches nest under the request's own trace instead.
+            reg = telemetry.default_registry()
+            with telemetry.trace.dispatch_scope(
+                    [g.parent for g in groups]) as dctx:
+                with reg.span("sched.dispatch", lanes=len(lanes),
+                              requests=len(groups), reason=reason) as sp:
+                    if dctx is not None:
+                        for link in dctx.links:
+                            sp.link(link["trace_id"],
+                                    link.get("span_id"))
+                    faults.inject("sched.dispatch")
+                    report = self._solve_lanes(lanes, timing)
             for lane in lanes:
                 self._maybe_cache(lane)
         except BaseException as e:  # noqa: BLE001 — re-raised per request
@@ -400,7 +448,9 @@ class Scheduler:
             dur = time.monotonic() - t0
             self._dispatch_ewma_s = (0.8 * self._dispatch_ewma_s
                                      + 0.2 * dur)
+            timing["dispatch_s"] = dur
             for g in groups:
+                g.timing.update(timing)
                 g.report = report
                 g.event.set()
 
@@ -415,10 +465,14 @@ class Scheduler:
 
     # -------------------------------------------------------------- solving
 
-    def _solve_lanes(self, lanes: List[_Lane]):
+    def _solve_lanes(self, lanes: List[_Lane], timing: Optional[dict] = None):
         """Solve one coalesced lane set; fills each lane's result/steps
-        and returns the dispatch's SolveReport."""
+        and returns the dispatch's SolveReport.  ``timing``, when given,
+        receives the solve/decode wall-clock split (ISSUE 4)."""
         from ..sat.solver import resolve_backend
+
+        if timing is None:
+            timing = {}
 
         live: List[_Lane] = []
         for lane in lanes:
@@ -428,6 +482,7 @@ class Scheduler:
                 faults.note_deadline_exceeded("sched.dispatch")
                 lane.result = Incomplete()
                 lane.steps = 0
+                lane.degraded = True
             else:
                 live.append(lane)
         if not live:
@@ -446,14 +501,16 @@ class Scheduler:
         try:
             with faults.deadline_scope(scope):
                 if backend == "host":
+                    t1 = time.perf_counter()
                     self._solve_host(live, rep)
+                    timing["solve_s"] = time.perf_counter() - t1
                 else:
-                    self._solve_device(live)
+                    self._solve_device(live, timing)
         finally:
             telemetry.end_report(rep, owns)
         return rep
 
-    def _solve_device(self, live: List[_Lane]) -> None:
+    def _solve_device(self, live: List[_Lane], timing: dict) -> None:
         from ..engine import driver
 
         problems = [lane.problem for lane in live]
@@ -461,9 +518,13 @@ class Scheduler:
         # only coalesces equal-budget groups).  solve_problems runs
         # every dispatch group under the fault-domain recovery wrapper
         # and merges its telemetry into the report begun above.
+        t1 = time.perf_counter()
         results = driver.solve_problems(problems,
                                         max_steps=live[0].max_steps)
+        timing["solve_s"] = time.perf_counter() - t1
+        t1 = time.perf_counter()
         decoded = driver.decode_results(problems, results)
+        timing["decode_s"] = time.perf_counter() - t1
         for lane, res, dec in zip(live, results, decoded):
             lane.steps = int(res.steps)
             lane.result = dec
@@ -483,6 +544,7 @@ class Scheduler:
                     faults.note_deadline_exceeded("sched.host_solve")
                     rep.count_outcome("incomplete")
                     lane.result = Incomplete()
+                    lane.degraded = True
                     continue
                 eng = HostEngine(lane.problem, max_steps=lane.max_steps)
                 outcome = "incomplete"

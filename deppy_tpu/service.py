@@ -131,6 +131,19 @@ class Metrics:
             "Budget-escalation stage reached per batch (0 = single "
             "stage, 1 = stage-1 budget sufficed, 2 = stage-2 redo).",
             buckets=telemetry.STAGE_BUCKETS)
+        # Per-request latency attribution (ISSUE 4): end-to-end request
+        # wall clock, and the slice of it spent queued in the scheduler
+        # before a coalesced dispatch picked the request up.
+        self._request_hist = r.histogram(
+            "deppy_request_total_seconds",
+            "End-to-end /v1/resolve wall clock, admission through "
+            "response render.",
+            buckets=telemetry.SECONDS_BUCKETS)
+        self._queue_wait_hist = r.histogram(
+            "deppy_request_queue_wait_seconds",
+            "Seconds a request's problems waited in the scheduler "
+            "queue before their coalesced dispatch started.",
+            buckets=telemetry.SECONDS_BUCKETS)
 
     def observe_batch(self, outcomes: Dict[str, int], seconds: float,
                       steps: int = 0,
@@ -147,6 +160,13 @@ class Metrics:
 
     def observe_error(self) -> None:
         self._errors.inc()
+
+    def observe_request(self, total_s: float,
+                        queue_wait_s: Optional[float] = None) -> None:
+        """One /v1/resolve request's latency breakdown (ISSUE 4)."""
+        self._request_hist.observe(total_s)
+        if queue_wait_s is not None:
+            self._queue_wait_hist.observe(queue_wait_s)
 
     def render(self) -> str:
         # The probe runs OUTSIDE any metric lock (it may import the
@@ -314,10 +334,16 @@ class Server:
         return None
 
     def resolve_document(self, doc,
-                         deadline_s: Optional[float] = None) -> Tuple[int, dict]:
+                         deadline_s: Optional[float] = None,
+                         timings: Optional[dict] = None) -> Tuple[int, dict]:
         """Resolve one request body; returns (http_status, response_doc).
         A 503 response carries ``retry_after_s`` (the handler mirrors it
-        into a ``Retry-After`` header)."""
+        into a ``Retry-After`` header).  ``timings``, when given,
+        receives this request's stage breakdown (ISSUE 4):
+        ``queue_wait_s`` / ``dispatch_s`` / ``solve_s`` / ``decode_s``
+        from the scheduler (or ``solve_s`` alone on the unscheduled
+        path) — the handler feeds it to the latency histograms and, on
+        ``X-Deppy-Timings: 1``, into the response body."""
         faults.inject("service.resolve")
         if deadline_s is None:
             deadline_s = self.request_deadline_s
@@ -346,6 +372,8 @@ class Server:
                     problems, deadline_s=deadline_s, stats=stats)
                 steps = stats.get("steps", 0)
                 report = stats.get("report")
+                if timings is not None:
+                    timings.update(stats.get("timings") or {})
             else:
                 from .resolution.facade import BatchResolver
 
@@ -355,6 +383,8 @@ class Server:
                 results = resolver.solve(problems)
                 steps = resolver.last_steps
                 report = resolver.last_report
+                if timings is not None:
+                    timings["solve_s"] = time.perf_counter() - t0
         except (DuplicateIdentifier, InternalSolverError) as e:
             self.metrics.observe_error()
             return 400, {"error": str(e)}
@@ -505,36 +535,81 @@ def _parse_addr(addr: str) -> Tuple[str, int]:
 
 def _api_handler(server: Server):
     class Handler(BaseHTTPRequestHandler):
+        # Trace context of the in-flight /v1/resolve (ISSUE 4); echoed
+        # into response headers by _send when the client sent a tracing
+        # header (strict byte-identity for clients that sent none).
+        _trace_ctx = None
+        _echo_ids = False
+        _echo_traceparent = False
+
         def log_message(self, fmt, *args):  # keep the library print-free
             pass
 
         def _send(self, status: int, body: str, ctype: str,
-                  extra_headers: Optional[dict] = None) -> None:
+                  extra_headers: Optional[dict] = None) -> int:
             data = body.encode()
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            if self._trace_ctx is not None and self._echo_ids:
+                # Echo the honored id back so the caller can quote it
+                # against /debug/traces and `deppy trace`; the W3C
+                # header is echoed only to callers speaking it.  Header-
+                # free requests get byte-identical pre-trace responses
+                # (their traces are still in the flight recorder).
+                self.send_header("X-Deppy-Request-Id",
+                                 self._trace_ctx.request_id)
+                if self._echo_traceparent:
+                    self.send_header(
+                        "traceparent",
+                        telemetry.trace.traceparent_of(self._trace_ctx))
             for k, v in (extra_headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
+            return status
 
-        def _send_json(self, status: int, doc: dict) -> None:
+        def _send_json(self, status: int, doc: dict) -> int:
             headers = None
             if status == 503 and "retry_after_s" in doc:
                 # Degraded mode (ISSUE 2): tell well-behaved clients when
                 # the breaker's half-open probe is due.
                 headers = {"Retry-After":
                            str(max(int(doc["retry_after_s"] + 0.5), 1))}
-            self._send(status, json.dumps(doc), "application/json",
-                       headers)
+            return self._send(status, json.dumps(doc), "application/json",
+                              headers)
 
         def do_GET(self):
             if self.path == "/metrics":
                 self._send(200, server.metrics.render(),
                            "text/plain; version=0.0.4")
+            elif self.path.split("?", 1)[0] == "/debug/traces":
+                self._debug_traces()
             else:
                 self._send_json(404, {"error": "not found"})
+
+        def _debug_traces(self):
+            """Flight-recorder lookup (ISSUE 4): the full span tree of
+            one request (``?id=`` trace or request id) or the index of
+            every retained trace."""
+            from urllib.parse import parse_qs, urlsplit
+
+            recorder = telemetry.trace.default_recorder()
+            query = parse_qs(urlsplit(self.path).query)
+            wanted = (query.get("id") or [None])[0]
+            if wanted:
+                trace = recorder.get(wanted)
+                if trace is None:
+                    self._send_json(404,
+                                    {"error": f"unknown trace id {wanted!r}"})
+                else:
+                    self._send(200, json.dumps({"trace": trace},
+                                               default=str),
+                               "application/json")
+            else:
+                self._send(200, json.dumps(
+                    {"traces": recorder.summaries()}, default=str),
+                    "application/json")
 
         def do_POST(self):
             if self.path != "/v1/resolve":
@@ -547,6 +622,52 @@ def _api_handler(server: Server):
                 server._exit_request()
 
         def _resolve_request(self):
+            # Per-request trace context (ISSUE 4): honor an inbound W3C
+            # traceparent or X-Deppy-Request-Id, mint ids otherwise.
+            # Every request is traced into the flight recorder; header
+            # echo and the timings body key are the only response
+            # changes, and the body changes only on explicit opt-in
+            # (X-Deppy-Timings) — tracing-header-free responses stay
+            # byte-identical.
+            inbound_tp = self.headers.get("traceparent")
+            inbound_rid = self.headers.get("X-Deppy-Request-Id")
+            ctx = telemetry.trace.context_from_headers(inbound_tp,
+                                                       inbound_rid)
+            self._trace_ctx = ctx
+            self._echo_ids = inbound_tp is not None or inbound_rid is not None
+            self._echo_traceparent = inbound_tp is not None
+            want_timings = (self.headers.get("X-Deppy-Timings") or "") \
+                .strip().lower() in ("1", "true", "yes")
+            timings: dict = {}
+            t0 = time.perf_counter()
+            reg = telemetry.default_registry()
+            status = None
+            try:
+                # request_id rides the root span's attrs so `deppy
+                # trace CLIENT-ID` resolves from live sink lines alone
+                # (no flight-recorder dump required).
+                with telemetry.trace.activate(ctx), \
+                        reg.span("service.request", path="/v1/resolve",
+                                 request_id=ctx.request_id) as sp:
+                    status = self._resolve_request_inner(t0, timings,
+                                                         want_timings)
+                    sp["status"] = status
+            finally:
+                # Runs even when the handler dies mid-response (client
+                # disconnect → BrokenPipeError): the errored trace is
+                # exactly the one the flight recorder's error ring
+                # promises to retain, and the latency histogram must
+                # count the request either way.  total_s is OVERWRITTEN
+                # here — the opt-in body carries its own pre-send
+                # snapshot, but the histogram/recorder interval must
+                # not depend on whether the client sent X-Deppy-Timings.
+                timings["total_s"] = time.perf_counter() - t0
+                server.metrics.observe_request(timings["total_s"],
+                                               timings.get("queue_wait_s"))
+                telemetry.trace.default_recorder().record(
+                    ctx, status=status, timings=timings)
+
+        def _resolve_request_inner(self, t0, timings, want_timings) -> int:
             # Per-request deadline override: seconds of wall-clock budget
             # the client grants this resolve (proxy chains decrement it).
             deadline_s = None
@@ -563,43 +684,49 @@ def _api_handler(server: Server):
                 # "unbounded": both violate the header's contract.
                 if deadline_s is None or not math.isfinite(deadline_s):
                     server.metrics.observe_error()
-                    self._send_json(
+                    return self._send_json(
                         400, {"error": "invalid X-Deppy-Deadline-S header"})
-                    return
             try:
                 length = int(self.headers.get("Content-Length", 0))
             except ValueError:
                 server.metrics.observe_error()
-                self._send_json(400, {"error": "invalid Content-Length"})
-                return
+                return self._send_json(400,
+                                       {"error": "invalid Content-Length"})
             if length < 0:
                 server.metrics.observe_error()
-                self._send_json(400, {"error": "invalid Content-Length"})
-                return
+                return self._send_json(400,
+                                       {"error": "invalid Content-Length"})
             if length > server.max_body_bytes:
                 # A client-controlled Content-Length must not be able to
                 # buffer unbounded memory on the service.
                 server.metrics.observe_error()
-                self._send_json(
+                return self._send_json(
                     413,
                     {"error": f"body exceeds {server.max_body_bytes} bytes"},
                 )
-                return
             try:
                 doc = json.loads(self.rfile.read(length) or b"null")
             except (ValueError, json.JSONDecodeError) as e:
                 server.metrics.observe_error()
-                self._send_json(400, {"error": f"invalid JSON body: {e}"})
-                return
+                return self._send_json(400,
+                                       {"error": f"invalid JSON body: {e}"})
             try:
-                status, resp = server.resolve_document(doc,
-                                                       deadline_s=deadline_s)
+                status, resp = server.resolve_document(
+                    doc, deadline_s=deadline_s, timings=timings)
             except Exception as e:  # solver/runtime failure → a real 500,
                 # visible to the caller and the error counter, instead of a
                 # dropped connection from the handler's default traceback.
                 server.metrics.observe_error()
                 status, resp = 500, {"error": f"internal error: {e}"}
-            self._send_json(status, resp)
+            if want_timings:
+                # Opt-in breakdown (X-Deppy-Timings: 1): queue-wait /
+                # dispatch / solve / decode seconds in the body.  Without
+                # the header the body is untouched (byte-identical).
+                timings["total_s"] = time.perf_counter() - t0
+                resp = dict(resp)
+                resp["timings"] = {k: round(float(v), 6)
+                                   for k, v in sorted(timings.items())}
+            return self._send_json(status, resp)
 
     return Handler
 
@@ -660,9 +787,21 @@ def serve(
         srv.ready.clear()  # flip /readyz before draining
         stop.set()
 
+    def _on_sigusr2(signum, frame):
+        # Operator-triggered flight-recorder dump (ISSUE 4): every
+        # retained request trace goes to the JSONL sink as `trace`
+        # events — `kill -USR2 $PID` then `deppy trace ID --file ...`.
+        n = telemetry.trace.default_recorder().dump(reason="sigusr2")
+        print(f"[service] SIGUSR2: dumped {n} flight-recorder trace(s) "
+              f"to {telemetry.default_registry().sink_path or '(no sink)'}",
+              file=sys.stderr, flush=True)
+
     # Handler goes in before the startup banner: the banner is the "ready
     # to be signaled" cue for process supervisors (and the e2e test).
     prev = signal.signal(signal.SIGTERM, _on_sigterm)
+    prev_usr2 = None
+    if hasattr(signal, "SIGUSR2"):  # absent on Windows
+        prev_usr2 = signal.signal(signal.SIGUSR2, _on_sigusr2)
     print(
         f"deppy service listening on :{srv.api_port} "
         f"(probes on :{srv.probe_port})",
@@ -675,4 +814,6 @@ def serve(
         pass
     finally:
         signal.signal(signal.SIGTERM, prev)
+        if prev_usr2 is not None:
+            signal.signal(signal.SIGUSR2, prev_usr2)
         srv.shutdown()

@@ -1,13 +1,18 @@
-"""deppy_tpu.telemetry — pipeline-wide observability (ISSUE 1).
+"""deppy_tpu.telemetry — pipeline-wide observability (ISSUE 1 + 4).
 
 A dependency-free span/counter/histogram registry plus the structured
 per-batch :class:`SolveReport`, threaded through encode → pad/pack →
 device transfer → solve → decode.  The service's ``/metrics`` endpoint,
 the ``deppy stats`` CLI, the JSONL event sink, and the benchmark BENCH
-rows all read from here.  See docs/observability.md for the metric/span
-name table and the JSONL event schema.
+rows all read from here.  ISSUE 4 adds the request dimension: per-request
+trace contexts (W3C ``traceparent`` interop), span trees with links
+across coalesced dispatches, and the :class:`trace.FlightRecorder`
+behind ``GET /debug/traces`` and ``deppy trace``.  See
+docs/observability.md for the metric/span name table and the JSONL
+event schema.
 """
 
+from . import trace  # noqa: F401 — re-exported subsystem (ISSUE 4)
 from .registry import (
     LANE_BUCKETS,
     RATIO_BUCKETS,
@@ -31,6 +36,7 @@ from .report import (
 )
 
 __all__ = [
+    "trace",
     "Counter",
     "Gauge",
     "Histogram",

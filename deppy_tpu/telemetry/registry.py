@@ -212,9 +212,17 @@ class Span:
     Attributes set during the span (``sp[\"stage\"] = 2`` or
     ``sp.set(lanes=64)``) ride along into the JSONL event.  Duration is
     available as ``sp.dur_s`` after exit.
+
+    When a trace context is active on the thread (ISSUE 4,
+    :mod:`deppy_tpu.telemetry.trace`), the span is stamped with
+    ``trace_id``/``span_id``/``parent_id`` on entry (nesting via the
+    thread's span stack) and its completed event joins the request's
+    trace; without one, behavior — and the emitted event — is
+    byte-identical to the pre-trace schema.
     """
 
-    __slots__ = ("name", "attrs", "_registry", "_t0", "dur_s")
+    __slots__ = ("name", "attrs", "_registry", "_t0", "dur_s",
+                 "trace_id", "span_id", "parent_id", "links")
 
     def __init__(self, registry: "Registry", name: str, attrs: dict):
         self.name = name
@@ -222,6 +230,10 @@ class Span:
         self._registry = registry
         self._t0 = 0.0
         self.dur_s = 0.0
+        self.trace_id: Optional[str] = None
+        self.span_id: Optional[str] = None
+        self.parent_id: Optional[str] = None
+        self.links: Optional[List[dict]] = None
 
     def __setitem__(self, key: str, value) -> None:
         self.attrs[key] = value
@@ -229,14 +241,31 @@ class Span:
     def set(self, **attrs) -> None:
         self.attrs.update(attrs)
 
+    def link(self, trace_id: str, span_id: Optional[str] = None) -> None:
+        """Record a span link (a causal reference to a span in another
+        trace — W3C/OTel links): how a coalesced dispatch points back at
+        every request it serves."""
+        if self.links is None:
+            self.links = []
+        link = {"trace_id": trace_id}
+        if span_id:
+            link["span_id"] = span_id
+        self.links.append(link)
+
     def __enter__(self) -> "Span":
+        from . import trace as _trace
+
         self._t0 = time.perf_counter()
+        _trace.enter_span(self)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        from . import trace as _trace
+
         self.dur_s = time.perf_counter() - self._t0
         if exc_type is not None:
             self.attrs["error"] = exc_type.__name__
+        _trace.exit_span(self)
         self._registry._record_span(self)
 
 
@@ -297,27 +326,52 @@ class Registry:
         return Span(self, name, attrs)
 
     def _record_span(self, span: Span) -> None:
+        from . import trace as _trace
+
         event = {"ts": round(time.time(), 3), "kind": "span",
                  "name": span.name, "dur_s": round(span.dur_s, 6),
                  "attrs": span.attrs}
+        _trace.note_span_event(span, event)
         with self._sink_lock:
             self._recent_spans.append(event)
             if len(self._recent_spans) > self._recent_cap:
                 del self._recent_spans[: -self._recent_cap]
         self.emit(event)
 
+    def record_span(self, name: str, dur_s: float, **attrs) -> None:
+        """Record a span whose duration was measured elsewhere (the
+        scheduler's queue-wait: the wait happens on the dispatch loop's
+        clock, the span belongs to the submitting request's trace).
+        Same stamping/sink path as a context-managed span."""
+        sp = Span(self, name, attrs)
+        sp.dur_s = dur_s
+        from . import trace as _trace
+
+        _trace.enter_span(sp)
+        _trace.exit_span(sp)
+        self._record_span(sp)
+
     def recent_spans(self) -> List[dict]:
         with self._sink_lock:
             return list(self._recent_spans)
 
     def event(self, kind: str, **fields) -> None:
-        """Emit one ad-hoc event to the JSONL sink (no-op without a
-        sink).  The fault-domain layer (ISSUE 2) uses this for
-        ``fault`` and ``breaker`` events; ``kind`` becomes the event's
-        ``kind`` field alongside the usual ``ts``."""
-        if self._sink_path is None:
+        """Emit one ad-hoc event to the JSONL sink, and — when a trace
+        context is active on this thread (ISSUE 4) — stamp it with the
+        trace's ids and attach it to the request's trace, sink or not.
+        The fault-domain layer (ISSUE 2) uses this for ``fault`` and
+        ``breaker`` events; ``kind`` becomes the event's ``kind`` field
+        alongside the usual ``ts``.  With neither a sink nor an active
+        trace this stays a two-branch no-op."""
+        from . import trace as _trace
+
+        traced = _trace.current_context() is not None
+        if self._sink_path is None and not traced:
             return
-        self.emit({"ts": round(time.time(), 3), "kind": kind, **fields})
+        event = {"ts": round(time.time(), 3), "kind": kind, **fields}
+        if traced:
+            _trace.stamp_event(event, kind)
+        self.emit(event)
 
     # --------------------------------------------------------------- sink
 

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Distributed-tracing smoke test (`make trace-smoke`, ISSUE 4 satellite).
+
+Boots the batch-resolution service on an ephemeral port with a generous
+coalescing window, fires two concurrent ``/v1/resolve`` clients carrying
+distinct W3C ``traceparent`` headers, and asserts the ISSUE 4 acceptance
+surface end to end:
+
+  * each response echoes its request's trace id
+    (``X-Deppy-Request-Id`` / ``traceparent`` response headers);
+  * ``GET /debug/traces?id=`` returns BOTH span trees, each containing a
+    ``service.request`` root, a ``sched.queue_wait`` leaf, and the
+    shared ``sched.dispatch`` trace grafted in with span links back to
+    both parent requests (the coalesced dispatch served both);
+  * every span's parent resolves inside the returned record (or via a
+    link) — no orphans;
+  * ``deppy_request_queue_wait_seconds`` and
+    ``deppy_request_total_seconds`` appear on ``/metrics``.
+
+Fast on purpose: host backend, no device compile — the full subsystem
+suite is ``make test-trace`` (tests/test_trace.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from http.client import HTTPConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def request(port: int, method: str, path: str, body=None, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    h = dict(headers or {})
+    if body is not None:
+        h["Content-Type"] = "application/json"
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=h)
+    resp = conn.getresponse()
+    data = resp.read()
+    hdrs = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, hdrs
+
+
+def main() -> int:
+    from deppy_tpu.service import Server
+
+    trace_ids = ["a1" * 16, "b2" * 16]
+    parents = ["c3" * 8, "d4" * 8]
+    docs = [
+        {"variables": [
+            {"id": f"app{i}", "constraints": [
+                {"type": "mandatory"},
+                {"type": "dependency", "ids": [f"lib{i}"]}]},
+            {"id": f"lib{i}"},
+        ]}
+        for i in range(2)
+    ]
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host", sched_max_wait_ms=300.0)
+    srv.start()
+    try:
+        out = [None, None]
+
+        def go(i):
+            out[i] = request(
+                srv.api_port, "POST", "/v1/resolve", docs[i],
+                {"traceparent": f"00-{trace_ids[i]}-{parents[i]}-01"})
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+
+        for i, (status, data, hdrs) in enumerate(out):
+            assert status == 200, f"client {i}: {status} {data!r}"
+            assert hdrs.get("X-Deppy-Request-Id") == trace_ids[i], hdrs
+            echoed = hdrs.get("traceparent", "")
+            assert echoed.startswith(f"00-{trace_ids[i]}-"), echoed
+
+        dispatch_roots = []
+        for i, tid in enumerate(trace_ids):
+            status, data, _ = request(srv.api_port, "GET",
+                                      f"/debug/traces?id={tid}")
+            assert status == 200, f"trace {tid} not retained: {data!r}"
+            trace = json.loads(data)["trace"]
+            spans = trace["spans"]
+            names = [sp["name"] for sp in spans]
+            assert "service.request" in names, names
+            assert "sched.queue_wait" in names, names
+            assert "sched.dispatch" in names, (
+                f"dispatch trace not mirrored into request {tid}: {names}")
+
+            # Parent linkage: every span resolves to an in-record parent,
+            # the inbound traceparent span, or (dispatch roots) a link.
+            by_id = {sp["span_id"]: sp for sp in spans}
+            for sp in spans:
+                parent = sp.get("parent_id")
+                if parent is None or parent in by_id or parent == parents[i]:
+                    continue
+                raise AssertionError(
+                    f"orphan span {sp['name']} (parent {parent}) "
+                    f"in trace {tid}")
+            root = [sp for sp in spans if sp["name"] == "service.request"][0]
+            assert root["parent_id"] == parents[i], (
+                "root must parent under the inbound traceparent span")
+            (dispatch,) = [sp for sp in spans
+                           if sp["name"] == "sched.dispatch"]
+            dispatch_roots.append(dispatch)
+
+        # The two requests rode ONE coalesced dispatch: both records
+        # contain the same dispatch span, and its links name both
+        # parent traces.
+        assert dispatch_roots[0]["span_id"] == dispatch_roots[1]["span_id"], (
+            "requests were not coalesced into one dispatch")
+        linked = {link["trace_id"] for link in dispatch_roots[0]["links"]}
+        assert linked == set(trace_ids), (
+            f"dispatch links {linked} != parent traces {set(trace_ids)}")
+
+        _, data, _ = request(srv.api_port, "GET", "/metrics")
+        text = data.decode()
+        for family in ("deppy_request_queue_wait_seconds",
+                       "deppy_request_total_seconds"):
+            assert f"# TYPE {family} histogram" in text, (
+                f"{family} missing from /metrics")
+            count = [line for line in text.splitlines()
+                     if line.startswith(f"{family}_count")]
+            assert count and float(count[0].rsplit(" ", 1)[1]) >= 2, count
+
+        print("trace-smoke: PASS (2 concurrent traced requests → one "
+              "coalesced dispatch; both span trees served from "
+              "/debug/traces with correct parent linkage and span "
+              "links; request latency histograms live on /metrics)")
+        return 0
+    finally:
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Doc-sync: docs/observability.md's metric tables vs the code (ISSUE 4
+satellite).
+
+The metric name tables drifted silently once (the sched families landed
+a PR before their rows did); this test makes the drift loud in both
+directions: every ``deppy_*`` metric family named in the
+telemetry/faults/sched/service/driver source must appear in
+docs/observability.md, and every family the doc names must still exist
+in the source.  Metric names are string literals at their registration
+(and mirror/render) sites, so a plain literal scan IS the registration
+surface — no solve or device work needed.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.trace
+
+REPO = Path(__file__).resolve().parent.parent
+DOC = REPO / "docs" / "observability.md"
+
+# The modules whose registered families the issue pins (telemetry /
+# faults / sched / service) plus the engine driver, which registers the
+# pipeline-global families the doc's second table lists.
+CODE_SCOPE = [
+    REPO / "deppy_tpu" / "telemetry",
+    REPO / "deppy_tpu" / "faults",
+    REPO / "deppy_tpu" / "sched",
+    REPO / "deppy_tpu" / "service.py",
+    REPO / "deppy_tpu" / "engine" / "driver.py",
+]
+
+_NAME = re.compile(r"deppy_[a-z0-9_]+")
+# Not metric families: the package name, and partial literals used to
+# build names ("deppy_cache_" + ...).
+_EXCLUDE = {"deppy_tpu"}
+
+
+def _names(text: str) -> set:
+    return {n for n in _NAME.findall(text)
+            if n not in _EXCLUDE and not n.endswith("_")}
+
+
+def _code_names() -> set:
+    out: set = set()
+    for scope in CODE_SCOPE:
+        files = [scope] if scope.is_file() else sorted(scope.glob("*.py"))
+        for path in files:
+            out |= _names(path.read_text(encoding="utf-8"))
+    return out
+
+
+def test_every_registered_family_is_documented():
+    documented = _names(DOC.read_text(encoding="utf-8"))
+    registered = _code_names()
+    missing = registered - documented
+    assert not missing, (
+        f"metric families registered in code but absent from "
+        f"docs/observability.md: {sorted(missing)} — add them to the "
+        f"metric name tables")
+
+
+def test_every_documented_family_exists_in_code():
+    documented = _names(DOC.read_text(encoding="utf-8"))
+    registered = _code_names()
+    stale = documented - registered
+    assert not stale, (
+        f"metric families documented in docs/observability.md but no "
+        f"longer present in code: {sorted(stale)} — delete or rename "
+        f"the doc rows")
+
+
+def test_scan_scope_is_sane():
+    """Guard the scanner itself: the core families must be visible to
+    both sides, or the two assertions above could pass vacuously."""
+    registered = _code_names()
+    assert {"deppy_resolutions_total", "deppy_breaker_state",
+            "deppy_sched_dispatches_total",
+            "deppy_request_queue_wait_seconds"} <= registered

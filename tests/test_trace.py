@@ -1,0 +1,683 @@
+"""Per-request distributed tracing + flight recorder (ISSUE 4).
+
+The acceptance contract, from the issue:
+
+  * trace/request ids are minted per ``/v1/resolve`` (inbound W3C
+    ``traceparent`` / ``X-Deppy-Request-Id`` honored and echoed) and
+    propagate through a coalesced dispatch, whose root span records
+    span links to every parent request it serves;
+  * the flight recorder retains the last-N completed request traces
+    plus ALL errored traces (ring eviction never drops an error), and
+    serves them at ``GET /debug/traces`` (+ ``?id=`` lookup);
+  * with no tracing headers sent, ``/v1/resolve`` response bodies are
+    byte-identical to pre-trace behavior; ``X-Deppy-Timings: 1`` opts
+    into the queue-wait/dispatch/solve/decode breakdown;
+  * ``deppy trace ID`` reconstructs the same span tree from the JSONL
+    sink, fault events included.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.client import HTTPConnection
+
+import pytest
+
+from deppy_tpu import faults, telemetry
+from deppy_tpu.service import Server
+from deppy_tpu.telemetry import trace
+
+pytestmark = pytest.mark.trace
+
+
+@pytest.fixture(autouse=True)
+def fresh_trace_state():
+    """Isolate the process-global registry, breaker, fault plan, and
+    flight recorder per test (same contract as the chaos/sched suites)."""
+    prev_breaker = faults.set_default_breaker(faults.CircuitBreaker())
+    prev_plan = faults.configure_plan(None)
+    prev_reg = telemetry.set_default_registry(telemetry.Registry())
+    prev_rec = trace.set_default_recorder(trace.FlightRecorder())
+    yield
+    trace.set_default_recorder(prev_rec)
+    telemetry.set_default_registry(prev_reg)
+    faults.configure_plan(prev_plan)
+    faults.set_default_breaker(prev_breaker)
+
+
+def request(port, method, path, body=None, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    h = dict(headers or {})
+    if body is not None:
+        h["Content-Type"] = "application/json"
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=h)
+    resp = conn.getresponse()
+    data = resp.read()
+    hdrs = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, hdrs
+
+
+def _doc(i):
+    return {"variables": [
+        {"id": f"a{i}", "constraints": [
+            {"type": "mandatory"},
+            {"type": "dependency", "ids": ["b", "c"]}]},
+        {"id": "b"}, {"id": "c"},
+    ]}
+
+
+def _problem_vars(ident):
+    from deppy_tpu import io as problem_io
+
+    return problem_io.problems_from_document(
+        {"variables": [{"id": ident,
+                        "constraints": [{"type": "mandatory"}]}]})[0]
+
+
+def _server(**kw):
+    kw.setdefault("bind_address", "127.0.0.1:0")
+    kw.setdefault("probe_address", "127.0.0.1:0")
+    kw.setdefault("backend", "host")
+    return Server(**kw)
+
+
+# ------------------------------------------------------------- id plumbing
+
+
+class TestTraceparent:
+    def test_valid_header_parses(self):
+        tid, sid = trace.parse_traceparent(f"00-{'ab' * 16}-{'cd' * 8}-01")
+        assert tid == "ab" * 16 and sid == "cd" * 8
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-e1e1e1e1e1e1e1e1-01",
+        f"00-{'0' * 32}-{'cd' * 8}-01",          # all-zero trace id
+        f"00-{'ab' * 16}-{'0' * 16}-01",          # all-zero span id
+        f"ff-{'ab' * 16}-{'cd' * 8}-01",          # reserved version
+        f"00-{'AB' * 16}",                        # too few fields
+        f"00-{'zz' * 16}-{'cd' * 8}-01",          # non-hex
+    ])
+    def test_malformed_headers_rejected(self, bad):
+        assert trace.parse_traceparent(bad) is None
+
+    def test_minted_ids_are_well_formed(self):
+        ctx = trace.context_from_headers(None, None)
+        assert len(ctx.trace_id) == 32
+        assert ctx.request_id == ctx.trace_id
+        assert ctx.parent_span_id is None
+
+    def test_request_id_header_is_honored_verbatim(self):
+        ctx = trace.context_from_headers(None, "my-req-7")
+        assert ctx.request_id == "my-req-7"
+        assert len(ctx.trace_id) == 32  # minted: not a valid trace id
+        hexid = "ab" * 16
+        assert trace.context_from_headers(None, hexid).trace_id == hexid
+
+
+class TestSpanStamping:
+    def test_spans_nest_and_stamp_only_under_a_context(self):
+        reg = telemetry.default_registry()
+        with reg.span("outside"):
+            pass
+        assert "trace_id" not in reg.recent_spans()[-1]
+
+        ctx = trace.TraceContext()
+        with trace.activate(ctx):
+            with reg.span("root") as root:
+                with reg.span("child") as child:
+                    pass
+        assert root.trace_id == ctx.trace_id
+        assert child.parent_id == root.span_id
+        assert root.span_id == ctx.root_span_id
+        names = [sp["name"] for sp in ctx.spans]
+        assert names == ["child", "root"]  # completion order
+
+    def test_events_stamp_and_mark_error(self):
+        ctx = trace.TraceContext()
+        reg = telemetry.default_registry()
+        with trace.activate(ctx):
+            with reg.span("work"):
+                faults.note_deadline_exceeded("tests.trace")
+        assert ctx.error
+        (ev,) = ctx.events
+        assert ev["kind"] == "fault" and ev["trace_id"] == ctx.trace_id
+        assert ev["parent_id"] == ctx.spans[-1]["span_id"]
+
+    def test_benign_breaker_transitions_do_not_mark_error(self):
+        ctx = trace.TraceContext()
+        reg = telemetry.default_registry()
+        with trace.activate(ctx):
+            reg.event("breaker", state="half_open")
+            reg.event("breaker", state="closed")
+            assert not ctx.error  # recovery is not an incident
+            reg.event("breaker", state="open")
+            assert ctx.error
+
+    def test_deadline_fault_does_not_poison_coalesced_batchmates(self):
+        """A deadline fault raised under a shared dispatch rides every
+        parent's tree but flags NO batchmate; a dispatch fault (device
+        failure) flags all riders; a deadline fault on a request's own
+        trace flags it."""
+        reg = telemetry.default_registry()
+        a, b = trace.TraceContext(), trace.TraceContext()
+        with trace.dispatch_scope([(a, None), (b, None)]) as dctx:
+            faults.note_deadline_exceeded("tests.trace")
+            assert not a.error and not b.error
+            assert any(e["fault"] == "deadline_exceeded"
+                       for e in a.events)  # event still on the tree
+            reg.event("fault", fault="dispatch_failed", attempt=1)
+            assert a.error and b.error
+        assert dctx is not None
+        own = trace.TraceContext()
+        with trace.activate(own):
+            faults.note_deadline_exceeded("tests.trace")
+        assert own.error
+
+    def test_mark_error_attributes_expired_lane_to_its_request(self):
+        """Scheduler path: the request whose lane expired is flagged;
+        the live batchmate is not (ISSUE 3 isolation, per-trace)."""
+        from deppy_tpu.sched import Scheduler
+
+        sched = Scheduler(backend="host", max_wait_ms=250.0, cache_size=0)
+        sched.start()
+        try:
+            ctxs = {}
+
+            def submit(tag, deadline):
+                ctx = trace.TraceContext()
+                ctxs[tag] = ctx
+                with trace.activate(ctx):
+                    sched.submit([_problem_vars(tag)],
+                                 deadline_s=deadline)
+
+            t1 = threading.Thread(target=submit, args=("dead", 0.02))
+            t2 = threading.Thread(target=submit, args=("live", None))
+            t1.start()
+            t2.start()
+            t1.join(30)
+            t2.join(30)
+            assert ctxs["dead"].error
+            assert not ctxs["live"].error
+        finally:
+            sched.stop()
+
+    def test_budget_exhaustion_is_not_flagged_as_incident(self):
+        """An Incomplete from step-budget exhaustion (deadline never
+        triaged) must not enter the error ring as a deadline incident."""
+        from deppy_tpu.sched import Scheduler
+
+        sched = Scheduler(backend="host", max_wait_ms=0.0, cache_size=0)
+        sched.start()
+        try:
+            from deppy_tpu import io as problem_io
+            from deppy_tpu.sat.errors import Incomplete
+
+            hard = problem_io.problems_from_document({"variables": [
+                {"id": "x", "constraints": [
+                    {"type": "mandatory"},
+                    {"type": "dependency", "ids": ["y", "z"]}]},
+                {"id": "y", "constraints": [{"type": "dependency",
+                                             "ids": ["w"]}]},
+                {"id": "z"},
+                {"id": "w", "constraints": [{"type": "conflict",
+                                             "id": "z"}]},
+            ]})[0]
+            ctx = trace.TraceContext()
+            with trace.activate(ctx):
+                (res,) = sched.submit([hard], deadline_s=30.0,
+                                      max_steps=3)
+            assert isinstance(res, Incomplete)
+            assert not ctx.error
+        finally:
+            sched.stop()
+
+
+# --------------------------------------------- coalesced dispatch + links
+
+
+class TestCoalescedPropagation:
+    def test_two_request_group_gets_span_links_and_mirrored_spans(self):
+        """ISSUE 4 pin: a dispatch serving 2 requests links to both
+        parents, and each request's trace contains the dispatch tree."""
+        srv = _server(sched_max_wait_ms=300.0)
+        srv.start()
+        try:
+            tids = ["a1" * 16, "b2" * 16]
+            out = [None, None]
+
+            def go(i):
+                out[i] = request(
+                    srv.api_port, "POST", "/v1/resolve", _doc(i),
+                    {"traceparent": f"00-{tids[i]}-{'cd' * 8}-01"})
+
+            threads = [threading.Thread(target=go, args=(i,))
+                       for i in (0, 1)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30)
+            assert [o[0] for o in out] == [200, 200]
+            # Echoed ids.
+            for i, (_, _, hdrs) in enumerate(out):
+                assert hdrs["X-Deppy-Request-Id"] == tids[i]
+                assert hdrs["traceparent"].startswith(f"00-{tids[i]}-")
+
+            recorder = trace.default_recorder()
+            dispatch_ids = set()
+            for tid in tids:
+                rec = recorder.get(tid)
+                assert rec is not None
+                names = {sp["name"] for sp in rec["spans"]}
+                assert {"service.request", "sched.queue_wait",
+                        "sched.dispatch"} <= names
+                (dispatch,) = [sp for sp in rec["spans"]
+                               if sp["name"] == "sched.dispatch"]
+                assert {link["trace_id"] for link in dispatch["links"]} \
+                    == set(tids)
+                dispatch_ids.add(dispatch["span_id"])
+            assert len(dispatch_ids) == 1  # one shared dispatch
+        finally:
+            srv.shutdown()
+
+    def test_unscheduled_path_nests_driver_spans_in_request_trace(self):
+        srv = _server(sched="off")
+        srv.start()
+        try:
+            tid = "3c" * 16
+            status, _, _ = request(
+                srv.api_port, "POST", "/v1/resolve", _doc(0),
+                {"traceparent": f"00-{tid}-{'cd' * 8}-01"})
+            assert status == 200
+            rec = trace.default_recorder().get(tid)
+            assert rec is not None
+            assert all(sp["trace_id"] == tid for sp in rec["spans"])
+            assert {sp["name"] for sp in rec["spans"]} \
+                >= {"service.request"}
+        finally:
+            srv.shutdown()
+
+    def test_fault_events_ride_the_request_trace(self):
+        """Retry/fallback attempts stamped onto the span tree: a
+        scripted dispatch failure shows up as fault events in the
+        request's flight record, and the errored trace is retained."""
+        faults.configure_plan(faults.plan_from_spec(
+            '[{"point": "sched.dispatch", "kind": "error", "times": 1}]'))
+        srv = _server(sched_max_wait_ms=10.0)
+        srv.start()
+        try:
+            tid = "4d" * 16
+            status, _, _ = request(
+                srv.api_port, "POST", "/v1/resolve", _doc(0),
+                {"traceparent": f"00-{tid}-{'cd' * 8}-01"})
+            # The injected sched.dispatch fault fails the whole request
+            # (500) — the point is the trace, not the outcome.
+            assert status == 500
+            rec = trace.default_recorder().get(tid)
+            assert rec is not None and rec["error"]
+            # Errored traces live in the error ring: they survive any
+            # amount of healthy traffic.
+            for i in range(trace.default_recorder().capacity + 5):
+                request(srv.api_port, "POST", "/v1/resolve", _doc(i))
+            assert trace.default_recorder().get(tid) is not None
+        finally:
+            srv.shutdown()
+
+
+# ----------------------------------------------------------- flight ring
+
+
+class TestFlightRecorder:
+    def test_ring_eviction_and_error_retention(self):
+        rec = trace.FlightRecorder(capacity=2, error_capacity=3)
+        ctxs = [trace.TraceContext() for _ in range(3)]
+        for ctx in ctxs:
+            rec.record(ctx, status=200)
+        assert rec.get(ctxs[0].trace_id) is None  # evicted
+        assert rec.get(ctxs[1].trace_id) is not None
+        assert rec.get(ctxs[2].trace_id) is not None
+
+        err = trace.TraceContext()
+        err.error = True
+        rec.record(err, status=200)
+        for _ in range(5):
+            rec.record(trace.TraceContext(), status=200)
+        assert rec.get(err.trace_id) is not None  # error ring retains
+        bad = trace.TraceContext()
+        rec.record(bad, status=500)  # HTTP failure counts as errored
+        assert rec.get(bad.trace_id)["error"] is True
+        shed = trace.TraceContext()
+        rec.record(shed, status=503)  # deliberate load shed: NOT errored
+        assert rec.get(shed.trace_id)["error"] is False
+
+    def test_lookup_by_request_id(self):
+        rec = trace.FlightRecorder(capacity=4)
+        ctx = trace.TraceContext(request_id="client-id-9")
+        rec.record(ctx, status=200)
+        assert rec.get("client-id-9")["trace_id"] == ctx.trace_id
+
+    def test_shared_trace_id_records_do_not_clobber(self):
+        """Several requests under ONE inbound W3C trace id (a proxy
+        fan-out) must each keep their record — and a later success must
+        never replace an earlier errored record in the error ring."""
+        rec = trace.FlightRecorder(capacity=4, error_capacity=4)
+        tid = "ab" * 16
+        first = trace.TraceContext(trace_id=tid)
+        first.error = True
+        rec.record(first, status=500)
+        second = trace.TraceContext(trace_id=tid)
+        rec.record(second, status=200)
+        retained = [t for t in rec.traces() if t["trace_id"] == tid]
+        assert len(retained) == 2
+        assert {t["status"] for t in retained} == {500, 200}
+        # Lookup by the shared id returns the most recent; the errored
+        # record survives in the error ring regardless.
+        assert rec.get(tid)["status"] == 200
+        assert any(t["error"] for t in rec.traces())
+
+    def test_dump_writes_trace_events_to_sink(self, tmp_path):
+        sink = tmp_path / "t.jsonl"
+        telemetry.configure_sink(str(sink))
+        rec = trace.FlightRecorder(capacity=4)
+        ctx = trace.TraceContext()
+        with trace.activate(ctx):
+            with telemetry.default_registry().span("work"):
+                pass
+        rec.record(ctx, status=200)
+        assert rec.dump(reason="test") == 1
+        events = [json.loads(line)
+                  for line in sink.read_text().splitlines()]
+        dumps = [e for e in events if e["kind"] == "trace"]
+        assert len(dumps) == 1
+        assert dumps[0]["reason"] == "test"
+        assert dumps[0]["trace"]["trace_id"] == ctx.trace_id
+
+    def test_errored_trace_written_to_sink_at_completion(self, tmp_path):
+        """The requests that trip a breaker finish recording AFTER the
+        trip — they reach the sink via the record-time errored-trace
+        write, not the dump."""
+        sink = tmp_path / "t.jsonl"
+        telemetry.configure_sink(str(sink))
+        rec = trace.FlightRecorder(capacity=4)
+        ok = trace.TraceContext()
+        rec.record(ok, status=200)
+        bad = trace.TraceContext()
+        bad.error = True
+        rec.record(bad, status=200)
+        events = [json.loads(line)
+                  for line in sink.read_text().splitlines()]
+        dumps = [e for e in events if e["kind"] == "trace"]
+        assert [d["trace"]["trace_id"] for d in dumps] == [bad.trace_id]
+        assert dumps[0]["reason"] == "error"
+
+    def test_cli_trace_resolves_request_id_from_live_spans(
+            self, tmp_path, capsys):
+        """A client-chosen X-Deppy-Request-Id resolves from live sink
+        lines alone — no flight-recorder dump required."""
+        from deppy_tpu.cli import main
+
+        sink = tmp_path / "t.jsonl"
+        telemetry.configure_sink(str(sink))
+        srv = _server(sched_max_wait_ms=10.0)
+        srv.start()
+        try:
+            request(srv.api_port, "POST", "/v1/resolve", _doc(0),
+                    {"X-Deppy-Request-Id": "my-req-77"})
+        finally:
+            srv.shutdown()
+        assert main(["trace", "my-req-77", "--file", str(sink)]) == 0
+        out = capsys.readouterr().out
+        assert "service.request" in out
+        assert "request my-req-77" in out
+
+    def test_breaker_open_dumps_recorder_on_fresh_trip_only(self, tmp_path):
+        sink = tmp_path / "t.jsonl"
+        telemetry.configure_sink(str(sink))
+        ctx = trace.TraceContext()
+        trace.default_recorder().record(ctx, status=200)
+        clock = [0.0]
+        breaker = faults.CircuitBreaker(failure_threshold=1,
+                                        reset_after_s=5.0,
+                                        clock=lambda: clock[0])
+        faults.set_default_breaker(breaker)
+        breaker.record_failure()  # fresh trip (closed → open) → dump
+
+        def dump_count():
+            return sum(1 for line in sink.read_text().splitlines()
+                       if json.loads(line).get("reason") == "breaker_open")
+
+        assert dump_count() == 1
+        # Flapping: cooldown elapses, the half-open probe fails, the
+        # breaker re-opens — but a hard-down accelerator must not
+        # re-dump the whole ring every cycle.
+        for _ in range(3):
+            clock[0] += 6.0
+            assert breaker.allow()  # claims the half-open probe slot
+            breaker.record_failure()
+        assert dump_count() == 1
+        # Recovery then a fresh trip dumps again.
+        clock[0] += 6.0
+        assert breaker.allow()
+        breaker.record_success()
+        breaker.record_failure()
+        assert dump_count() == 2
+
+
+# -------------------------------------------------- response byte identity
+
+
+class TestByteIdentity:
+    def test_header_free_responses_match_unscheduled_path(self):
+        """No tracing headers → bodies byte-identical across the
+        scheduled path, the unscheduled path, and repeats; no timings
+        key ever appears uninvited."""
+        sched_srv = _server(sched_max_wait_ms=50.0)
+        plain_srv = _server(sched="off")
+        sched_srv.start()
+        plain_srv.start()
+        try:
+            for doc in (_doc(0), {"problems": [_doc(1), _doc(2)]}):
+                s = request(sched_srv.api_port, "POST", "/v1/resolve", doc)
+                p = request(plain_srv.api_port, "POST", "/v1/resolve", doc)
+                assert s[1] == p[1]
+                assert b"timings" not in s[1]
+                assert b"trace" not in s[1]
+                # Headers too: no echo without an inbound tracing header.
+                for hdrs in (s[2], p[2]):
+                    assert "X-Deppy-Request-Id" not in hdrs
+                    assert "traceparent" not in hdrs
+        finally:
+            sched_srv.shutdown()
+            plain_srv.shutdown()
+
+    def test_timings_opt_in(self):
+        srv = _server(sched_max_wait_ms=10.0)
+        srv.start()
+        try:
+            status, data, _ = request(srv.api_port, "POST", "/v1/resolve",
+                                      _doc(0), {"X-Deppy-Timings": "1"})
+            assert status == 200
+            body = json.loads(data)
+            timings = body["timings"]
+            assert {"queue_wait_s", "dispatch_s", "solve_s",
+                    "total_s"} <= set(timings)
+            assert timings["total_s"] >= timings["queue_wait_s"] >= 0.0
+            # Same doc without the header: breakdown gone, results equal.
+            _, data2, _ = request(srv.api_port, "POST", "/v1/resolve",
+                                  _doc(0))
+            body2 = json.loads(data2)
+            assert "timings" not in body2
+            assert body2["results"] == body["results"]
+        finally:
+            srv.shutdown()
+
+    def test_request_histograms_observe(self):
+        srv = _server(sched_max_wait_ms=10.0)
+        srv.start()
+        try:
+            request(srv.api_port, "POST", "/v1/resolve", _doc(0))
+            _, data, _ = request(srv.api_port, "GET", "/metrics")
+            text = data.decode()
+            for family in ("deppy_request_total_seconds",
+                           "deppy_request_queue_wait_seconds"):
+                (count,) = [line for line in text.splitlines()
+                            if line.startswith(f"{family}_count")]
+                assert float(count.rsplit(" ", 1)[1]) >= 1
+        finally:
+            srv.shutdown()
+
+
+# ----------------------------------------------------------- debug + CLI
+
+
+class TestDebugEndpointAndCLI:
+    def test_debug_traces_index_and_lookup(self):
+        srv = _server(sched_max_wait_ms=10.0)
+        srv.start()
+        try:
+            tid = "5e" * 16
+            request(srv.api_port, "POST", "/v1/resolve", _doc(0),
+                    {"traceparent": f"00-{tid}-{'cd' * 8}-01"})
+            status, data, _ = request(srv.api_port, "GET", "/debug/traces")
+            assert status == 200
+            index = json.loads(data)["traces"]
+            assert any(t["trace_id"] == tid for t in index)
+            status, data, _ = request(srv.api_port, "GET",
+                                      f"/debug/traces?id={tid}")
+            assert status == 200
+            assert json.loads(data)["trace"]["trace_id"] == tid
+            status, _, _ = request(srv.api_port, "GET",
+                                   "/debug/traces?id=nope")
+            assert status == 404
+        finally:
+            srv.shutdown()
+
+    def test_cli_trace_reconstructs_tree_from_sink(self, tmp_path, capsys):
+        from deppy_tpu.cli import main
+
+        sink = tmp_path / "t.jsonl"
+        telemetry.configure_sink(str(sink))
+        srv = _server(sched_max_wait_ms=10.0)
+        srv.start()
+        tid = "6f" * 16
+        try:
+            request(srv.api_port, "POST", "/v1/resolve", _doc(0),
+                    {"traceparent": f"00-{tid}-{'cd' * 8}-01"})
+        finally:
+            srv.shutdown()
+        assert main(["trace", tid, "--file", str(sink)]) == 0
+        out = capsys.readouterr().out
+        assert "service.request" in out
+        assert "sched.dispatch" in out  # grafted via the span link
+        assert "sched.queue_wait" in out
+        # Unknown id is a usage error.
+        assert main(["trace", "ffff", "--file", str(sink)]) == 2
+
+    def test_stats_percentiles_and_span_filter(self, tmp_path, capsys):
+        from deppy_tpu.cli import main
+
+        path = tmp_path / "t.jsonl"
+        events = [{"ts": i, "kind": "span", "name": "driver.solve",
+                   "dur_s": dur, "attrs": {}}
+                  for i, dur in enumerate([0.1] * 98 + [1.0, 10.0])]
+        events.append({"ts": 99, "kind": "span", "name": "other",
+                       "dur_s": 0.5, "attrs": {}})
+        path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+
+        assert main(["stats", str(path), "--output", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        agg = doc["spans"]["driver.solve"]
+        assert agg["count"] == 100
+        assert agg["p50_s"] == pytest.approx(0.1)
+        assert agg["p95_s"] == pytest.approx(0.1)
+        assert agg["p99_s"] == pytest.approx(1.0)
+        assert "other" in doc["spans"]
+
+        assert main(["stats", str(path), "--span", "driver.solve",
+                     "--output", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert list(doc["spans"]) == ["driver.solve"]
+        assert doc["last_report"] is None  # --span filters both formats
+
+        assert main(["stats", str(path), "--span", "driver.solve"]) == 0
+        text = capsys.readouterr().out
+        assert "p95_ms" in text and "driver.solve" in text
+        assert "other" not in text
+
+    def test_cli_trace_dedupes_dumped_fault_events(self, tmp_path, capsys):
+        """A fault event present both as a live stamped sink line and
+        inside a flight-recorder dump of the same trace prints once."""
+        from deppy_tpu.cli import main
+
+        tid, root = "7a" * 16, "8b" * 8
+        span = {"ts": 1.0, "kind": "span", "name": "service.request",
+                "dur_s": 0.5, "attrs": {}, "trace_id": tid,
+                "span_id": root}
+        fault = {"ts": 1.1, "kind": "fault", "fault": "dispatch_failed",
+                 "attempt": 1, "trace_id": tid, "parent_id": root}
+        dump = {"ts": 2.0, "kind": "trace", "reason": "sigusr2",
+                "trace": {"trace_id": tid, "request_id": tid,
+                          "spans": [span], "events": [fault]}}
+        path = tmp_path / "t.jsonl"
+        path.write_text("\n".join(json.dumps(e)
+                                  for e in (span, fault, dump)) + "\n")
+        assert main(["trace", tid, "--file", str(path),
+                     "--output", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["events"]) == 1
+        assert len(doc["spans"]) == 1
+
+    def test_cli_trace_keeps_distinct_identical_looking_events(
+            self, tmp_path, capsys):
+        """Two genuinely distinct fault events with identical fields
+        (two lanes expiring in the same ms) carry distinct ``seq``
+        stamps and must both survive the dump dedup."""
+        from deppy_tpu.cli import main
+
+        tid, root = "9c" * 16, "8b" * 8
+        span = {"ts": 1.0, "kind": "span", "name": "service.request",
+                "dur_s": 0.5, "attrs": {}, "trace_id": tid,
+                "span_id": root}
+        faults_ = [{"ts": 1.1, "kind": "fault",
+                    "fault": "deadline_exceeded", "trace_id": tid,
+                    "parent_id": root, "seq": s} for s in (7, 8)]
+        path = tmp_path / "t.jsonl"
+        path.write_text("\n".join(
+            json.dumps(e) for e in [span] + faults_) + "\n")
+        assert main(["trace", tid, "--file", str(path),
+                     "--output", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["events"]) == 2
+
+    def test_cli_trace_prints_events_with_missing_parent_spans(
+            self, tmp_path, capsys):
+        """A fault event whose parent span never completed (crash
+        mid-span) still shows in the text tree, not just the JSON."""
+        from deppy_tpu.cli import main
+
+        tid = "ad" * 16
+        span = {"ts": 1.0, "kind": "span", "name": "service.request",
+                "dur_s": 0.5, "attrs": {}, "trace_id": tid,
+                "span_id": "8b" * 8}
+        orphan = {"ts": 1.1, "kind": "fault", "fault": "dispatch_failed",
+                  "trace_id": tid, "parent_id": "ff" * 8, "seq": 1}
+        path = tmp_path / "t.jsonl"
+        path.write_text("\n".join(json.dumps(e)
+                                  for e in (span, orphan)) + "\n")
+        assert main(["trace", tid, "--file", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "unattached events:" in out
+        assert "dispatch_failed" in out
+
+    def test_live_duplicate_fault_events_get_distinct_seqs(self):
+        ctx = trace.TraceContext()
+        reg = telemetry.default_registry()
+        with trace.activate(ctx):
+            with reg.span("work"):
+                faults.note_deadline_exceeded("tests.trace")
+                faults.note_deadline_exceeded("tests.trace")
+        seqs = [ev["seq"] for ev in ctx.events]
+        assert len(set(seqs)) == 2
