@@ -68,6 +68,14 @@ trace-smoke: ## Two concurrent traced requests against a live server: assert /de
 test-trace: ## Distributed-tracing subsystem tests only (the `trace` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m trace
 
+.PHONY: hostpool-smoke
+hostpool-smoke: ## Multicore host-pool end-to-end: pool-vs-inline bit-identity, mid-batch worker crash, breaker-open sched drain (ISSUE 5 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/hostpool_smoke.py
+
+.PHONY: test-hostpool
+test-hostpool: ## Host worker-pool subsystem tests only (the `hostpool` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m hostpool
+
 ##@ Benchmarks
 
 .PHONY: bench

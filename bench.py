@@ -51,6 +51,19 @@ LADDER_LOG = os.environ.get("DEPPY_TPU_REVAL_LOG",
 LADDER_FRESH_S = float(os.environ.get("DEPPY_BENCH_LADDER_FRESH_S",
                                       str(3 * 3600)))
 ARM_LADDER = os.environ.get("DEPPY_BENCH_ARM_LADDER", "1") != "0"
+# Probe-verdict cache (ISSUE 5 satellite).  BENCH_r05 burned ~10 minutes
+# per run on a KNOWN-dead worker: 4 hung 90s probes with 60s waits
+# between them, every invocation, while the wedge lasted hours.  The
+# last verdict is cached to a file with a TTL; while a fresh "dead"
+# verdict stands, a bench run spends at most ONE live probe confirming
+# it before dropping to the host/CPU path.  A healthy verdict is never
+# trusted blind — the live probe still runs (a fresh crash must not
+# misroute the workload) — so the cache only ever removes the
+# pathological retry-wait loop, never real evidence.
+PROBE_CACHE = os.environ.get("DEPPY_BENCH_PROBE_CACHE",
+                             "/tmp/deppy_probe_cache.json")
+PROBE_CACHE_TTL_S = float(os.environ.get("DEPPY_BENCH_PROBE_CACHE_TTL",
+                                         str(30 * 60)))
 
 def _cpu_env() -> dict:
     """Environment forcing the single-device virtual-CPU platform."""
@@ -100,6 +113,47 @@ def _probe_once() -> "tuple[str | None, str]":
     return backend or None, "ok" if backend else "error"
 
 
+def _read_probe_cache() -> dict | None:
+    """The cached probe verdict, iff fresh within PROBE_CACHE_TTL_S.
+    Shape: {"verdict": "dead"|"ok", "backend": ..., "status": ...,
+    "ts": unix-seconds}.  Any read/parse problem means no cache — the
+    cache can only ever skip retries, never fabricate a verdict."""
+    import time
+
+    if not PROBE_CACHE:
+        return None
+    try:
+        with open(PROBE_CACHE) as f:
+            doc = json.load(f)
+        age = time.time() - float(doc["ts"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    if not isinstance(doc, dict) or doc.get("verdict") not in ("dead", "ok"):
+        return None
+    # -1s tolerance: the write rounds ts, which can land up to 50ms in
+    # the future (the same pitfall _newest_record documents); anything
+    # further future-dated is bogus.
+    if not (-1 <= age <= PROBE_CACHE_TTL_S):
+        return None
+    doc["age_s"] = round(age, 1)
+    return doc
+
+
+def _write_probe_cache(verdict: str, backend: str | None,
+                       status: str) -> None:
+    import time
+
+    if not PROBE_CACHE:
+        return
+    try:
+        with open(PROBE_CACHE, "w") as f:
+            json.dump({"verdict": verdict, "backend": backend,
+                       "status": status, "ts": round(time.time(), 1)}, f)
+            f.write("\n")
+    except OSError as exc:
+        _log(f"could not write probe cache: {exc}")
+
+
 def _probe_accelerator() -> str | None:
     """Return the backend name once a non-CPU backend initializes, retrying
     across worker restarts (see PROBE_RETRIES above).  A "cpu" probe result
@@ -109,24 +163,45 @@ def _probe_accelerator() -> str | None:
     exhausted.  A COMPUTE-stage hang ends the loop immediately: that
     wedge has only ever cleared on an hours scale (BASELINE.md round-3
     notes), so minutes of retries would be pure waste — go straight to
-    the CPU fallback."""
+    the CPU fallback.
+
+    A fresh cached "dead" verdict (see PROBE_CACHE above) shrinks the
+    budget to ONE live probe with no retry waits: the worker was known
+    wedged minutes ago, and burning 4x90s probes re-learning that was
+    BENCH_r05's dominant waste.  Every final verdict is written back,
+    so consecutive bench runs against a dead worker pay ~90s, not ~10
+    minutes."""
     import time
 
+    retries = PROBE_RETRIES
+    cached = _read_probe_cache()
+    if cached is not None and cached["verdict"] == "dead":
+        _log(f"probe cache: worker dead {cached['age_s']}s ago "
+             f"(status {cached.get('status')}); single confirming probe")
+        retries = 1
     last = None
-    for attempt in range(PROBE_RETRIES):
+    last_status = "error"
+    for attempt in range(retries):
         backend, status = _probe_once()
+        last_status = status
         if backend and backend != "cpu":
+            _write_probe_cache("ok", backend, status)
             return backend
         if status == "compute-hang":
             _log("compute-stage wedge is hours-scale; skipping retries")
+            _write_probe_cache("dead", last, status)
             return last
         last = backend or last
-        if attempt < PROBE_RETRIES - 1:
+        if attempt < retries - 1:
             _log(
                 f"waiting {PROBE_RETRY_DELAY_S}s for a possible worker "
-                f"restart (attempt {attempt + 1}/{PROBE_RETRIES})"
+                f"restart (attempt {attempt + 1}/{retries})"
             )
             time.sleep(PROBE_RETRY_DELAY_S)
+    # A resolved-to-CPU machine is "ok, cpu" (no accelerator to wait
+    # out); anything else is the outage signature.
+    _write_probe_cache("ok" if last == "cpu" else "dead", last,
+                       last_status)
     return last
 
 

@@ -22,6 +22,7 @@ Layers (bottom-up, mirroring SURVEY.md §1):
 
 __version__ = "0.1.0"
 
-from . import entity, models, resolution, sat, utils
+from . import entity, hostpool, models, resolution, sat, utils
 
-__all__ = ["entity", "models", "resolution", "sat", "utils", "__version__"]
+__all__ = ["entity", "hostpool", "models", "resolution", "sat", "utils",
+           "__version__"]

@@ -105,6 +105,8 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
         f"device: {n} in {dev_s:.3f}s = {rate:.1f}/s "
         f"({n_sat} sat / {n_unsat} unsat; warm-up {warm_s:.1f}s)"
     )
+    from .. import hostpool
+
     out = {
         "n_problems": n,
         "host_s_per_problem": host_s,
@@ -115,6 +117,14 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
         # the backend first — this harness or an earlier probe_wall_s()
         # caller — the measured init cost rides every record.
         "probe_wall_s": probe_s,
+        # Host-path concurrency (ISSUE 5 satellite): the worker-pool
+        # size the breaker-open / host-backend path would use under this
+        # record's configuration (0 = inline serial engine).  The serial
+        # host_s_per_problem sample above is deliberately per-CORE — the
+        # pool speedup itself is tracked by
+        # benchmarks/results/hostpool_baseline.json (host_baseline
+        # --pool), not folded into the device-vs-host ratio.
+        "host_workers": hostpool.effective_workers(),
         "sat": n_sat,
         "unsat": n_unsat,
     }

@@ -68,6 +68,8 @@ def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
         # multi-minute probe/retry stalls were invisible without these.
         "probe_wall_s": round(probe_s, 3),
         "warmup_seconds": round(m["warmup_seconds"], 3),
+        # Host-path pool size (ISSUE 5 satellite; 0 = inline serial).
+        "host_workers": m["host_workers"],
     }
     if "telemetry" in m:
         # Occupancy and fallback columns ride in every BENCH row (ISSUE

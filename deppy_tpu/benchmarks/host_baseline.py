@@ -33,6 +33,8 @@ RESULTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "..", "..", "benchmarks", "results")
 BASELINE_PATH = os.path.abspath(
     os.path.join(RESULTS_DIR, "host_baseline.json"))
+HOSTPOOL_PATH = os.path.abspath(
+    os.path.join(RESULTS_DIR, "hostpool_baseline.json"))
 
 
 def machine_key() -> str:
@@ -96,6 +98,71 @@ def measure(length: int = 48, sample_n: int = 24, passes: int = 30) -> dict:
     }
 
 
+def measure_pool(length: int = 48, batch_n: int = 256,
+                 passes: int = 5, workers: "int | None" = None) -> dict:
+    """The hostpool speedup row (ISSUE 5 satellite): the same batch
+    solved serially inline, through a 1-worker pool (isolating the IPC
+    overhead), and through the N-worker pool — best-of-passes each, so
+    the committed record tracks the pool's measured value like every
+    other measured default.  ``workers`` defaults to the pool's own
+    policy (min(cpu_count, 8))."""
+    import time as _time
+
+    from .. import hostpool
+    from ..models import random_instance
+    from ..sat.encode import encode
+
+    if workers is None:
+        workers = min(os.cpu_count() or 1, 8)
+    batch = [encode(random_instance(length=length, seed=s))
+             for s in range(batch_n)]
+
+    def best(fn) -> float:
+        times = []
+        for _ in range(passes):
+            t0 = _time.perf_counter()
+            fn()
+            times.append(_time.perf_counter() - t0)
+        return min(times)
+
+    inline_s = best(lambda: hostpool.solve_inline(batch))
+    rows = {}
+    for n in sorted({1, workers}):
+        pool = hostpool.HostPool(workers=n)
+        try:
+            pool.solve(batch[: 2 * n])  # spawn + warm outside the clock
+            rows[str(n)] = best(lambda: pool.solve(batch))
+        except hostpool.HostPoolError as e:
+            rows[str(n)] = None
+            print(f"[host_baseline] pool({n}) unavailable: {e}",
+                  file=__import__("sys").stderr)
+        finally:
+            pool.shutdown()
+    pooled_s = rows.get(str(workers))
+    return {
+        "machine": machine_key(),
+        "cpu_count": os.cpu_count(),
+        "workload": f"{workload_key(length)}-batch{batch_n}",
+        "batch_n": batch_n,
+        "passes": passes,
+        "statistic": "min-of-passes (same as host_baseline.json)",
+        "inline_rate": batch_n / inline_s,
+        "pool_rates": {n: (batch_n / s if s else None)
+                       for n, s in rows.items()},
+        "workers": workers,
+        "speedup_vs_inline": (inline_s / pooled_s if pooled_s else None),
+        # Scaling context the ratio is meaningless without: the pool
+        # parent competes for the same CPU quota as its workers, so a
+        # 2-CPU box measures ~parity (workers + parent > quota) while
+        # the ISSUE 5 acceptance's >= 2x is a >= 4-core claim.  Judge
+        # this record against cpu_count, and refresh it on real serving
+        # hardware like every other measured default.
+        "note": ("pool speedup is bounded by cpu_count minus the "
+                 "parent's share; >= 2x requires >= 4 cores"),
+        "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+    }
+
+
 def load_pinned(length: int) -> dict | None:
     """The committed record, iff it matches this machine and workload."""
     try:
@@ -122,11 +189,27 @@ def main() -> None:
     ap.add_argument("--length", type=int, default=48)
     ap.add_argument("--sample-n", type=int, default=24)
     ap.add_argument("--passes", type=int, default=30)
-    ap.add_argument("--out", default=BASELINE_PATH)
+    ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--pool", action="store_true",
+        help="measure the hostpool 1-vs-N speedup row instead of the "
+        "serial denominator (writes hostpool_baseline.json)")
+    ap.add_argument("--batch-n", type=int, default=256,
+                    help="batch size for the --pool measurement")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="N for the --pool measurement (default "
+                    "min(cpu_count, 8))")
     a = ap.parse_args()
-    rec = measure(length=a.length, sample_n=a.sample_n, passes=a.passes)
-    os.makedirs(os.path.dirname(a.out), exist_ok=True)
-    with open(a.out, "w") as f:
+    if a.pool:
+        rec = measure_pool(length=a.length, batch_n=a.batch_n,
+                           workers=a.workers)
+        out = a.out or HOSTPOOL_PATH
+    else:
+        rec = measure(length=a.length, sample_n=a.sample_n,
+                      passes=a.passes)
+        out = a.out or BASELINE_PATH
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
         json.dump(rec, f, indent=1)
         f.write("\n")
     print(json.dumps(rec))

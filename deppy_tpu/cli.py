@@ -100,6 +100,12 @@ def _build_parser() -> argparse.ArgumentParser:
         "path to a JSON fault plan (also via DEPPY_TPU_FAULT_PLAN; see "
         "docs/robustness.md)",
     )
+    p_resolve.add_argument(
+        "--host-workers", type=int, default=None, metavar="N",
+        help="host-engine worker pool size for host-path solves "
+        "(default min(cpu_count, 8); 0 = inline serial engine; also "
+        "via DEPPY_TPU_HOST_WORKERS — see docs/robustness.md)",
+    )
 
     p_bench = sub.add_parser(
         "bench", help="run the headline benchmark (one JSON line on stdout)"
@@ -174,6 +180,12 @@ def _build_parser() -> argparse.ArgumentParser:
         "1024, 0 disables; also via DEPPY_TPU_CACHE_SIZE) — repeated "
         "identical problems are answered without a dispatch",
     )
+    p_serve.add_argument(
+        "--host-workers", type=int, default=None, metavar="N",
+        help="host-engine worker pool size for breaker-open / "
+        "host-backend serving (default min(cpu_count, 8); 0 = inline "
+        "serial engine; also via DEPPY_TPU_HOST_WORKERS)",
+    )
 
     p_stats = sub.add_parser(
         "stats",
@@ -238,6 +250,7 @@ _CONFIG_KEYS = {
     "schedMaxWaitMs": ("sched_max_wait_ms", float),
     "schedMaxFill": ("sched_max_fill", int),
     "cacheSize": ("cache_size", int),
+    "hostWorkers": ("host_workers", int),
 }
 
 
@@ -292,6 +305,10 @@ def _cmd_resolve(args) -> int:
         configure_sink(args.telemetry_file)
     if _arm_fault_plan(args.fault_plan):
         return 2
+    if args.host_workers is not None:
+        from . import hostpool
+
+        hostpool.configure_pool(args.host_workers)
     try:
         problems, is_batch = problem_io.load_document(args.file)
     except FileNotFoundError:
@@ -679,6 +696,7 @@ def _cmd_serve(args) -> int:
         "sched_max_wait_ms": None,
         "sched_max_fill": None,
         "cache_size": None,
+        "host_workers": None,
     }
     try:
         if args.config:
@@ -693,9 +711,17 @@ def _cmd_serve(args) -> int:
             ("sched_max_wait_ms", args.sched_max_wait_ms),
             ("sched_max_fill", args.sched_max_fill),
             ("cache_size", args.cache_size),
+            ("host_workers", args.host_workers),
         ):
             if val is not None:
                 kwargs[key] = val
+        # The pool is process-global (like the breaker), not a Server
+        # field: install the size before the service boots.
+        host_workers = kwargs.pop("host_workers", None)
+        if host_workers is not None:
+            from . import hostpool
+
+            hostpool.configure_pool(host_workers)
         serve(**kwargs)
     except FileNotFoundError:
         print(f"error: no such file: {args.config}", file=sys.stderr)

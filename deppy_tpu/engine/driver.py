@@ -1052,11 +1052,17 @@ def _fault_results_host(problems, budget, reason: str) -> List[core.SolveResult]
     """Solve one dispatch group entirely on the host engine (fault-path
     fallback: the device dispatch failed or the breaker is open).
 
-    Results are device-shaped — installed/core masks padded to the
-    group's bucketed dims so checkpoint stacking and decode see exactly
-    what a device dispatch would have produced; the step budget carries
-    over, so budget-exhausted lanes still read Incomplete."""
-    from ..sat.host import HostEngine
+    Lanes run through the shared hostpool entry (ISSUE 5) — concurrent
+    across the host worker pool when one is available, inline otherwise,
+    bit-identical either way — so breaker-open serving scales with the
+    host's cores instead of collapsing to one.  Results are
+    device-shaped — installed/core masks padded to the group's bucketed
+    dims so checkpoint stacking and decode see exactly what a device
+    dispatch would have produced; the step budget carries over, so
+    budget-exhausted lanes still read Incomplete, and lanes not started
+    before the batch deadline expires degrade (one counted event for
+    the group, matching the driver's per-group accounting)."""
+    from .. import hostpool
 
     faults.inject("driver.host_fallback")
     reg = telemetry.default_registry()
@@ -1071,38 +1077,28 @@ def _fault_results_host(problems, budget, reason: str) -> List[core.SolveResult]
     dl = faults.current_deadline()
     with reg.span("driver.fault_host_fallback", problems=len(problems),
                   reason=reason):
-        for i, p in enumerate(problems):
-            # The serial fallback honors the batch deadline between
-            # problems like the facade's host loop: solved problems keep
-            # their answers, the remainder degrades to Incomplete
-            # instead of running minutes past the request's budget.
-            if dl is not None and dl.expired():
-                faults.note_deadline_exceeded("driver.host_fallback",
-                                              len(problems) - i)
-                out.extend(_deadline_results(problems[i:]))
-                break
+        lanes = hostpool.solve_host_problems(
+            problems, max_steps=int(budget),
+            deadlines=[dl] * len(problems))
+        n_degraded = sum(1 for r in lanes if r.degraded)
+        if n_degraded:
+            faults.note_deadline_exceeded("driver.host_fallback",
+                                          n_degraded)
+        for p, lane in zip(problems, lanes):
             installed = np.zeros(d.NV, bool)
             cmask = np.zeros(d.NCON, bool)
-            eng = HostEngine(p, max_steps=int(budget))
-            outcome = core.RUNNING
-            try:
-                _, idx = eng.solve()
-                installed[idx] = True
+            if lane.outcome == "sat":
+                installed[lane.installed_idx] = True
                 outcome = core.SAT
-            except NotSatisfiable as e:
-                # solve() already ran the deletion sweep; the exception
-                # carries the very objects of p.applied, so the mask
-                # rebuilds by identity — re-running unsat_core_mask here
-                # would double the step charge and could flip an
-                # in-budget UNSAT to Incomplete.
-                core_ids = {id(c) for c in e.constraints}
-                cmask[: p.n_cons] = [id(c) in core_ids for c in p.applied]
+            elif lane.outcome == "unsat":
+                cmask[lane.core_idx] = True
                 outcome = core.UNSAT
-            except Incomplete:
+            else:
                 outcome = core.RUNNING
             out.append(core.SolveResult(
-                np.int32(outcome), installed, cmask, np.int64(eng.steps),
-                np.zeros((0, 0), np.int32), np.int32(eng.backtracks)))
+                np.int32(outcome), installed, cmask,
+                np.int64(lane.steps), np.zeros((0, 0), np.int32),
+                np.int32(lane.backtracks)))
     return out
 
 

@@ -25,6 +25,15 @@ Fault points wired in this PR:
                               (ISSUE 3; before backend resolution, so an
                               error here fails every coalesced request
                               and latency stalls the whole flush)
+  ``hostpool.dispatch``       entry of one host-worker-pool dispatch
+                              (ISSUE 5; an error degrades the batch to
+                              the inline engine byte-identically —
+                              counted ``deppy_hostpool_inline_fallback_
+                              total``)
+  ``hostpool.worker_crash``   per chunk assignment in the pool parent
+                              (ISSUE 5; an error hard-kills the assigned
+                              worker mid-task — the crash-retry path
+                              runs exactly as for a real worker death)
   ==========================  ================================================
 
 Plan format — an object ``{"faults": [...]}`` or a bare list of rules::

@@ -1,0 +1,60 @@
+"""deppy_tpu.hostpool — multicore host-engine serving (ISSUE 5).
+
+The designated degraded mode — PR 2's circuit-breaker host-drain and
+PR 3's breaker-open queue drain — used to funnel every request through
+the serial, single-process spec engine in :mod:`deppy_tpu.sat.host`, so
+one wedged accelerator collapsed throughput to one core.  This package
+is the first multi-process execution engine in the repo: a lazily
+started, forkserver-backed pool of host-engine workers (sized by
+``DEPPY_TPU_HOST_WORKERS`` / ``--host-workers``, default
+``min(cpu_count, 8)``) that solves independent lanes of a batch
+concurrently, with results bit-identical to the inline engine — models,
+unsat cores, and step counts alike, because the workers and the inline
+fallback run the single :func:`worker.solve_lane` implementation.
+
+Every host-path consumer routes through
+:func:`solve_host_problems`: the solver facade's ``backend="host"``
+loop, the engine driver's ``_recovering`` host-fallback, and the
+scheduler's breaker-open queue drain.  The full fault vocabulary rides
+along: worker crashes retry on a fresh worker (charging
+``deppy_fault_retries``), workers recycle after N solves, per-lane
+deadlines cancel only the expired lane, a ``hostpool.dispatch`` fault
+point scripts pool failures, a fork-restricted sandbox degrades to the
+inline engine byte-identically, and graceful shutdown drains then
+terminates the pool.
+
+Metric families (``deppy_hostpool_*``, on the default registry and
+mirrored into every service ``/metrics`` scrape) and the
+``hostpool.dispatch`` / ``hostpool.worker_solve`` spans are tabled in
+docs/observability.md; the fault rows live in docs/robustness.md.
+"""
+
+from .metrics import FAMILY_ORDER, render_metric_lines
+from .pool import (
+    HostPool,
+    HostPoolError,
+    configure_pool,
+    default_pool,
+    effective_workers,
+    pool_workers,
+    shutdown_default_pool,
+    solve_host_problems,
+    solve_inline,
+)
+from .worker import HostLaneResult, solve_lane
+
+__all__ = [
+    "FAMILY_ORDER",
+    "HostLaneResult",
+    "HostPool",
+    "HostPoolError",
+    "configure_pool",
+    "default_pool",
+    "effective_workers",
+    "pool_workers",
+    "render_metric_lines",
+    "shutdown_default_pool",
+    "solve_host_problems",
+    "solve_inline",
+    "solve_lane",
+]

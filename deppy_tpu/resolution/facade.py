@@ -38,19 +38,6 @@ def _to_solution(variables: Sequence[Variable], installed: Sequence[Variable]) -
     return solution
 
 
-def _fold_report(batch: telemetry.SolveReport,
-                 one: telemetry.SolveReport) -> None:
-    """Fold one problem's host SolveReport into the batch report."""
-    for k, v in one.outcomes.items():
-        batch.count_outcome(k, v)
-    batch.steps += one.steps
-    batch.decisions += one.decisions
-    batch.propagation_rounds += one.propagation_rounds
-    batch.backtracks += one.backtracks
-    for stage, s in one.wall.items():
-        batch.add_wall(stage, s)
-
-
 class Resolver:
     """Single-problem resolution facade (reference DeppySolver,
     solver.go:24-64)."""
@@ -172,57 +159,11 @@ class BatchResolver:
 
                 print(
                     "warning: checkpoint_dir is a tensor-backend feature; "
-                    "the host engine solves serially without persisting "
-                    "groups — a crashed run will restart from scratch",
+                    "the host engine solves without persisting groups — "
+                    "a crashed run will restart from scratch",
                     file=sys.stderr,
                 )
-            out: List[Union[Solution, NotSatisfiable, Incomplete]] = []
-            # begin/end (not a bare SolveReport) so host-backend batches
-            # honor the same telemetry contract as device batches: the
-            # report reaches telemetry.last_report() and the JSONL sink,
-            # and the serial loop shows up as a span.
-            batch_rep, owns_rep = telemetry.begin_report(
-                backend="host", n_problems=len(problems)
-            )
-            reg = telemetry.default_registry()
-            try:
-                with reg.span("facade.host_solve", problems=len(problems)):
-                    dl = faults.current_deadline()
-                    for i, variables in enumerate(problems):
-                        # The host loop honors the batch deadline between
-                        # problems: completed batchmates keep their
-                        # answers, the rest degrade to Incomplete — the
-                        # serial mirror of the driver's per-group check
-                        # (one counted event for the whole remainder,
-                        # matching the driver's per-group accounting).
-                        if dl is not None and dl.expired():
-                            remaining = len(problems) - i
-                            faults.note_deadline_exceeded(
-                                "facade.host_solve", remaining)
-                            batch_rep.count_outcome("incomplete",
-                                                    remaining)
-                            out.extend(Incomplete()
-                                       for _ in range(remaining))
-                            break
-                        solver = Solver(
-                            variables, backend="host",
-                            max_steps=self.max_steps,
-                        )
-                        try:
-                            installed = solver.solve()
-                            out.append(_to_solution(variables, installed))
-                        except NotSatisfiable as e:
-                            out.append(e)
-                        except Incomplete as e:
-                            out.append(e)
-                        finally:
-                            self.last_steps += solver.steps
-                            if solver.report is not None:
-                                _fold_report(batch_rep, solver.report)
-            finally:
-                telemetry.end_report(batch_rep, owns_rep)
-            self.last_report = batch_rep
-            return out
+            return self._solve_host_batch(problems)
         from ..engine.driver import solve_batch
 
         stats: dict = {}
@@ -234,3 +175,77 @@ class BatchResolver:
         finally:
             self.last_steps = stats.get("steps", 0)
             self.last_report = stats.get("report")
+
+    def _solve_host_batch(
+        self, problems: Sequence[Sequence[Variable]]
+    ) -> List[Union[Solution, NotSatisfiable, Incomplete]]:
+        """Host-backend batch solve through the shared hostpool entry
+        (ISSUE 5): lanes run concurrently across the worker pool when
+        one is available (``DEPPY_TPU_HOST_WORKERS``), inline otherwise
+        — bit-identical either way.  Deadline semantics mirror the
+        historical serial loop: problems not started before the batch
+        deadline expires come back ``Incomplete``, counted as ONE
+        deadline event for the whole degraded remainder (the driver's
+        per-group accounting)."""
+        from .. import hostpool
+
+        # begin/end (not a bare SolveReport) so host-backend batches
+        # honor the same telemetry contract as device batches: the
+        # report reaches telemetry.last_report() and the JSONL sink,
+        # and the batch shows up as a span.
+        batch_rep, owns_rep = telemetry.begin_report(
+            backend="host", n_problems=len(problems)
+        )
+        reg = telemetry.default_registry()
+        try:
+            with reg.span("facade.host_solve", problems=len(problems)):
+                dl = faults.current_deadline()
+                # Deadline triage BEFORE each encode, like the serial
+                # loop checked before each Solver construction: an
+                # already-expired batch must not pay unbounded encode
+                # work (and a malformed problem past the expiry point
+                # degrades like any other remainder instead of raising).
+                # Encoding errors (DuplicateIdentifier) for problems
+                # reached in time surface exactly as before.
+                encoded = []
+                for vs in problems:
+                    if dl is not None and dl.expired():
+                        break
+                    encoded.append(Solver(vs, backend="host",
+                                          max_steps=self.max_steps).problem)
+                lanes = hostpool.solve_host_problems(
+                    encoded, max_steps=self.max_steps,
+                    deadlines=[dl] * len(encoded)) if encoded else []
+                lanes += [hostpool.HostLaneResult("incomplete",
+                                                  degraded=True)
+                          for _ in range(len(problems) - len(encoded))]
+                n_degraded = sum(1 for r in lanes if r.degraded)
+                if n_degraded:
+                    faults.note_deadline_exceeded("facade.host_solve",
+                                                  n_degraded)
+                out: List[Union[Solution, NotSatisfiable, Incomplete]] = []
+                for variables, p, lane in zip(problems,
+                                              encoded + [None] * (
+                                                  len(problems)
+                                                  - len(encoded)),
+                                              lanes):
+                    batch_rep.count_outcome(lane.outcome)
+                    batch_rep.steps += lane.steps
+                    batch_rep.decisions += lane.decisions
+                    batch_rep.propagation_rounds += lane.propagation_rounds
+                    batch_rep.backtracks += lane.backtracks
+                    batch_rep.add_wall("solve", lane.wall_s)
+                    self.last_steps += lane.steps
+                    if lane.outcome == "sat":
+                        out.append(_to_solution(
+                            variables,
+                            [p.variables[i] for i in lane.installed_idx]))
+                    elif lane.outcome == "unsat":
+                        out.append(NotSatisfiable(
+                            [p.applied[j] for j in lane.core_idx]))
+                    else:
+                        out.append(Incomplete())
+        finally:
+            telemetry.end_report(batch_rep, owns_rep)
+        self.last_report = batch_rep
+        return out

@@ -83,6 +83,45 @@ class Solver:
             self.report = stats.get("report")
 
     def _solve_host(self) -> List[Variable]:
+        if self.tracer is not None:
+            # Tracer callbacks can't cross a process boundary: a traced
+            # solve stays on the in-process engine.
+            return self._solve_host_traced()
+        # The shared host-path entry (ISSUE 5): one lane through
+        # deppy_tpu.hostpool, which routes a batch of one inline anyway
+        # (a lone problem is IPC-latency-bound the same way it is
+        # dispatch-latency-bound on the device) but keeps this facade on
+        # the single solve_lane implementation the pool's differential
+        # tests pin.
+        from .. import hostpool
+
+        try:
+            (lane,) = hostpool.solve_host_problems(
+                [self.problem], max_steps=self.max_steps)
+        except InternalSolverError:
+            # Parity with the engine path's finally: the report exists
+            # (outcome-less) even when the problem was malformed.
+            self.steps = 0
+            self.report = telemetry.SolveReport(backend="host",
+                                                n_problems=1)
+            raise
+        self.steps = lane.steps
+        rep = telemetry.SolveReport(backend="host", n_problems=1)
+        rep.count_outcome(lane.outcome)
+        rep.steps = lane.steps
+        rep.decisions = lane.decisions
+        rep.propagation_rounds = lane.propagation_rounds
+        rep.backtracks = lane.backtracks
+        rep.add_wall("solve", lane.wall_s)
+        self.report = rep
+        if lane.outcome == "sat":
+            return [self.problem.variables[i] for i in lane.installed_idx]
+        if lane.outcome == "unsat":
+            raise NotSatisfiable(
+                [self.problem.applied[j] for j in lane.core_idx])
+        raise Incomplete()
+
+    def _solve_host_traced(self) -> List[Variable]:
         engine = HostEngine(
             self.problem, tracer=self.tracer, max_steps=self.max_steps
         )

@@ -530,41 +530,43 @@ class Scheduler:
             lane.result = dec
 
     def _solve_host(self, live: List[_Lane], rep) -> None:
-        """Serial host-engine drain — the breaker's host-only mode and
-        the explicit host backend.  Mirrors the facade's host loop
-        (per-problem engine, same telemetry folds) but honors each
-        LANE's own deadline between problems: completed lanes keep
-        their answers, expired ones degrade individually."""
-        from ..sat.host import HostEngine
+        """Host-engine drain — the breaker's host-only mode and the
+        explicit host backend.  Lanes run through the shared hostpool
+        entry (ISSUE 5): concurrent across the host worker pool when one
+        is available, so a wedged accelerator degrades throughput to
+        the host's cores instead of one; inline (bit-identical)
+        otherwise.  Each LANE's own deadline rides along per lane:
+        completed lanes keep their answers, expired ones degrade
+        individually without poisoning their pool batchmates."""
+        from .. import hostpool
 
         reg = telemetry.default_registry()
         with reg.span("sched.host_solve", problems=len(live)):
-            for lane in live:
-                if lane.deadline is not None and lane.deadline.expired():
+            results = hostpool.solve_host_problems(
+                [lane.problem for lane in live],
+                max_steps=[lane.max_steps for lane in live],
+                deadlines=[lane.deadline for lane in live])
+            for lane, r in zip(live, results):
+                if r.degraded:
                     faults.note_deadline_exceeded("sched.host_solve")
                     rep.count_outcome("incomplete")
                     lane.result = Incomplete()
                     lane.degraded = True
                     continue
-                eng = HostEngine(lane.problem, max_steps=lane.max_steps)
-                outcome = "incomplete"
-                try:
-                    installed, _ = eng.solve()
+                if r.outcome == "sat":
                     solution = {v.identifier: False
                                 for v in lane.problem.variables}
-                    for v in installed:
-                        solution[v.identifier] = True
+                    for i in r.installed_idx:
+                        solution[lane.problem.variables[i].identifier] = True
                     lane.result = solution
-                    outcome = "sat"
-                except NotSatisfiable as e:
-                    lane.result = e
-                    outcome = "unsat"
-                except Incomplete as e:
-                    lane.result = e
-                finally:
-                    lane.steps = eng.steps
-                    rep.count_outcome(outcome)
-                    rep.steps += eng.steps
-                    rep.decisions += eng.decisions
-                    rep.propagation_rounds += eng.propagation_rounds
-                    rep.backtracks += eng.backtracks
+                elif r.outcome == "unsat":
+                    lane.result = NotSatisfiable(
+                        [lane.problem.applied[j] for j in r.core_idx])
+                else:
+                    lane.result = Incomplete()
+                lane.steps = r.steps
+                rep.count_outcome(r.outcome)
+                rep.steps += r.steps
+                rep.decisions += r.decisions
+                rep.propagation_rounds += r.propagation_rounds
+                rep.backtracks += r.backtracks

@@ -196,6 +196,13 @@ class Metrics:
         # counters are pipeline-global (one accelerator, one breaker),
         # appended here so every scrape sees them.
         lines += faults.render_metric_lines()
+        # Hostpool families (ISSUE 5): the breaker-open worker pool is
+        # process-global too (one host, one pool) — same injection
+        # pattern, so queue depth / busy workers / crash-recycle
+        # counters ride every scrape.
+        from . import hostpool
+
+        lines += hostpool.render_metric_lines()
         return "\n".join(lines) + "\n"
 
 
@@ -817,3 +824,13 @@ def serve(
         if prev_usr2 is not None:
             signal.signal(signal.SIGUSR2, prev_usr2)
         srv.shutdown()
+        # Host worker pool (ISSUE 5): after shutdown() drained requests
+        # and stopped the scheduler loop, nothing can dispatch to the
+        # pool — drain (the pool lock serializes against any straggler
+        # dispatch) and terminate the workers.  Owned here, at the
+        # PROCESS entry point, not in Server.shutdown: the pool is
+        # process-global like the breaker, and embedded servers come
+        # and go without owning it.
+        from . import hostpool
+
+        hostpool.shutdown_default_pool()

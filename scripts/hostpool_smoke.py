@@ -1,0 +1,159 @@
+"""Hostpool smoke (ISSUE 5 acceptance, CPU-only, <1 min).
+
+End-to-end of the multicore host-engine worker pool:
+
+  1. pool-vs-inline bit-identity (models, unsat cores, step counts)
+     over the fuzz distribution;
+  2. a worker hard-killed mid-batch (scripted fault plan) — answers
+     unchanged, crash + retry counters charged;
+  3. breaker-open scheduler drain through the pool — byte-identical to
+     the unscheduled inline host path while the breaker stays open;
+  4. ``DEPPY_TPU_HOST_WORKERS=0`` restores byte-identical inline
+     behavior;
+  5. ``deppy stats --span hostpool.dispatch`` summarizes the pool spans
+     from the JSONL sink with the standard schema.
+
+Exits 0 only when every stage passed.  Run via ``make hostpool-smoke``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(f"[hostpool-smoke] {msg}", flush=True)
+
+
+def fail(msg: str) -> "None":
+    print(f"[hostpool-smoke] FAIL: {msg}", file=sys.stderr, flush=True)
+    raise SystemExit(1)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from deppy_tpu import faults, hostpool, telemetry
+    from deppy_tpu.models import random_instance
+    from deppy_tpu.sat.encode import encode
+
+    var_sets = [random_instance(length=48, seed=s) for s in range(24)]
+    problems = [encode(vs) for vs in var_sets]
+    inline = hostpool.solve_inline(problems)
+    keys = [r.key() for r in inline]
+    if not any(r.outcome == "sat" for r in inline):
+        fail("fuzz distribution produced no SAT instance")
+
+    sink = tempfile.NamedTemporaryFile(
+        mode="w", suffix=".jsonl", prefix="deppy_hostpool_", delete=False)
+    sink.close()
+    telemetry.default_registry().configure_sink(sink.name)
+
+    # 1. bit-identity
+    pool = hostpool.HostPool(workers=2)
+    try:
+        pooled = pool.solve(problems)
+        if [r.key() for r in pooled] != keys:
+            fail("pool results diverged from the inline engine")
+        log("pool-vs-inline bit-identity over 24 fuzz problems: ok")
+
+        # 2. worker crash mid-batch
+        faults.configure_plan(faults.plan_from_spec(
+            '[{"point": "hostpool.worker_crash", "kind": "error",'
+            ' "after": 1, "times": 1}]'))
+        crashed = pool.solve(problems)
+        faults.configure_plan(None)
+        if [r.key() for r in crashed] != keys:
+            fail("results diverged after a mid-batch worker crash")
+        snap = telemetry.default_registry().snapshot()
+        if snap.get("deppy_hostpool_worker_crashes_total", 0) < 1:
+            fail("worker crash was not counted")
+        if snap.get("deppy_fault_retries", 0) < 1:
+            fail("crash retry did not charge deppy_fault_retries")
+        log("mid-batch worker crash retried on a fresh worker: ok")
+    finally:
+        pool.shutdown()
+
+    # 3. breaker-open sched drain through the pool
+    from deppy_tpu import io as problem_io
+    from deppy_tpu.sched import Scheduler
+
+    breaker = faults.CircuitBreaker(failure_threshold=1,
+                                    reset_after_s=3600)
+    prev_breaker = faults.set_default_breaker(breaker)
+    breaker.record_failure()
+    sched = Scheduler(backend="auto", max_wait_ms=50.0, cache_size=0)
+    sched.start()
+    try:
+        out = sched.submit(var_sets[:8])
+    finally:
+        sched.stop()
+        faults.set_default_breaker(prev_breaker)
+    rendered = [json.dumps(problem_io.result_to_dict(r), sort_keys=True)
+                for r in out]
+    want = []
+    for p, lane in zip(problems[:8], inline[:8]):
+        if lane.outcome == "sat":
+            sol = {v.identifier: False for v in p.variables}
+            for i in lane.installed_idx:
+                sol[p.variables[i].identifier] = True
+            want.append(sol)
+        elif lane.outcome == "unsat":
+            from deppy_tpu.sat.errors import NotSatisfiable
+
+            want.append(NotSatisfiable(
+                [p.applied[j] for j in lane.core_idx]))
+        else:
+            from deppy_tpu.sat.errors import Incomplete
+
+            want.append(Incomplete())
+    want_rendered = [json.dumps(problem_io.result_to_dict(r),
+                                sort_keys=True) for r in want]
+    if rendered != want_rendered:
+        fail("breaker-open sched drain diverged from the inline path")
+    snap = telemetry.default_registry().snapshot()
+    if snap.get("deppy_hostpool_lanes_total", 0) < 8:
+        fail("breaker-open drain did not route through the pool")
+    log("breaker-open sched drain through the pool, byte-identical: ok")
+
+    # 4. DEPPY_TPU_HOST_WORKERS=0 → inline
+    os.environ["DEPPY_TPU_HOST_WORKERS"] = "0"
+    try:
+        if hostpool.default_pool() is not None:
+            fail("DEPPY_TPU_HOST_WORKERS=0 did not disable the pool")
+        off = hostpool.solve_host_problems(problems)
+        if [r.key() for r in off] != keys:
+            fail("pool-off results diverged from the inline engine")
+    finally:
+        del os.environ["DEPPY_TPU_HOST_WORKERS"]
+    log("DEPPY_TPU_HOST_WORKERS=0 restores inline behavior: ok")
+
+    # 5. deppy stats --span hostpool.dispatch over the sink
+    telemetry.default_registry().configure_sink(None)
+    from deppy_tpu import cli
+
+    import contextlib
+    import io as _io
+
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main(["stats", sink.name, "--output", "json"])
+    if rc != 0:
+        fail(f"deppy stats exited {rc}")
+    doc = json.loads(buf.getvalue())
+    if doc["spans"].get("hostpool.dispatch", {}).get("count", 0) < 1:
+        fail("no hostpool.dispatch spans reached the sink")
+    log("deppy stats summarizes hostpool.dispatch spans: ok")
+    os.unlink(sink.name)
+
+    log("all stages passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,7 @@ CODE_SCOPE = [
     REPO / "deppy_tpu" / "telemetry",
     REPO / "deppy_tpu" / "faults",
     REPO / "deppy_tpu" / "sched",
+    REPO / "deppy_tpu" / "hostpool",
     REPO / "deppy_tpu" / "service.py",
     REPO / "deppy_tpu" / "engine" / "driver.py",
 ]
@@ -80,4 +81,5 @@ def test_scan_scope_is_sane():
     registered = _code_names()
     assert {"deppy_resolutions_total", "deppy_breaker_state",
             "deppy_sched_dispatches_total",
+            "deppy_hostpool_queue_depth",
             "deppy_request_queue_wait_seconds"} <= registered
