@@ -76,6 +76,14 @@ hostpool-smoke: ## Multicore host-pool end-to-end: pool-vs-inline bit-identity, 
 test-hostpool: ## Host worker-pool subsystem tests only (the `hostpool` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m hostpool
 
+.PHONY: shard-smoke
+shard-smoke: ## Mesh serving on a forced 8-device CPU platform: sharded-vs-unsharded byte-identity + poisoned-shard per-device fault domain (ISSUE 6 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/shard_smoke.py
+
+.PHONY: test-shard
+test-shard: ## Mesh-serving shard subsystem tests only (the `shard` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m shard
+
 ##@ Benchmarks
 
 .PHONY: bench

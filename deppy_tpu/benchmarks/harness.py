@@ -43,10 +43,16 @@ def probe_wall_s() -> float:
 
 
 def bench_problems(problems: Sequence, host_sample: int = 16,
-                   mesh=None) -> Dict:
+                   mesh=None, serving_mesh=None) -> Dict:
     """Measure a list of lowered problems: host ms/problem (serial,
     sampled), device rate (batched, post-warm-up).  Returns the raw
-    numbers; callers shape them into their own output records."""
+    numbers; callers shape them into their own output records.
+
+    ``serving_mesh`` routes the timed dispatch through the ISSUE 6
+    batch-axis sharded entry (``driver.solve_problems_sharded``) so the
+    mesh scaling curve is measured with the exact code path the
+    scheduler serves with; ``mesh`` stays the clause-axis mesh of the
+    historical dispatch paths."""
     from ..engine import core, driver
     from ..sat.errors import NotSatisfiable
     from ..sat.host import HostEngine
@@ -56,6 +62,13 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
     if host_sample <= 0:
         raise ValueError("host_sample must be positive")
     n = len(problems)
+    n_devices = int(getattr(serving_mesh, "size", 1) or 1)
+
+    def dispatch():
+        if serving_mesh is not None:
+            return driver.solve_problems_sharded(problems,
+                                                 mesh=serving_mesh)
+        return driver.solve_problems(problems, mesh=mesh)
     # First backend touch is timed HERE, before the warm-up pays it
     # invisibly — direct bench_problems callers get the real init stall
     # in their record, not ~0 measured after the fact.
@@ -83,10 +96,10 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
     log(f"host: {host_s * 1e3:.2f} ms/problem ({1.0 / host_s:.1f}/s serial)")
 
     t0 = time.perf_counter()
-    driver.solve_problems(problems, mesh=mesh)  # includes compile
+    dispatch()  # includes compile
     warm_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    results = driver.solve_problems(problems, mesh=mesh)
+    results = dispatch()
     dev_s = time.perf_counter() - t0
     # Sub-50ms dispatches (the single-problem config) are dominated by
     # timer/GC jitter in one sample: re-time and keep the best.
@@ -95,7 +108,7 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
         times = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            results = driver.solve_problems(problems, mesh=mesh)
+            results = dispatch()
             times.append(time.perf_counter() - t0)
         dev_s = min(times + [dev_s])
     n_sat = sum(1 for r in results if r.outcome == core.SAT)
@@ -125,6 +138,12 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
         # benchmarks/results/hostpool_baseline.json (host_baseline
         # --pool), not folded into the device-vs-host ratio.
         "host_workers": hostpool.effective_workers(),
+        # Mesh-serving columns (ISSUE 6): how many devices the timed
+        # dispatch sharded over (1 = historical single-device path) and
+        # the per-device throughput — the scaling-curve numerator every
+        # MULTICHIP/BENCH round tracks.
+        "n_devices": n_devices,
+        "per_device_rate": rate / n_devices,
         "sat": n_sat,
         "unsat": n_unsat,
     }

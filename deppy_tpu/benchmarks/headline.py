@@ -20,7 +20,8 @@ from .harness import bench_problems, log, probe_wall_s
 
 
 def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
-        platform: str | None = None) -> dict:
+        platform: str | None = None,
+        mesh_devices: int | None = None) -> dict:
     import jax
 
     from ..models import random_instance
@@ -34,10 +35,20 @@ def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
     probe_s = probe_wall_s()  # time the first backend touch explicitly
     backend = jax.default_backend()
     log(f"jax backend: {backend} devices={jax.devices()}")
+    # Mesh serving (ISSUE 6): --mesh-devices / DEPPY_TPU_MESH_DEVICES
+    # shards the timed dispatch over a device mesh — the same entry
+    # point the scheduler drains through, so the headline number and
+    # the serving path stay one code path.
+    from ..parallel.mesh import serving_mesh
+
+    smesh = serving_mesh(mesh_devices)
+    if smesh is not None:
+        log(f"serving mesh: {int(smesh.size)} devices (batch-axis shard)")
     problems = [
         encode(random_instance(length=length, seed=s)) for s in range(n_problems)
     ]
-    m = bench_problems(problems, host_sample=host_sample)
+    m = bench_problems(problems, host_sample=host_sample,
+                       serving_mesh=smesh)
 
     # The ratio's denominator: the committed machine-keyed median record
     # when one matches (so vs_baseline moves only when the device rate
@@ -70,6 +81,10 @@ def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
         "warmup_seconds": round(m["warmup_seconds"], 3),
         # Host-path pool size (ISSUE 5 satellite; 0 = inline serial).
         "host_workers": m["host_workers"],
+        # Mesh-serving scaling columns (ISSUE 6): device count the timed
+        # dispatch sharded over + throughput per device.
+        "n_devices": m["n_devices"],
+        "per_device_rate": round(m["per_device_rate"], 2),
     }
     if "telemetry" in m:
         # Occupancy and fallback columns ride in every BENCH row (ISSUE
@@ -103,9 +118,12 @@ def main() -> None:
     ap.add_argument("--n-problems", type=int, default=4096)
     ap.add_argument("--length", type=int, default=48)
     ap.add_argument("--host-sample", type=int, default=24)
+    ap.add_argument("--mesh-devices", type=int, default=None,
+                    help="shard the timed dispatch over N devices "
+                    "(-1 = all; default: DEPPY_TPU_MESH_DEVICES or off)")
     a = ap.parse_args()
     run(n_problems=a.n_problems, length=a.length, host_sample=a.host_sample,
-        platform=a.platform)
+        platform=a.platform, mesh_devices=a.mesh_devices)
 
 
 if __name__ == "__main__":

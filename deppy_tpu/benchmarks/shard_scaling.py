@@ -1,0 +1,117 @@
+"""Sharded-scheduler scaling row (ISSUE 6): single-device vs full-mesh.
+
+Measures the scheduler's dispatch path at two mesh sizes on the current
+platform — 1 device (the historical single-device dispatch) and every
+local device (the batch-axis sharded entry) — and writes one JSON record
+to ``benchmarks/results/`` so the scaling curve is tracked per round.
+
+On real hardware the mesh row is the paper's multi-chip claim; on the
+forced-CPU 8-device platform (CI, dev boxes) the virtual devices share
+the host's cores, so the row tracks *overhead parity* (the sharded path
+must not cost throughput), not speedup — the record carries the
+platform and core count so readers can tell which claim they are
+looking at.
+
+Usage::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python -m deppy_tpu.benchmarks.shard_scaling \
+        --out benchmarks/results/shard_scaling_r06.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .harness import log, probe_wall_s
+
+
+def run(n_problems: int = 512, length: int = 32,
+        out: str | None = None) -> dict:
+    import jax
+
+    from ..engine import driver
+    from ..models import random_instance
+    from ..parallel.mesh import serving_mesh
+    from ..sat.encode import encode
+
+    probe_s = probe_wall_s()
+    n_dev = len(jax.devices())
+    problems = [encode(random_instance(length=length, seed=s))
+                for s in range(n_problems)]
+
+    def rate(fn) -> float:
+        fn()  # warm-up (compile)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return n_problems / best
+
+    r_single = rate(lambda: driver.solve_problems(problems))
+    log(f"single-device: {r_single:.1f}/s")
+    mesh = serving_mesh(-1)
+    r_mesh = r_single
+    if mesh is not None:
+        r_mesh = rate(
+            lambda: driver.solve_problems_sharded(problems, mesh=mesh))
+        log(f"mesh({int(mesh.size)}): {r_mesh:.1f}/s "
+            f"({r_mesh / r_single:.2f}x)")
+    else:
+        log("single local device: mesh row = single row")
+
+    rec = {
+        "metric": "sharded-scheduler throughput, single vs mesh",
+        "unit": "problems/s",
+        "backend": jax.default_backend(),
+        "n_devices": n_dev,
+        "cpu_count": os.cpu_count(),
+        "n_problems": n_problems,
+        "length": length,
+        "probe_wall_s": round(probe_s, 3),
+        "rows": [
+            {"mesh_devices": 1, "rate": round(r_single, 2),
+             "per_device_rate": round(r_single, 2)},
+            {"mesh_devices": int(mesh.size) if mesh is not None else 1,
+             "rate": round(r_mesh, 2),
+             "per_device_rate": round(
+                 r_mesh / (int(mesh.size) if mesh is not None else 1), 2)},
+        ],
+        "speedup": round(r_mesh / r_single, 3),
+        # Virtual devices on a shared host measure dispatch overhead,
+        # not chip scaling — make the record self-describing.
+        "note": ("forced-CPU virtual devices share host cores: this row "
+                 "tracks sharded-path overhead parity, not chip scaling"
+                 ) if jax.default_backend() == "cpu" else "",
+    }
+    if out:
+        if os.path.dirname(out):
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w", encoding="utf-8") as fh:
+            json.dump(rec, fh, indent=2)
+            fh.write("\n")
+        log(f"wrote {out}")
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main() -> None:
+    import argparse
+
+    from ..utils.platform_env import apply_platform_env
+
+    apply_platform_env()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n-problems", type=int, default=512)
+    ap.add_argument("--length", type=int, default=32)
+    ap.add_argument("--out", default=None,
+                    help="also write the record to this JSON file")
+    a = ap.parse_args()
+    run(n_problems=a.n_problems, length=a.length, out=a.out)
+
+
+if __name__ == "__main__":
+    main()

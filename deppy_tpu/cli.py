@@ -27,7 +27,20 @@ import sys
 from typing import List, Optional
 
 from . import io as problem_io
-from .sat.errors import DuplicateIdentifier, InternalSolverError
+from .sat.errors import (BackendCapabilityError, DuplicateIdentifier,
+                         InternalSolverError)
+
+
+def _mesh_devices_arg(raw: str) -> int:
+    """--mesh-devices value: a device count, or 'all' → -1 (every local
+    device) — the same spelling DEPPY_TPU_MESH_DEVICES accepts."""
+    if raw.strip().lower() == "all":
+        return -1
+    try:
+        return int(raw)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer or 'all', got {raw!r}")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -186,6 +199,15 @@ def _build_parser() -> argparse.ArgumentParser:
         "host-backend serving (default min(cpu_count, 8); 0 = inline "
         "serial engine; also via DEPPY_TPU_HOST_WORKERS)",
     )
+    p_serve.add_argument(
+        "--mesh-devices", type=_mesh_devices_arg, default=None,
+        metavar="N|all",
+        help="shard each coalesced micro-batch across N accelerator "
+        "devices ('all' = every local device; default off — "
+        "single-device dispatch; also via DEPPY_TPU_MESH_DEVICES).  "
+        "Each device gets its own fault domain and "
+        "deppy_breaker_state{device=...} breaker",
+    )
 
     p_stats = sub.add_parser(
         "stats",
@@ -251,6 +273,7 @@ _CONFIG_KEYS = {
     "schedMaxFill": ("sched_max_fill", int),
     "cacheSize": ("cache_size", int),
     "hostWorkers": ("host_workers", int),
+    "meshDevices": ("mesh_devices", int),
 }
 
 
@@ -329,7 +352,8 @@ def _cmd_resolve(args) -> int:
     )
     try:
         results = resolver.solve(problems)
-    except (DuplicateIdentifier, InternalSolverError) as e:
+    except (BackendCapabilityError, DuplicateIdentifier,
+            InternalSolverError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     if args.report and resolver.last_report is not None:
@@ -697,6 +721,7 @@ def _cmd_serve(args) -> int:
         "sched_max_fill": None,
         "cache_size": None,
         "host_workers": None,
+        "mesh_devices": None,
     }
     try:
         if args.config:
@@ -712,6 +737,7 @@ def _cmd_serve(args) -> int:
             ("sched_max_fill", args.sched_max_fill),
             ("cache_size", args.cache_size),
             ("host_workers", args.host_workers),
+            ("mesh_devices", args.mesh_devices),
         ):
             if val is not None:
                 kwargs[key] = val

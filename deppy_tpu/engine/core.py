@@ -198,6 +198,79 @@ def or_reduce_rows(x: jax.Array) -> jax.Array:
     return x
 
 
+def _pow2_pad(x: jax.Array, axis: int, fill) -> tuple:
+    """Pad ``axis`` with ``fill`` up to the next power of two; returns
+    (padded, padded length)."""
+    n = x.shape[axis]
+    p = 1
+    while p < n:
+        p <<= 1
+    if p != n:
+        shape = list(x.shape)
+        shape[axis] = p - n
+        x = jnp.concatenate(
+            [x, jnp.full(shape, fill, x.dtype)], axis=axis
+        )
+    return x, p
+
+
+def _tree_fold(x: jax.Array, axis: int, combine, fill) -> jax.Array:
+    """Static halving-tree reduction along ``axis`` (keepdims).  The
+    installed Mosaic lowering rejects every *integer* ``reduce_*``
+    primitive ("Reductions over integers not implemented", jax 0.4.x),
+    while adds/mins and slices always lower — so the kernels reduce by
+    tree instead.  Bit-exact vs the reduction primitives: int32 add and
+    min are associative."""
+    x, p = _pow2_pad(x, axis, fill)
+    while p > 1:
+        h = p // 2
+        x = combine(lax.slice_in_dim(x, 0, h, axis=axis),
+                    lax.slice_in_dim(x, h, p, axis=axis))
+        p = h
+    return x
+
+
+def tree_sum(x: jax.Array, axis: "int | None" = None,
+             keepdims: bool = False) -> jax.Array:
+    """Mosaic-safe integer sum (see :func:`_tree_fold`).  ``axis=None``
+    reduces every axis to a scalar.  Bools count as int32."""
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.int32)
+    if axis is None:
+        for ax in range(x.ndim):
+            x = _tree_fold(x, ax, lax.add, 0)
+        return jnp.squeeze(x)
+    axis = axis % x.ndim
+    x = _tree_fold(x, axis, lax.add, 0)
+    return x if keepdims else jnp.squeeze(x, axis=axis)
+
+
+def tree_min(x: jax.Array, axis: "int | None" = None,
+             keepdims: bool = False) -> jax.Array:
+    """Mosaic-safe integer min (see :func:`_tree_fold`)."""
+    fill = jnp.iinfo(x.dtype).max
+    if axis is None:
+        for ax in range(x.ndim):
+            x = _tree_fold(x, ax, lax.min, fill)
+        return jnp.squeeze(x)
+    axis = axis % x.ndim
+    x = _tree_fold(x, axis, lax.min, fill)
+    return x if keepdims else jnp.squeeze(x, axis=axis)
+
+
+def tree_max(x: jax.Array, axis: "int | None" = None,
+             keepdims: bool = False) -> jax.Array:
+    """Mosaic-safe integer max (see :func:`_tree_fold`)."""
+    fill = jnp.iinfo(x.dtype).min
+    if axis is None:
+        for ax in range(x.ndim):
+            x = _tree_fold(x, ax, lax.max, fill)
+        return jnp.squeeze(x)
+    axis = axis % x.ndim
+    x = _tree_fold(x, axis, lax.max, fill)
+    return x if keepdims else jnp.squeeze(x, axis=axis)
+
+
 def pack_mask(mask: jax.Array, Wv: int) -> jax.Array:
     """bool[V] → packed i32[1, Wv] bitplane.  Distinct bit positions make the
     int32 sum carry-free, i.e. an OR."""
@@ -208,7 +281,7 @@ def pack_mask(mask: jax.Array, Wv: int) -> jax.Array:
         m = jnp.concatenate([m, jnp.zeros(pad, bool)])
     m = m.reshape(Wv, WORD).astype(jnp.int32)
     shifts = jnp.arange(WORD, dtype=jnp.int32)[None, :]
-    return (m << shifts).sum(axis=1, dtype=jnp.int32)[None, :]
+    return tree_sum(m << shifts, axis=1)[None, :]
 
 
 def unpack_mask(words: jax.Array, V: int) -> jax.Array:
@@ -292,8 +365,8 @@ def round_planes(pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f):
     sat = (((pos & t) | (neg & f)) != 0).any(axis=1, keepdims=True)   # [C,1]
     upos = pos & ~a
     uneg = neg & ~a
-    n_un = popcount32(upos).sum(axis=1, keepdims=True) + popcount32(uneg).sum(
-        axis=1, keepdims=True
+    n_un = tree_sum(popcount32(upos), axis=1, keepdims=True) + tree_sum(
+        popcount32(uneg), axis=1, keepdims=True
     )                                                                  # [C,1]
     valid = ((pos | neg) != 0).any(axis=1, keepdims=True)
     dead = valid & ~sat & (n_un == 0)
@@ -304,15 +377,15 @@ def round_planes(pos, neg, mem, card_active, card_n2, min_bits, min_w, t, f):
     # AtMost rows: count true / unassigned members; > n conflicts, == n
     # forces the rest false.
     active = card_active                                               # [NA,1]
-    trues = popcount32(mem & t).sum(axis=1, keepdims=True)
-    unk = popcount32(mem & ~a).sum(axis=1, keepdims=True)
+    trues = tree_sum(popcount32(mem & t), axis=1, keepdims=True)
+    unk = tree_sum(popcount32(mem & ~a), axis=1, keepdims=True)
     over = active & (trues > card_n2)
     full = active & (trues == card_n2) & (unk > 0)
     wneg = wneg | or_reduce_rows(jnp.where(full, mem & ~a, 0))
 
     # Dynamic "at most w of the extras" bound for the minimization loop.
     # (min_bits/t are replicated under clause sharding — no collective.)
-    mtrues = popcount32(min_bits & t).sum()
+    mtrues = tree_sum(popcount32(min_bits & t))
     min_over = mtrues > min_w
     wneg = jnp.where(mtrues == min_w, wneg | (min_bits & ~a), wneg)
 

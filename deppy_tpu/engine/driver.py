@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools as _functools
 import os
+import threading as _threading
 import time as _time
 from typing import List, Optional, Sequence, Union
 
@@ -690,10 +691,14 @@ def _host_core_rows(problems, idx, d: _Dims, budget, spent,
     return cores, steps
 
 
-def _solve_monolith(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
+def _solve_monolith(problems, budget, mesh, trace_cap,
+                    _spmd_entry: bool = False) -> List[core.SolveResult]:
     """Single-dispatch path (one jitted program, all phases lane-gated):
     the right trade for a batch of one, where phase compaction buys
-    nothing and one compile beats three."""
+    nothing and one compile beats three.  ``_spmd_entry`` swaps the
+    jitted program for :func:`batched_solve_sharded` — same vmapped
+    solve, explicit PartitionSpec shardings over ``mesh`` — the SPMD
+    spelling of the mesh entry (:func:`_solve_spmd`)."""
     n = len(problems)
     d = _Dims(problems, max(n, 1), batch_multiple=mesh.size if mesh is not None else 1)
     host_core = any(p.n_cons > HOST_CORE_NCONS for p in problems)
@@ -714,8 +719,12 @@ def _solve_monolith(problems, budget, mesh, trace_cap) -> List[core.SolveResult]
                          full=True if not host_core else None)
     if rep is not None:
         rep.add_wall("device_put", sp.dur_s)
-    fn = core.batched_solve(d.V, d.NCON, d.NV, trace_cap,
-                            with_core=not host_core)
+    if _spmd_entry:
+        fn = batched_solve_sharded(mesh, d.V, d.NCON, d.NV, trace_cap,
+                                   with_core=not host_core)
+    else:
+        fn = core.batched_solve(d.V, d.NCON, d.NV, trace_cap,
+                                with_core=not host_core)
     res = fn(pts, budget)
     # One batched fetch for the whole result tree: each individual
     # device→host transfer pays a full round trip on a tunneled TPU
@@ -728,20 +737,33 @@ def _solve_monolith(problems, budget, mesh, trace_cap) -> List[core.SolveResult]
     trace_stack = np.asarray(res.trace_stack)
     trace_n = np.asarray(res.trace_n)
     if host_core:
-        unsat_idx = np.nonzero(outcome[:n] == core.UNSAT)[0]
-        if unsat_idx.size:
-            hc, hs = _host_core_rows(problems, unsat_idx, d, budget,
-                                     steps[unsat_idx],
-                                     allow_device=mesh is None)
-            cores = cores.copy()
-            cores[unsat_idx] = hc
-            steps[unsat_idx] += hs
-            outcome = np.where(steps > int(budget), core.RUNNING, outcome)
+        outcome, cores, steps = _host_core_patch(
+            problems, d, budget, outcome, cores, steps,
+            allow_device=mesh is None)
     return [
         core.SolveResult(outcome[i], installed[i], cores[i], steps[i],
                          trace_stack[i], trace_n[i])
         for i in range(n)
     ]
+
+
+def _host_core_patch(problems, d: _Dims, budget, outcome, cores, steps,
+                     allow_device: bool = False):
+    """Host-route core extraction for a fetched single-program result's
+    UNSAT rows (the ``with_core=False`` compositions: monolith and the
+    mesh-serving shard dispatch) — same steps/outcome convention as
+    :func:`_host_core_rows`.  Returns (outcome, cores, steps); inputs
+    are host numpy, ``cores`` is copied before patching."""
+    unsat_idx = np.nonzero(outcome[: len(problems)] == core.UNSAT)[0]
+    if unsat_idx.size:
+        hc, hs = _host_core_rows(problems, unsat_idx, d, budget,
+                                 steps[unsat_idx],
+                                 allow_device=allow_device)
+        cores = cores.copy()
+        cores[unsat_idx] = hc
+        steps[unsat_idx] += hs
+        outcome = np.where(steps > int(budget), core.RUNNING, outcome)
+    return outcome, cores, steps
 
 
 # Per-dispatch lane cap (power of two).  Two reasons: (1) the axon-tunneled
@@ -1115,7 +1137,8 @@ def _deadline_results(problems) -> List[core.SolveResult]:
     ]
 
 
-def _recovering(impl):
+def _recovering(impl, breaker=None, point: str = "driver.dispatch",
+                on_fault=None):
     """Wrap a dispatch-group impl with the fault-domain policy.
 
     Order of recovery for a failing group: (1) retry up to
@@ -1129,24 +1152,36 @@ def _recovering(impl):
 
     The breaker sees every failure and success; once open, groups route
     straight to the host engine without paying an attempt, until the
-    cooldown's half-open probe dispatch."""
+    cooldown's half-open probe dispatch.  ``breaker`` defaults to the
+    process-wide accelerator breaker; the mesh-serving path passes a
+    per-device breaker and its shard's fault point
+    (``driver.shard_dispatch.N``) so a poisoned shard charges — and
+    trips — only its own device (ISSUE 6).  ``on_fault`` (optional) is
+    called whenever the group leaves the clean path — a dispatch
+    failure or a breaker-open host route — possibly more than once per
+    call (retries, split halves); callers wanting once-per-group
+    semantics dedup themselves (the shard recovery counter does)."""
 
     def run(problems, budget, mesh, trace_cap):
         policy = faults.RetryPolicy.from_env()
-        breaker = faults.default_breaker()
+        nonlocal breaker
+        if breaker is None:
+            breaker = faults.default_breaker()
         reg = telemetry.default_registry()
         dl = faults.current_deadline()
         if dl is not None and dl.expired():
-            faults.note_deadline_exceeded("driver.dispatch", len(problems))
+            faults.note_deadline_exceeded(point, len(problems))
             return _deadline_results(problems)
         if not breaker.allow():
+            if on_fault is not None:
+                on_fault()
             return _fault_results_host(problems, budget,
                                        reason="breaker_open")
         attempt = 0
         while True:
             t0 = _time.monotonic()
             try:
-                faults.inject("driver.dispatch")
+                faults.inject(point)
                 results = impl(problems, budget, mesh, trace_cap)
             except (InternalSolverError, NotSatisfiable, Incomplete,
                     faults.DeadlineExceeded):
@@ -1158,14 +1193,15 @@ def _recovering(impl):
                 raise
             except Exception as e:
                 attempt += 1
+                if on_fault is not None:
+                    on_fault()
                 breaker.record_failure()
                 faults.fault_counter("deppy_fault_failures_total").inc()
                 reg.event("fault", fault="dispatch_failed",
                           error=type(e).__name__, attempt=attempt,
                           problems=len(problems), breaker=breaker.state())
                 if dl is not None and dl.expired():
-                    faults.note_deadline_exceeded("driver.dispatch",
-                                                  len(problems))
+                    faults.note_deadline_exceeded(point, len(problems))
                     return _deadline_results(problems)
                 if attempt < policy.max_attempts and not breaker.blocks_device():
                     faults.fault_counter("deppy_fault_retries").inc()
@@ -1204,12 +1240,18 @@ def _recovering(impl):
     return run
 
 
-def _solve_escalating(impl, problems, budget, mesh, trace_cap):
+def _solve_escalating(impl, problems, budget, mesh, trace_cap,
+                      breaker=None, point: str = "driver.dispatch",
+                      on_fault=None):
     """Run ``impl`` in two budget stages when profitable; transparent
     fallbacks otherwise.  Tracing disables escalation (stage-2 re-runs
     would re-record trace buffers from scratch).  Every impl call is
-    wrapped by the fault-domain recovery policy (:func:`_recovering`)."""
-    impl = _recovering(impl)
+    wrapped by the fault-domain recovery policy (:func:`_recovering`);
+    ``breaker``/``point``/``on_fault`` pass through to it so the
+    mesh-serving path runs this same pipeline under a per-device fault
+    domain (ISSUE 6)."""
+    impl = _recovering(impl, breaker=breaker, point=point,
+                       on_fault=on_fault)
     reg = telemetry.default_registry()
     if (
         STAGE1_STEPS <= 0
@@ -1266,6 +1308,295 @@ def _solve_escalating(impl, problems, budget, mesh, trace_cap):
             # invariant as single-stage).
             results[i] = r
         return results
+
+
+# ------------------------------------------------------------- mesh serving
+#
+# ISSUE 6 tentpole: the scheduler's coalesced micro-batches shard their
+# lane axis across a device mesh instead of landing on one chip.  The
+# shape of the machinery:
+#
+#   * batched_solve_sharded — the batch-axis sharded dispatch: the
+#     single-program batched solve jitted with explicit PartitionSpec
+#     shardings on the lane axis, memoized per (mesh, signature) exactly
+#     like parallel.clause_shard._sharded_fn.  This is the SPMD
+#     spelling: one program, the whole mesh, one fault domain
+#     (solve_problems_sharded(spmd=True); the bench scaling row and the
+#     multichip dry run measure it against the serving composition);
+#   * solve_problems_sharded — the serving entry: slice the batch into
+#     per-device shards and drain each device's shards on its own
+#     worker thread through the FULL phased pipeline (size-class
+#     bucketing → compacted three-phase dispatch → budget escalation —
+#     the same composition the single-device path serves with, so the
+#     mesh pays no composition tax), with EACH shard under its own
+#     fault domain — retry/split/host-fallback via the PR 2 _recovering
+#     machinery for that slice only, charging a per-device breaker
+#     (deppy_breaker_state{device=...}) so one bad chip degrades one
+#     shard of the mesh, not the process.
+#
+# One program per device rather than one SPMD program over the mesh for
+# the *serving* path: problems are independent (zero collectives either
+# way — XLA would partition the SPMD program into the same per-device
+# work), but separate programs make the fault blast radius one shard,
+# which is the entire point of per-shard fault domains — and the
+# per-device spelling keeps the phased/compacted composition, where the
+# SPMD monolith lane-gates every phase (an UNSAT lane serializes its
+# whole dispatch through the deletion loop).
+
+
+@_functools.lru_cache(maxsize=32)
+def batched_solve_sharded(mesh, V: int, NCON: int, NV: int,
+                          trace_cap: int = 0, with_core: bool = True):
+    """Batch-axis sharded dispatch entry (ISSUE 6): the vmapped
+    single-program solve jitted with every ``ProblemTensors`` leaf
+    sharded on its leading (lane) axis over the mesh's ``batch`` axis
+    (``PartitionSpec``; SNIPPETS.md [1]-[3]), budget replicated, outputs
+    lane-sharded.  Memoized per (mesh, space signature); input-shape
+    variation within a signature retraces via jit's own cache."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.mesh import BATCH_AXIS
+
+    s_lane = NamedSharding(mesh, PartitionSpec(BATCH_AXIS))
+    s_repl = NamedSharding(mesh, PartitionSpec())
+    vfn = jax.vmap(
+        _functools.partial(core.solve_full, V=V, NCON=NCON, NV=NV,
+                           T=trace_cap, with_core=with_core),
+        in_axes=(0, None),
+    )
+    in_sh = (
+        core.ProblemTensors(
+            *([s_lane] * len(core.ProblemTensors._fields))),
+        s_repl,
+    )
+    out_sh = core.SolveResult(
+        *([s_lane] * len(core.SolveResult._fields)))
+    return jax.jit(vfn, in_shardings=in_sh, out_shardings=out_sh)
+
+
+@_functools.lru_cache(maxsize=64)
+def _device_submesh(device):
+    """One-device 1-D batch mesh (memoized so the pjit entry's
+    per-(mesh, signature) cache hits across dispatches)."""
+    from ..parallel.mesh import default_mesh
+
+    return default_mesh([device])
+
+
+def _shard_slices(n: int, n_dev: int) -> List[List[int]]:
+    """Contiguous lane slices for a sharded dispatch: ``ceil(n/n_dev)``
+    lanes per shard, capped at MAX_LANES (oversized single programs are
+    the documented worker-crash class); shard *i* runs on device
+    ``i % n_dev``, so batches past ``n_dev × MAX_LANES`` wrap round-robin
+    and every device stays busy."""
+    per = min(-(-n // n_dev), MAX_LANES)
+    return [list(range(lo, min(lo + per, n)))
+            for lo in range(0, n, per)]
+
+
+def _solve_spmd(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
+    """SPMD spelling of the mesh entry: ONE program over the whole mesh,
+    the lane axis partitioned by :func:`batched_solve_sharded`'s explicit
+    shardings.  Single fault domain — the bench scaling record and the
+    multichip dry run measure it against the per-device serving
+    composition (:func:`_solve_sharded_inner`)."""
+    return _solve_monolith(problems, budget, mesh, trace_cap,
+                           _spmd_entry=True)
+
+
+def _shard_pipeline(problems, budget, submesh, trace_cap, breaker, point,
+                    on_fault) -> List[core.SolveResult]:
+    """One shard slice through the FULL single-device composition —
+    size-class bucketing, compacted three-phase dispatch, budget
+    escalation (the same pipeline :func:`_solve_problems_inner` runs) —
+    under the shard's per-device fault domain.  This is why the mesh
+    path pays no composition tax over single-device serving: the old
+    monolith-per-shard spelling lane-gated every phase, serializing a
+    shard's SAT lanes through its UNSAT lanes' deletion loops."""
+    n = len(problems)
+    impl = _solve_split if n > 1 else _solve_monolith
+    buckets = partition_buckets(problems) if n > 1 else [list(range(n))]
+    if len(buckets) == 1:
+        return _solve_escalating(impl, list(problems), budget, submesh,
+                                 trace_cap, breaker=breaker, point=point,
+                                 on_fault=on_fault)
+    out: List[Optional[core.SolveResult]] = [None] * n
+    for idxs in buckets:
+        sub = _solve_escalating(impl, [problems[i] for i in idxs], budget,
+                                submesh, trace_cap, breaker=breaker,
+                                point=point, on_fault=on_fault)
+        for i, r in zip(idxs, sub):
+            out[i] = r
+    return out  # type: ignore[return-value]
+
+
+def _solve_sharded_inner(problems, budget, mesh,
+                         trace_cap: int) -> List[core.SolveResult]:
+    n = len(problems)
+    devices = list(mesh.devices.flat)
+    n_dev = len(devices)
+    reg = telemetry.default_registry()
+    rep = telemetry.current_report()
+    dl = faults.current_deadline()
+    if dl is not None and dl.expired():
+        faults.note_deadline_exceeded("driver.mesh_dispatch", n)
+        return _deadline_results(problems)
+    slices = _shard_slices(n, n_dev)
+    c_disp = reg.counter(
+        "deppy_shard_dispatches_total",
+        "Mesh-serving shard dispatches, by device.", labelname="device")
+    c_rec = reg.counter(
+        "deppy_shard_recoveries_total",
+        "Shard slices that entered per-device fault recovery "
+        "(retry / split / host fallback).", labelname="device")
+    results: List[Optional[core.SolveResult]] = [None] * n
+    shard_reports: List[Optional[telemetry.SolveReport]] = \
+        [None] * len(slices)
+    shard_spans: List[Optional[tuple]] = [None] * len(slices)
+    errors: List[BaseException] = []
+
+    def drain_device(di: int) -> None:
+        # One worker per device (a device runs one program at a time, so
+        # more threads per device buy nothing): drains this device's
+        # round-robin share of the slices serially, each through the
+        # full phased pipeline under the device's own fault domain.  The
+        # report and batch deadline both travel on thread-locals, so the
+        # worker re-installs the parent's deadline and fills its own
+        # report for the parent to merge after the join — sharing the
+        # parent's report would race its unlocked counters.
+        dev = devices[di]
+        dev_key = str(getattr(dev, "id", di))
+        # The device's own breaker gated on the process-wide one: an
+        # open accelerator verdict host-routes every shard without an
+        # attempt (PR 2's guarantee), while failures charge only this
+        # device so one bad chip trips one shard of the mesh.
+        br = faults.GatedDeviceBreaker(faults.device_breaker(dev_key),
+                                       faults.default_breaker())
+        submesh = _device_submesh(dev)
+        for si in range(di, len(slices), n_dev):
+            idxs = slices[si]
+            sub = [problems[i] for i in idxs]
+            c_disp.inc(label=dev_key)
+            fired = [False]
+
+            def on_fault(fired=fired):
+                # Once per slice, however many retries / split halves /
+                # breaker-open host routes the recovery walk takes.
+                if not fired[0]:
+                    fired[0] = True
+                    c_rec.inc(label=dev_key)
+
+            srep, owns = telemetry.begin_report(backend="tpu")
+            t1 = _time.perf_counter()
+            try:
+                with faults.deadline_scope(dl):
+                    out = _shard_pipeline(
+                        sub, budget, submesh, trace_cap, breaker=br,
+                        point=f"driver.shard_dispatch.{di}",
+                        on_fault=on_fault)
+            except BaseException as e:  # re-raised on the parent thread
+                errors.append(e)
+                return
+            finally:
+                telemetry.detach_report(srep, owns)
+                if owns:
+                    shard_reports[si] = srep
+            shard_spans[si] = (dev_key, len(idxs),
+                               _time.perf_counter() - t1)
+            for i, r in zip(idxs, out):
+                results[i] = r
+
+    workers = [
+        _threading.Thread(target=drain_device, args=(di,),
+                          name=f"deppy-shard-{di}", daemon=True)
+        for di in range(min(n_dev, len(slices)))
+    ]
+    with reg.span("driver.mesh_dispatch", problems=n, shards=len(slices),
+                  devices=n_dev):
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join()
+    # Spans and report merge land on the parent thread: record_span here
+    # stamps the submitting request's trace context (workers have none),
+    # and the merged report keeps one report event per batch.
+    for entry in shard_spans:
+        if entry is not None:
+            dev_key, lanes, dur = entry
+            reg.record_span("driver.shard_solve", dur, device=dev_key,
+                            lanes=lanes)
+    if rep is not None:
+        for srep in shard_reports:
+            if srep is not None:
+                rep.merge(srep)
+    if errors:
+        # Semantic outcomes (InternalSolverError et al.) pass through
+        # _recovering untouched; surface the first one exactly as the
+        # unsharded path would.
+        raise errors[0]
+    return results  # type: ignore[return-value]
+
+
+def solve_problems_sharded(
+    problems: Sequence[Problem],
+    mesh=None,
+    max_steps: Optional[int] = None,
+    trace_cap: int = 0,
+    spmd: bool = False,
+) -> List[core.SolveResult]:
+    """Mesh-serving batch entry (ISSUE 6): shard one coalesced
+    micro-batch's lane axis across ``mesh``'s devices — one worker
+    thread per device draining its shards through the full phased
+    pipeline, per-shard fault domains (see
+    :func:`_solve_sharded_inner`).  Byte-identical results to
+    :func:`solve_problems` on the same batch — problems are independent
+    and sharding only changes placement — which the shard test suite
+    pins.  Falls back to :func:`solve_problems` when the mesh is absent
+    or single-device or the batch has a single problem.
+
+    ``spmd=True`` instead dispatches the whole batch as ONE program
+    whose lane axis is partitioned over the mesh by explicit
+    ``PartitionSpec`` shardings (:func:`batched_solve_sharded`) under a
+    single fault domain — same answers, whole-mesh blast radius; the
+    bench scaling record measures both spellings."""
+    if (mesh is None or getattr(mesh, "size", 1) < 2
+            or len(problems) < 2):
+        return solve_problems(problems, max_steps=max_steps,
+                              trace_cap=trace_cap)
+    for p in problems:
+        if p.errors:
+            raise InternalSolverError(p.errors)
+    rep, owns = telemetry.begin_report(backend="tpu",
+                                       n_problems=len(problems))
+    reg = telemetry.default_registry()
+    t0 = _time.perf_counter()
+    try:
+        with faults.ambient_deadline(), \
+                reg.span("driver.solve", problems=len(problems),
+                         devices=int(mesh.size)):
+            if spmd:
+                results = _recovering(_solve_spmd)(
+                    list(problems), _budget(max_steps), mesh, trace_cap)
+            else:
+                results = _solve_sharded_inner(
+                    problems, _budget(max_steps), mesh, trace_cap)
+        for r in results:
+            o = int(r.outcome)
+            key = ("sat" if o == core.SAT
+                   else "unsat" if o == core.UNSAT else "incomplete")
+            rep.count_outcome(key)
+            rep.steps += int(r.steps)
+            rep.backtracks += int(r.trace_n)
+        reg.histogram(
+            "deppy_solve_seconds",
+            "Wall-clock seconds per driver solve call (pad through "
+            "decode).",
+        ).observe(_time.perf_counter() - t0)
+    finally:
+        rep.add_wall("solve", _time.perf_counter() - t0)
+        if owns:
+            telemetry.end_report(rep, owns)
+    return results
 
 
 def solve_problems(

@@ -103,7 +103,7 @@ def _lanes_iota(n: int) -> jax.Array:
 def _row_read(arr: jax.Array, idx) -> jax.Array:
     """arr [N, W], scalar idx → [1, W] row (zeros when idx not in range)."""
     sel = _rows_iota(arr.shape[0]) == idx
-    return jnp.where(sel, arr, 0).sum(axis=0, keepdims=True)
+    return core.tree_sum(jnp.where(sel, arr, 0), axis=0, keepdims=True)
 
 
 def _row_write(arr: jax.Array, idx, row: jax.Array, gate=True) -> jax.Array:
@@ -115,7 +115,7 @@ def _row_write(arr: jax.Array, idx, row: jax.Array, gate=True) -> jax.Array:
 def _lane_read(row: jax.Array, idx) -> jax.Array:
     """row [1, N], scalar idx → scalar (0 when idx not in range)."""
     sel = _lanes_iota(row.shape[1]) == idx
-    return jnp.where(sel, row, 0).sum()
+    return core.tree_sum(jnp.where(sel, row, 0))
 
 
 def _lane_write(row: jax.Array, idx, val, gate=True) -> jax.Array:
@@ -189,7 +189,7 @@ def _first_unassigned(pvb, t, f):
     nz = un != 0
     has_un = nz.any()
     Wr = un.shape[1]
-    wi = jnp.min(jnp.where(nz, _lanes_iota(Wr), Wr)).astype(jnp.int32)
+    wi = core.tree_min(jnp.where(nz, _lanes_iota(Wr), Wr)).astype(jnp.int32)
     word = _lane_read(un, wi)
     lsb = word & -word
     return has_un, wi * WORD + core.popcount32(lsb - 1)
@@ -261,7 +261,7 @@ def _dpll(pos, neg, mem, card_active, card_n2, pvb, t_init, f_init,
         m_f = jnp.where(tot, f3, m_f)
 
         cand = (lvl <= sp) & (dec_phase == core.FALSE)
-        bt_l = jnp.max(jnp.where(cand, lvl, -1))
+        bt_l = core.tree_max(jnp.where(cand, lvl, -1))
         no_bt = bt_l < 0
         bt = do_step & conflict & ~no_bt
         status = jnp.where(do_step & conflict & no_bt,
@@ -373,7 +373,7 @@ def _kernel(en_ref, na_ref, budget_ref,
         idx = _lane_read(dq_i, jnp.clip(head, 0, DQ - 1))
         head_push = jnp.mod(head + 1, DQ)
         cands = _row_read(choice_cand, jnp.clip(cid, 0, NC - 1))  # [1, Kc]
-        ncand = (cands >= 0).sum()
+        ncand = core.tree_sum(cands >= 0)
         cand_var = _lane_read(cands, jnp.clip(idx, 0, Kc - 1))
         var = jnp.where(idx < ncand, cand_var, -1)
         # "some candidate already assumed" — candidate membership test on

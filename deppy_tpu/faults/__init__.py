@@ -30,7 +30,11 @@ from .breaker import (
     BREAKER_HALF_OPEN,
     BREAKER_OPEN,
     CircuitBreaker,
+    GatedDeviceBreaker,
     default_breaker,
+    device_breaker,
+    device_breakers,
+    reset_device_breakers,
     set_default_breaker,
 )
 from .metrics import FAMILIES, fault_counter
@@ -72,6 +76,10 @@ __all__ = [
     "current_plan",
     "deadline_scope",
     "default_breaker",
+    "device_breaker",
+    "device_breakers",
+    "GatedDeviceBreaker",
+    "reset_device_breakers",
     "env_float",
     "fault_counter",
     "FAMILIES",
@@ -102,6 +110,12 @@ def render_metric_lines() -> list:
         "# TYPE deppy_breaker_state gauge",
         f"deppy_breaker_state {default_breaker().state_code()}",
     ]
+    # Per-device breaker fleet (ISSUE 6): one labeled line per mesh
+    # device that has ever dispatched a shard, synthesized live like the
+    # process-wide gauge (cooldown edge included).
+    for key, br in sorted(device_breakers().items()):
+        lines.append(f'deppy_breaker_state{{device="{key}"}} '
+                     f"{br.state_code()}")
     for name in FAMILIES:
         fault_counter(name)  # ensure registered (zero) before rendering
     return lines + telemetry.default_registry().render_families(

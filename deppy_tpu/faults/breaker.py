@@ -45,13 +45,24 @@ _STATE_NAMES = {
 
 
 class CircuitBreaker:
-    """Thread-safe three-state breaker (closed → open → half-open)."""
+    """Thread-safe three-state breaker (closed → open → half-open).
+
+    ``device`` labels a per-device breaker (ISSUE 6: one breaker per
+    mesh device, so a single bad chip trips only its shard of the
+    serving mesh to host).  ``None`` is the historical process-wide
+    accelerator breaker; labeled breakers publish their transitions
+    with a ``device`` field and leave the process-wide
+    ``deppy_breaker_state`` gauge alone (the service's ``/metrics``
+    synthesizes ``deppy_breaker_state{device=...}`` lines from the
+    registry — see :func:`deppy_tpu.faults.render_metric_lines`)."""
 
     def __init__(self, failure_threshold: int = 3,
                  reset_after_s: float = 30.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 device: Optional[str] = None):
         self.failure_threshold = max(int(failure_threshold), 1)
         self.reset_after_s = float(reset_after_s)
+        self.device = device
         self._clock = clock
         self._lock = threading.Lock()
         self._state = BREAKER_CLOSED
@@ -198,12 +209,27 @@ class CircuitBreaker:
         from .metrics import BREAKER_STATE_HELP, fault_counter
 
         reg = telemetry.default_registry()
-        reg.gauge("deppy_breaker_state", BREAKER_STATE_HELP).set(ev["code"])
-        fault_counter("deppy_breaker_transitions_total").inc(
-            1, label=ev["state"])
-        reg.event("breaker", state=ev["state"],
-                  consecutive_failures=ev["consecutive_failures"])
-        if ev["state"] == "open" and ev.get("from") == "closed":
+        if self.device is None:
+            reg.gauge("deppy_breaker_state", BREAKER_STATE_HELP).set(
+                ev["code"])
+            reg.event("breaker", state=ev["state"],
+                      consecutive_failures=ev["consecutive_failures"])
+        else:
+            # Per-device breaker (ISSUE 6): the process-wide gauge stays
+            # the whole-accelerator verdict; this shard's state rides the
+            # event stream (and the /metrics mirror's labeled lines).
+            reg.event("breaker", state=ev["state"], device=self.device,
+                      consecutive_failures=ev["consecutive_failures"])
+        if self.device is None:
+            # Process transitions only: this counter predates the device
+            # fleet and alerts on it read "the accelerator is cycling".
+            # One flapping device must not fire that page — per-device
+            # churn is visible in the labeled state gauge lines and the
+            # device-tagged breaker events above.
+            fault_counter("deppy_breaker_transitions_total").inc(
+                1, label=ev["state"])
+        if (self.device is None and ev["state"] == "open"
+                and ev.get("from") == "closed"):
             # A FRESH trip (closed → open) is the incident moment: dump
             # the flight recorder to the JSONL sink NOW (ISSUE 4) — the
             # healthy context leading up to the trip.  Half-open probe
@@ -252,3 +278,84 @@ def set_default_breaker(
     with _DEFAULT_LOCK:
         prev, _DEFAULT = _DEFAULT, breaker
     return prev
+
+
+# --------------------------------------------------------- per-device fleet
+#
+# ISSUE 6: the mesh-sharded dispatch path charges failures to the breaker
+# of the DEVICE whose shard failed, so one bad chip degrades only its
+# slice of the serving mesh — batchmates on healthy devices keep
+# dispatching.  The process-wide breaker above stays the whole-
+# accelerator verdict (it still trips when every device is failing,
+# because the driver's non-sharded paths keep charging it).
+
+_DEVICE_BREAKERS: "dict[str, CircuitBreaker]" = {}
+_DEVICE_LOCK = threading.Lock()
+
+
+def device_breaker(device: object) -> CircuitBreaker:
+    """The breaker for one mesh device, keyed by its stable id (an int
+    device index or a ``jax.Device.id``); created from the same
+    ``DEPPY_TPU_BREAKER_*`` environment knobs as the process breaker on
+    first use."""
+    key = str(device)
+    with _DEVICE_LOCK:
+        br = _DEVICE_BREAKERS.get(key)
+        if br is None:
+            br = _breaker_from_env()
+            br.device = key
+            _DEVICE_BREAKERS[key] = br
+    return br
+
+
+def device_breakers() -> "dict[str, CircuitBreaker]":
+    """Snapshot of the per-device breaker fleet (metrics rendering)."""
+    with _DEVICE_LOCK:
+        return dict(_DEVICE_BREAKERS)
+
+
+def reset_device_breakers() -> None:
+    """Drop every per-device breaker (tests; also after a mesh
+    reconfiguration, where stale device keys would render forever)."""
+    with _DEVICE_LOCK:
+        _DEVICE_BREAKERS.clear()
+
+
+class GatedDeviceBreaker:
+    """A per-device breaker view that ALSO honors the process-wide
+    accelerator breaker: the mesh path must keep PR 2's guarantee that
+    an OPEN process breaker host-routes every dispatch group without
+    paying an attempt — a fleet-wide outage verdict applies to every
+    shard, not just the non-sharded paths.  Verdicts still charge only
+    the device breaker: one shard's failure must not trip the process
+    to host-only, and a shard success must not close (or consume the
+    half-open probe slot of) the process breaker — that slot belongs to
+    the driver's non-sharded probe dispatch."""
+
+    def __init__(self, device: CircuitBreaker, process: CircuitBreaker):
+        self._device = device
+        self._process = process
+
+    def allow(self) -> bool:
+        # blocks_device() is the non-consuming check: an open process
+        # breaker denies the shard without claiming its probe slot.
+        if self._process.blocks_device():
+            return False
+        return self._device.allow()
+
+    def blocks_device(self) -> bool:
+        return (self._process.blocks_device()
+                or self._device.blocks_device())
+
+    def state(self) -> int:
+        """The effective (most-degraded) state for fault events."""
+        return max(self._process.state(), self._device.state())
+
+    def record_success(self) -> None:
+        self._device.record_success()
+
+    def record_failure(self) -> bool:
+        return self._device.record_failure()
+
+    def abandon_probe(self) -> None:
+        self._device.abandon_probe()

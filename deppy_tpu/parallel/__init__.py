@@ -19,12 +19,16 @@ orthogonal parallelism axes:
 Both scale to multi-host DCN fleets via ``jax.distributed`` initialization.
 """
 
+from ._compat import resolve_shard_map, shard_map
 from .clause_shard import clause_mesh, solve_one_sharded, solve_sharded
-from .mesh import (BATCH_AXIS, default_mesh, initialize_distributed,
-                   replicated_sharding, shard_batch)
+from .mesh import (BATCH_AXIS, batch_sharding, default_mesh,
+                   initialize_distributed, mesh_devices_from_env,
+                   replicated_sharding, serving_mesh, shard_batch)
 
 __all__ = [
-    "BATCH_AXIS", "default_mesh", "initialize_distributed",
-    "replicated_sharding", "shard_batch",
+    "BATCH_AXIS", "batch_sharding", "default_mesh",
+    "initialize_distributed", "mesh_devices_from_env",
+    "replicated_sharding", "resolve_shard_map", "serving_mesh",
+    "shard_batch", "shard_map",
     "clause_mesh", "solve_one_sharded", "solve_sharded",
 ]

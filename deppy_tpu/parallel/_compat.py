@@ -1,0 +1,72 @@
+"""JAX version-compat shims for the sharded path.
+
+``shard_map`` has moved twice and renamed a kwarg once across the JAX
+releases this repo has met in the wild:
+
+  * ≤ 0.4.x — ``jax.experimental.shard_map.shard_map(..., check_rep=)``;
+  * ≥ 0.5/0.6 — promoted to ``jax.shard_map(..., check_vma=)`` (the
+    replication check was generalized to "varying manual axes").
+
+The sharded solve paths must run on whichever spelling the installed
+JAX carries — an AttributeError at dispatch time took out 17 tier-1
+tests on 0.4.37 (ROADMAP open item 1).  This module resolves the
+callable once, inspects its *actual* signature, and maps whichever of
+``check_rep``/``check_vma`` the caller used onto the parameter the
+installed build accepts, so both old and new call sites survive the
+next rename.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Optional
+
+
+@functools.lru_cache(maxsize=1)
+def resolve_shard_map() -> Callable:
+    """The installed build's ``shard_map`` callable, wherever it lives."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    return fn
+
+
+@functools.lru_cache(maxsize=1)
+def _check_param() -> Optional[str]:
+    """Which replication-check kwarg the installed ``shard_map`` takes:
+    ``"check_rep"``, ``"check_vma"``, or None when neither exists (the
+    check is dropped rather than guessed — passing an unknown kwarg is
+    the exact failure class this shim removes)."""
+    try:
+        params = inspect.signature(resolve_shard_map()).parameters
+    except (TypeError, ValueError):  # C-accelerated/builtin: no signature
+        return None
+    for name in ("check_rep", "check_vma"):
+        if name in params:
+            return name
+    if any(p.kind is inspect.Parameter.VAR_KEYWORD
+           for p in params.values()):
+        # **kwargs swallows anything; prefer the modern spelling.
+        return "check_vma"
+    return None
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_rep=None,
+              check_vma=None, **kwargs):
+    """Version-portable ``shard_map``.
+
+    ``check_rep`` and ``check_vma`` are aliases for the same knob (the
+    per-output replication/varying check); pass either and it reaches
+    the installed build under whatever name that build expects.  Extra
+    kwargs pass through untouched.
+    """
+    check = check_vma if check_vma is not None else check_rep
+    if check is not None:
+        param = _check_param()
+        if param is not None:
+            kwargs[param] = check
+    return resolve_shard_map()(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, **kwargs)

@@ -30,8 +30,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..sat.constraints import Variable
 from ..sat.encode import Problem, encode
-from ..sat.errors import Incomplete, InternalSolverError, NotSatisfiable
+from ..sat.errors import (BackendCapabilityError, Incomplete,
+                          InternalSolverError, NotSatisfiable)
 from ..engine import core, driver
+from ._compat import shard_map
 
 CLAUSE_AXIS = "clause"
 
@@ -80,7 +82,7 @@ def _sharded_fn(mesh: Mesh, V: int, NCON: int, NV: int,
     :class:`core.clause_axis` around invocations so those retraces pick
     up the collectives.  ``with_core=False`` compiles the deletion arm
     out (host-routed core extraction, driver.HOST_CORE_NCONS)."""
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         functools.partial(core.solve_full, V=V, NCON=NCON, NV=NV,
                           with_core=with_core),
         mesh=mesh,
@@ -104,9 +106,15 @@ def solve_sharded(
         # Only the bitplane round kernel carries the per-round OR
         # collective; the gather/pallas paths would propagate per-shard
         # with no cross-device combine and silently return wrong answers.
-        raise NotImplementedError(
-            "clause-sharded solve requires the 'bits' BCP impl "
-            f"(selected: {core._resolved_impl()!r})"
+        # Typed (not a raw NotImplementedError): callers that never chose
+        # an impl — the facade, the service — get a clean
+        # backend-capability verdict they can render, not an internal
+        # crash.
+        raise BackendCapabilityError(
+            "clause_shard", core._resolved_impl(),
+            hint="clause-sharded solve carries its per-round OR "
+            "collective only in the 'bits' BCP round kernel; unset "
+            "DEPPY_TPU_BCP_IMPL or select bits",
         )
     if mesh is None:
         mesh = clause_mesh()

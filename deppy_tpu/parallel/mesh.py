@@ -37,6 +37,62 @@ def default_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.array(devs), (BATCH_AXIS,))
 
 
+def mesh_devices_from_env() -> Optional[int]:
+    """Parse ``DEPPY_TPU_MESH_DEVICES`` (the ``--mesh-devices`` env
+    mirror): ``all`` or ``-1`` → -1 (every local device, the same
+    spelling the CLI flag documents), a positive integer → that many,
+    unset/empty/``0``/``1`` → None (mesh serving off — the historical
+    single-device dispatch).  Malformed values warn and degrade to off,
+    like every other fault-layer env knob."""
+    raw = (os.environ.get("DEPPY_TPU_MESH_DEVICES") or "").strip().lower()
+    if not raw or raw in ("0", "1", "off", "none"):
+        return None
+    if raw == "all":
+        return -1
+    try:
+        n = int(raw)
+    except ValueError:
+        n = None
+    if n == -1:
+        return -1
+    if n is None or n < 0:
+        import sys
+
+        print(f"[deppy] ignoring malformed DEPPY_TPU_MESH_DEVICES={raw!r} "
+              f"(want an integer or 'all'); mesh serving stays off",
+              file=sys.stderr, flush=True)
+        return None
+    return n if n > 1 else None
+
+
+def serving_mesh(n_devices: Optional[int] = None) -> Optional[Mesh]:
+    """The batch-axis mesh the scheduler's sharded drain dispatches over
+    (ISSUE 6), or None when mesh serving is off.  ``n_devices`` -1 (or
+    ``DEPPY_TPU_MESH_DEVICES=all``) takes every local device; a count
+    above the platform's device total clamps with a warning rather than
+    failing serving.  Callers resolve this lazily — only after the
+    backend probe said the device platform is usable — because
+    enumerating devices is exactly the call that hangs on a wedged
+    accelerator plugin (see assert_env_platform)."""
+    if n_devices is None:
+        n_devices = mesh_devices_from_env()
+    if n_devices is None:
+        return None
+    devs = jax.devices()
+    if n_devices == -1:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        import sys
+
+        print(f"[deppy] mesh-devices={n_devices} > {len(devs)} local "
+              f"devices; clamping to {len(devs)}", file=sys.stderr,
+              flush=True)
+        n_devices = len(devs)
+    if n_devices < 2:
+        return None
+    return default_mesh(devs[:n_devices])
+
+
 def batch_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     """Shard a rank-``ndim`` array's leading (batch) axis over the mesh;
     all trailing axes replicated per shard."""

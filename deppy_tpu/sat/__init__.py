@@ -26,6 +26,7 @@ from .constraints import (
 )
 from .encode import Problem, encode
 from .errors import (
+    BackendCapabilityError,
     DuplicateIdentifier,
     Incomplete,
     InternalSolverError,
@@ -38,6 +39,7 @@ from .tracer import DefaultTracer, LoggingTracer, SearchPosition, StatsTracer, T
 __all__ = [
     "AppliedConstraint",
     "AtMost",
+    "BackendCapabilityError",
     "Conflict",
     "Constraint",
     "Dependency",

@@ -57,7 +57,8 @@ from ..sat.errors import Incomplete, InternalSolverError, NotSatisfiable
 from .cache import MISS, ResultCache, fingerprint
 
 # Knob defaults + env mirrors (CLI flags --sched-max-wait-ms,
-# --sched-max-fill, --cache-size override; see deppy_tpu.cli).
+# --sched-max-fill, --cache-size, --mesh-devices override; see
+# deppy_tpu.cli).
 DEFAULT_MAX_WAIT_MS = 5.0
 DEFAULT_MAX_FILL = 256
 DEFAULT_CACHE_SIZE = 1024
@@ -127,9 +128,27 @@ class Scheduler:
         cache_size: Optional[int] = None,
         max_depth: Optional[int] = None,
         registry: Optional[telemetry.Registry] = None,
+        mesh=None,
+        mesh_devices: Optional[int] = None,
+        lanes_per_device: Optional[int] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
+        # Mesh serving (ISSUE 6): device dispatches shard each coalesced
+        # micro-batch over a jax mesh.  ``mesh`` pins one explicitly
+        # (tests, library callers); otherwise ``mesh_devices`` (or the
+        # DEPPY_TPU_MESH_DEVICES env mirror) sizes one LAZILY on the
+        # first device dispatch — enumerating devices up front is
+        # exactly the call that hangs on a wedged accelerator plugin,
+        # and the scheduler must never probe (see _prewarm_backend).
+        self._mesh = mesh
+        self._mesh_devices = mesh_devices
+        self._mesh_resolved = mesh is not None
+        self._max_fill_explicit = max_fill is not None
+        if lanes_per_device is None:
+            lanes_per_device = _env_int("DEPPY_TPU_SCHED_LANES_PER_DEVICE",
+                                        DEFAULT_MAX_FILL)
+        self.lanes_per_device = max(int(lanes_per_device), 1)
         if max_wait_ms is None:
             max_wait_ms = faults.env_float(
                 "DEPPY_TPU_SCHED_MAX_WAIT_MS", DEFAULT_MAX_WAIT_MS,
@@ -176,6 +195,41 @@ class Scheduler:
         self._thread: Optional[threading.Thread] = None
         # EWMA of dispatch wall clock, seeding the Retry-After estimate.
         self._dispatch_ewma_s = 0.05
+        if self._mesh is not None:
+            self._apply_mesh_sizing(self._mesh)
+
+    # ----------------------------------------------------------------- mesh
+
+    def _apply_mesh_sizing(self, mesh) -> None:
+        """Size micro-batches to the mesh: ``n_devices ×
+        lanes_per_device`` lanes per flush (ISSUE 6), so a full flush
+        hands every device a full shard.  An explicitly passed
+        ``max_fill`` wins — the operator said what they meant."""
+        if mesh is None or self._max_fill_explicit:
+            return
+        self.max_fill = max(int(mesh.size) * self.lanes_per_device, 1)
+
+    def _resolve_mesh(self):
+        """The serving mesh, resolved lazily on the first device
+        dispatch (never on the submit/queue path): by then the backend
+        probe has already established that touching the device platform
+        is safe.  Resolution failures degrade to single-device dispatch
+        — mesh serving must never take down serving."""
+        if self._mesh_resolved:
+            return self._mesh
+        try:
+            from ..parallel.mesh import serving_mesh
+
+            self._mesh = serving_mesh(self._mesh_devices)
+        except Exception as e:  # noqa: BLE001 — degrade, don't die
+            import sys
+
+            print(f"[sched] mesh resolution failed ({e}); serving "
+                  f"single-device", file=sys.stderr, flush=True)
+            self._mesh = None
+        self._mesh_resolved = True
+        self._apply_mesh_sizing(self._mesh)
+        return self._mesh
 
     # -------------------------------------------------------------- lifecycle
 
@@ -515,12 +569,20 @@ class Scheduler:
 
         problems = [lane.problem for lane in live]
         # All live lanes share one normalized budget (the flush policy
-        # only coalesces equal-budget groups).  solve_problems runs
-        # every dispatch group under the fault-domain recovery wrapper
-        # and merges its telemetry into the report begun above.
+        # only coalesces equal-budget groups).  Under a serving mesh
+        # (ISSUE 6) the coalesced micro-batch drains through the
+        # sharded entry point — lane axis split across devices,
+        # per-shard fault domains; otherwise solve_problems runs the
+        # group under the process-wide fault-domain recovery wrapper.
+        # Both merge their telemetry into the report begun above.
+        mesh = self._resolve_mesh()
         t1 = time.perf_counter()
-        results = driver.solve_problems(problems,
-                                        max_steps=live[0].max_steps)
+        if mesh is not None:
+            results = driver.solve_problems_sharded(
+                problems, mesh=mesh, max_steps=live[0].max_steps)
+        else:
+            results = driver.solve_problems(problems,
+                                            max_steps=live[0].max_steps)
         timing["solve_s"] = time.perf_counter() - t1
         t1 = time.perf_counter()
         decoded = driver.decode_results(problems, results)

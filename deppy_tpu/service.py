@@ -35,7 +35,8 @@ from typing import Dict, Optional, Tuple
 from . import faults
 from . import io as problem_io
 from . import telemetry
-from .sat.errors import DuplicateIdentifier, InternalSolverError
+from .sat.errors import (BackendCapabilityError, DuplicateIdentifier,
+                         InternalSolverError)
 
 
 class _V6HTTPServer(ThreadingHTTPServer):
@@ -224,6 +225,7 @@ class Server:
         sched_max_wait_ms: Optional[float] = None,
         sched_max_fill: Optional[int] = None,
         cache_size: Optional[int] = None,
+        mesh_devices: Optional[int] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -249,7 +251,8 @@ class Server:
                 backend=backend, max_steps=max_steps,
                 max_wait_ms=sched_max_wait_ms, max_fill=sched_max_fill,
                 cache_size=cache_size,
-                registry=self.metrics.registry)
+                registry=self.metrics.registry,
+                mesh_devices=mesh_devices)
         # Fault-domain knobs (ISSUE 2).  request_deadline_s: default
         # wall-clock budget per /v1/resolve (clients override per request
         # via the X-Deppy-Deadline-S header; None = unbounded).  drain_s
@@ -393,6 +396,13 @@ class Server:
                 if timings is not None:
                     timings["solve_s"] = time.perf_counter() - t0
         except (DuplicateIdentifier, InternalSolverError) as e:
+            self.metrics.observe_error()
+            return 400, {"error": str(e)}
+        except BackendCapabilityError as e:
+            # The selected backend/impl cannot serve this solve path
+            # (ISSUE 6 satellite): a clean capability verdict, not an
+            # internal 500 — the client (or operator) picks a different
+            # impl.
             self.metrics.observe_error()
             return 400, {"error": str(e)}
 
@@ -774,6 +784,7 @@ def serve(
     sched_max_wait_ms: Optional[float] = None,
     sched_max_fill: Optional[int] = None,
     cache_size: Optional[int] = None,
+    mesh_devices: Optional[int] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -786,7 +797,8 @@ def serve(
     srv = Server(bind_address, probe_address, backend, max_steps,
                  request_deadline_s=request_deadline_s, sched=sched,
                  sched_max_wait_ms=sched_max_wait_ms,
-                 sched_max_fill=sched_max_fill, cache_size=cache_size)
+                 sched_max_fill=sched_max_fill, cache_size=cache_size,
+                 mesh_devices=mesh_devices)
     srv.start()
     stop = threading.Event()
 

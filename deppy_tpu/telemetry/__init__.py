@@ -31,6 +31,7 @@ from .report import (
     SolveReport,
     begin_report,
     current_report,
+    detach_report,
     end_report,
     last_report,
 )
@@ -51,6 +52,7 @@ __all__ = [
     "configure_sink",
     "current_report",
     "default_registry",
+    "detach_report",
     "end_report",
     "last_report",
     "set_default_registry",

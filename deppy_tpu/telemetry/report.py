@@ -86,6 +86,31 @@ class SolveReport:
     def note_escalation(self, stage: int) -> None:
         self.escalation_stage = max(self.escalation_stage, stage)
 
+    def merge(self, other: "SolveReport") -> None:
+        """Fold a sub-report into this one — the mesh-serving path runs
+        one pipeline per device on worker threads, each filling its own
+        thread-local report (the driver internals find their report via
+        ``current_report()``, so shards cannot share the parent's
+        without racing its unlocked ``+=`` counters); the parent merges
+        them after the join.  Counters add, the escalation stage keeps
+        its max (same convention as multi-bucket batches), wall clock
+        sums per stage (threads overlap, so merged wall is cumulative
+        device-time, not elapsed — same reading as multi-chunk rows)."""
+        self.n_problems += other.n_problems
+        for k, v in other.outcomes.items():
+            self.outcomes[k] = self.outcomes.get(k, 0) + v
+        for field_name in ("steps", "backtracks", "decisions",
+                           "propagation_rounds", "batch_lanes",
+                           "live_lanes", "pad_cells", "live_cells",
+                           "n_chunks", "n_buckets", "host_fallback_rows",
+                           "fault_host_routed"):
+            setattr(self, field_name,
+                    getattr(self, field_name) + getattr(other, field_name))
+        self.escalation_stage = max(self.escalation_stage,
+                                    other.escalation_stage)
+        for k, v in other.wall.items():
+            self.add_wall(k, v)
+
     def count_outcome(self, outcome: str, n: int = 1) -> None:
         self.outcomes[outcome] = self.outcomes.get(outcome, 0) + n
 
@@ -204,6 +229,17 @@ def begin_report(backend: str = "tpu",
     rep = SolveReport(backend=backend, n_problems=n_problems)
     _TLS.active = rep
     return rep, True
+
+
+def detach_report(rep: SolveReport, owns: bool) -> None:
+    """End an owned report WITHOUT publishing it (no ``last_report``,
+    no sink event): the mesh shard workers bracket their per-thread
+    reports with ``begin_report``/``detach_report`` and hand them back
+    for the parent batch's report to :meth:`SolveReport.merge` — eight
+    shards must not emit eight ``report`` sink events for one batch.
+    No-op for non-owning (nested) callers, like :func:`end_report`."""
+    if owns and current_report() is rep:
+        _TLS.active = None
 
 
 def end_report(rep: SolveReport, owns: bool) -> None:
