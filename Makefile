@@ -84,6 +84,22 @@ shard-smoke: ## Mesh serving on a forced 8-device CPU platform: sharded-vs-unsha
 test-shard: ## Mesh-serving shard subsystem tests only (the `shard` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m shard
 
+.PHONY: lint
+lint: ## Static analysis: the four deppy-lint checkers vs analysis/baseline.json (ISSUE 7 acceptance; docs/analysis.md).
+	$(PYTHON) -m deppy_tpu lint
+
+.PHONY: test-analysis
+test-analysis: ## Static-analysis framework + lockdep tests only (the `analysis` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m analysis
+
+.PHONY: test-lockdep
+test-lockdep: ## The threaded-subsystem suites under runtime lock-order assertions (ISSUE 7 acceptance).
+	JAX_PLATFORMS=cpu DEPPY_TPU_LOCKDEP=1 DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m "chaos or sched or hostpool"
+
+.PHONY: lockdep-smoke
+lockdep-smoke: ## Scripted lock-order inversion end to end: LockdepError + sink event + flight recorder + stats/trace CLIs.
+	$(PYTHON) scripts/lockdep_smoke.py
+
 ##@ Benchmarks
 
 .PHONY: bench

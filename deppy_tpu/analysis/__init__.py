@@ -1,0 +1,53 @@
+"""deppy_tpu.analysis — static analysis + runtime lock discipline (ISSUE 7).
+
+The serving spine is six threaded subsystems around a jit/pjit/
+shard_map/pallas hot path — exactly the two failure classes no test
+tier can see: silent host-sync/recompile hazards *inside* traced code,
+and unsynchronized shared state *across* threads.  This package is the
+invariant gate those classes are held to:
+
+  * **checkers** — four AST checkers behind ``deppy lint``
+    (:mod:`.purity`, :mod:`.concurrency`, :mod:`.registry_sync`,
+    :mod:`.exceptions`), with a findings baseline
+    (``analysis/baseline.json``) so CI fails only on NEW findings while
+    the existing ones burn down (see docs/analysis.md);
+  * **lockdep** — a runtime lock-order assertion mode
+    (``DEPPY_TPU_LOCKDEP=1``, :mod:`.lockdep`): the subsystems' locks
+    are created through named factories, and with the mode armed every
+    acquisition is checked against the process's observed lock order —
+    inversions and self-deadlocks raise *before* they deadlock, and
+    emit ``lockdep`` events onto the telemetry sink / flight recorder.
+
+The checkers are import-light (stdlib ``ast`` only) so ``deppy lint``
+runs without JAX; lockdep imports telemetry lazily, only on violation.
+"""
+
+from .core import (
+    CHECKERS,
+    Baseline,
+    Finding,
+    baseline_path,
+    repo_root,
+    run_checkers,
+)
+from .lockdep import (
+    LockdepError,
+    lockdep_enabled,
+    make_condition,
+    make_lock,
+    make_rlock,
+)
+
+__all__ = [
+    "Baseline",
+    "CHECKERS",
+    "Finding",
+    "LockdepError",
+    "baseline_path",
+    "lockdep_enabled",
+    "make_condition",
+    "make_lock",
+    "make_rlock",
+    "repo_root",
+    "run_checkers",
+]

@@ -1,0 +1,101 @@
+"""``deppy lint`` — run the checkers, diff against the baseline.
+
+Exit codes: 0 = clean vs baseline, 1 = new findings (or stale baseline
+keys under ``--strict-baseline``), 2 = usage.  ``--github`` prints
+workflow annotation lines for new findings so sanity CI marks the
+exact source lines in the PR diff.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+from .core import (Baseline, Finding, baseline_path, repo_root,
+                   run_checkers)
+
+
+def run_lint(args) -> int:
+    from pathlib import Path
+
+    root = repo_root()
+    try:
+        findings = run_checkers(root, names=args.checker)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    bpath = Path(args.baseline) if args.baseline else baseline_path()
+    if args.update_baseline:
+        updated = Baseline.from_findings(findings)
+        if args.checker is not None:
+            # Partial run: replace only the selected checkers' keys —
+            # the other checkers' accepted findings were not re-scanned
+            # and must survive the rewrite.
+            try:
+                prior = Baseline.load(bpath)
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"error: cannot load baseline {bpath}: {e}",
+                      file=sys.stderr)
+                return 2
+            prefixes = tuple(f"{c}:" for c in args.checker)
+            for key, count in prior.counts.items():
+                if not key.startswith(prefixes):
+                    updated.counts[key] = count
+        updated.save(bpath)
+        print(f"baseline updated: {len(updated.counts)} key(s) -> "
+              f"{bpath}")
+        return 0
+    if args.no_baseline:
+        baseline = Baseline()
+    else:
+        try:
+            baseline = Baseline.load(bpath)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: cannot load baseline {bpath}: {e}",
+                  file=sys.stderr)
+            return 2
+    new, stale = baseline.diff(findings)
+    partial = args.checker is not None and not args.no_baseline
+    if partial:
+        # A single-checker run must not report every OTHER checker's
+        # baseline keys as stale.
+        prefixes = tuple(f"{c}:" for c in args.checker)
+        stale = [k for k in stale if k.startswith(prefixes)]
+
+    if args.json:
+        json.dump({
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.to_dict() for f in new],
+            "stale_baseline_keys": stale,
+            "baseline": str(bpath),
+        }, sys.stdout, indent=2)
+        print()
+    else:
+        _render_text(findings, new, stale)
+    if args.github:
+        for f in new:
+            # GitHub annotation format; the message must be one line.
+            msg = f"[{f.checker}/{f.code}] {f.message}".replace("\n", " ")
+            print(f"::warning file={f.path},line={f.line}::{msg}")
+    if new:
+        return 1
+    if stale and args.strict_baseline:
+        return 1
+    return 0
+
+
+def _render_text(findings: List[Finding], new: List[Finding],
+                 stale: List[str]) -> None:
+    new_keys = {id(f) for f in new}
+    for f in findings:
+        marker = "NEW " if id(f) in new_keys else "     "
+        print(f"{marker}{f.render()}")
+    if stale:
+        print(f"\n{len(stale)} stale baseline key(s) — findings fixed "
+              f"but still accepted; run `deppy lint --update-baseline` "
+              f"to burn them down:")
+        for k in stale:
+            print(f"  {k}")
+    print(f"\n{len(findings)} finding(s), {len(new)} new vs baseline"
+          + (f", {len(stale)} stale baseline key(s)" if stale else ""))

@@ -1,0 +1,325 @@
+"""concurrency-discipline: shared state vs its guarding lock.
+
+Scope: the threaded subsystems (``sched/``, ``faults/``, ``hostpool/``,
+``telemetry/``) — the scheduler's dispatch loop, the pool's worker
+multiplexer, the breaker, and the metrics registry all mutate state
+that other threads read.  Three rules:
+
+  * **guarded-attr access** — a mutable instance attribute (or module
+    global) that is *ever* mutated under ``with <lock>:`` is mapped to
+    that lock; any other *mutation* of it outside a lock context is
+    flagged (``unlocked-write``), and plain reads outside a lock are
+    flagged at lower confidence (``unlocked-read``) — a torn read of a
+    multi-field invariant is the classic scheduler bug.  Methods named
+    ``*_locked`` are the repo's caller-holds-the-lock convention and
+    count as guarded context.
+  * **lock-order** — ``with A: ... with B:`` records the edge A→B per
+    lock *name*; a reverse edge anywhere across the scanned subsystems
+    is a lock-order inversion (``lock-order``).  The runtime twin of
+    this rule is :mod:`deppy_tpu.analysis.lockdep`.
+  * **thread-local escape** — a ``threading.local()`` object handed to
+    another thread (as a ``Thread``/``submit`` argument) reads the
+    *receiving* thread's slots, which is how trace contexts silently
+    vanish across a thread hop (``tls-escape``).  The sanctioned hop is
+    value capture (``capture_parent`` / explicit Deadline objects).
+
+The inference is deliberately syntactic: it sees ``with self._lock:``
+blocks, not lock state through call chains — the registry's "families
+share the registry lock and are only rendered under it" pattern is
+invisible to it and rides the baseline with suppressions explaining
+exactly that.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, SourceFile
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore", "make_lock", "make_rlock",
+                   "make_condition"}
+_MUTATOR_METHODS = {"append", "extend", "insert", "pop", "popleft",
+                    "appendleft", "remove", "clear", "update",
+                    "setdefault", "add", "discard"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _dotted(node.func) or ""
+    return name.rsplit(".", 1)[-1] in _LOCK_FACTORIES
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``X`` (one level only)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, module: str, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.locks: Set[str] = set()          # lock attr names
+        self.guarded: Dict[str, str] = {}     # attr -> lock attr
+        # (attr, lineno, is_write, method, locked) accesses
+        self.accesses: List[Tuple[str, int, bool, str, bool]] = []
+
+
+class ConcurrencyChecker(Checker):
+    name = "concurrency-discipline"
+    default_scope = ("deppy_tpu/sched", "deppy_tpu/faults",
+                     "deppy_tpu/hostpool", "deppy_tpu/telemetry")
+
+    def check(self, files: List[SourceFile], root: Path) -> List[Finding]:
+        out: List[Finding] = []
+        # lock-name -> lock-name ordered edges, with one witness site.
+        edges: Dict[Tuple[str, str], Tuple[SourceFile, int]] = {}
+        for sf in files:
+            self._check_module(out, sf, edges)
+        self._check_lock_order(out, edges)
+        return out
+
+    # ------------------------------------------------------------ classes
+
+    def _check_module(self, out: List[Finding], sf: SourceFile,
+                      edges) -> None:
+        module = sf.rel
+        module_locks: Set[str] = set()
+        for node in sf.tree.body:
+            if (isinstance(node, ast.Assign) and _is_lock_ctor(node.value)
+                    and node.targets
+                    and isinstance(node.targets[0], ast.Name)):
+                module_locks.add(node.targets[0].id)
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                info = self._index_class(module, node, module_locks,
+                                         sf, edges)
+                self._flag_class(out, sf, info)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Module-level functions contribute lock-order edges on
+                # the module's global locks (the singleton double-check
+                # pattern lives here).
+                self._module_fn_edges(node, module, module_locks, sf,
+                                      edges)
+        # Module-level thread-local escapes.
+        tls_names = {
+            t.targets[0].id for t in sf.tree.body
+            if isinstance(t, ast.Assign) and t.targets
+            and isinstance(t.targets[0], ast.Name)
+            and isinstance(t.value, ast.Call)
+            and (_dotted(t.value.func) or "").endswith("local")
+        }
+        if tls_names:
+            self._check_tls_escape(out, sf, tls_names)
+
+    def _index_class(self, module: str, node: ast.ClassDef,
+                     module_locks: Set[str], sf: SourceFile,
+                     edges) -> _ClassInfo:
+        info = _ClassInfo(module, node)
+        methods = [m for m in node.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        # Pass 1: lock attributes — assigned a lock constructor, or
+        # named like one (`self._lock = lock` parameter passing: the
+        # registry hands ONE lock to every metric family).
+        for m in methods:
+            for sub in ast.walk(m):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        attr = _self_attr(t)
+                        if attr and (_is_lock_ctor(sub.value)
+                                     or attr.endswith("lock")
+                                     or attr.endswith("_cv")):
+                            info.locks.add(attr)
+        # Pass 2: accesses with lock context, plus lock-order edges.
+        for m in methods:
+            caller_holds = m.name.endswith("_locked")
+            self._walk_method(info, m, module_locks, caller_holds,
+                              sf, edges)
+        return info
+
+    def _module_fn_edges(self, fn: ast.FunctionDef, module: str,
+                         module_locks: Set[str], sf: SourceFile,
+                         edges) -> None:
+        def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, ast.With):
+                new_held = held
+                for item in node.items:
+                    name = _dotted(item.context_expr)
+                    if name in module_locks:
+                        ln = f"{module}:{name}"
+                        for h in held:
+                            if h != ln and (h, ln) not in edges:
+                                edges[(h, ln)] = (sf, node.lineno)
+                        new_held = new_held + (ln,)
+                for child in node.body:
+                    visit(child, new_held)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, ())
+
+    def _walk_method(self, info: _ClassInfo, m: ast.FunctionDef,
+                     module_locks: Set[str], caller_holds: bool,
+                     sf: SourceFile, edges) -> None:
+        lock_label = f"{info.module}:{info.name}"
+
+        def lock_name_of(item_ctx: ast.AST) -> Optional[str]:
+            attr = _self_attr(item_ctx)
+            if attr and attr in info.locks:
+                return f"{lock_label}.{attr}"
+            name = _dotted(item_ctx)
+            if name in module_locks:
+                return f"{info.module}:{name}"
+            return None
+
+        def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, ast.With):
+                new_held = held
+                for item in node.items:
+                    ln = lock_name_of(item.context_expr)
+                    if ln is not None:
+                        for h in held:
+                            if h != ln and (h, ln) not in edges:
+                                edges[(h, ln)] = (sf, node.lineno)
+                        new_held = new_held + (ln,)
+                for child in node.body:
+                    visit(child, new_held)
+                return
+            # Record self-attr accesses at this nesting.
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr and attr not in info.locks:
+                        info.accesses.append(
+                            (attr, node.lineno, True, m.name,
+                             caller_holds or bool(held)))
+                visit_children(node, held)
+                return
+            if isinstance(node, ast.Call):
+                # self._x.append(...) and friends are writes.
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    attr = _self_attr(f.value)
+                    if (attr and attr not in info.locks
+                            and f.attr in _MUTATOR_METHODS):
+                        info.accesses.append(
+                            (attr, node.lineno, True, m.name,
+                             caller_holds or bool(held)))
+                visit_children(node, held)
+                return
+            if isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if (attr and attr not in info.locks
+                        and isinstance(node.ctx, ast.Load)):
+                    info.accesses.append(
+                        (attr, node.lineno, False, m.name,
+                         caller_holds or bool(held)))
+                visit_children(node, held)
+                return
+            visit_children(node, held)
+
+        def visit_children(node: ast.AST, held: Tuple[str, ...]) -> None:
+            for child in ast.iter_child_nodes(node):
+                # Nested defs get their own thread of control — a
+                # closure run under the method's lock inherits it only
+                # dynamically; stay conservative and keep held state
+                # (closures here run inline under _solve_locked etc.).
+                visit(child, held)
+
+        for stmt in m.body:
+            visit(stmt, ())
+
+    def _flag_class(self, out: List[Finding], sf: SourceFile,
+                    info: _ClassInfo) -> None:
+        if not info.locks:
+            return
+        # An attribute is lock-guarded when some WRITE happens under a
+        # lock outside __init__ (construction is single-threaded).
+        guarded: Set[str] = set()
+        for attr, _ln, is_write, meth, locked in info.accesses:
+            if is_write and locked and meth != "__init__":
+                guarded.add(attr)
+        write_sites = {(attr, ln) for attr, ln, is_write, _m, _l
+                       in info.accesses if is_write}
+        for attr, ln, is_write, meth, locked in info.accesses:
+            if attr not in guarded or locked or meth == "__init__":
+                continue
+            if not is_write and (attr, ln) in write_sites:
+                continue  # the write finding already covers this site
+            if is_write:
+                self.finding(
+                    out, sf, ln, "unlocked-write",
+                    f"{info.name}.{attr}",
+                    f"`self.{attr}` is written under a lock elsewhere "
+                    f"but mutated without one in `{meth}` — guard it "
+                    f"or rename the method `*_locked`")
+            else:
+                self.finding(
+                    out, sf, ln, "unlocked-read",
+                    f"{info.name}.{attr}",
+                    f"`self.{attr}` is lock-guarded but read without "
+                    f"the lock in `{meth}` — torn/stale reads cross "
+                    f"threads here")
+
+    # --------------------------------------------------------- lock order
+
+    def _check_lock_order(self, out: List[Finding], edges) -> None:
+        for (a, b), (sf, ln) in sorted(edges.items()):
+            if (b, a) in edges:
+                rsf, rln = edges[(b, a)]
+                # Report each inversion once, from the lexically first
+                # edge, naming the reverse witness.
+                if (a, b) < (b, a):
+                    self.finding(
+                        out, sf, ln, "lock-order",
+                        f"{a}<->{b}",
+                        f"lock-order inversion: {a} -> {b} here but "
+                        f"{b} -> {a} at {rsf.rel}:{rln} — one thread "
+                        f"per order deadlocks")
+
+    # --------------------------------------------------------- tls escape
+
+    def _check_tls_escape(self, out: List[Finding], sf: SourceFile,
+                          tls_names: Set[str]) -> None:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = _dotted(node.func) or ""
+            leaf = target.rsplit(".", 1)[-1]
+            if leaf not in ("Thread", "submit", "apply_async", "Process"):
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                for sub in ast.walk(arg):
+                    if (isinstance(sub, ast.Name)
+                            and sub.id in tls_names):
+                        self.finding(
+                            out, sf, node.lineno, "tls-escape",
+                            sub.id,
+                            f"thread-local `{sub.id}` handed across a "
+                            f"thread boundary — the receiving thread "
+                            f"sees empty slots; capture the VALUE "
+                            f"before the hop")

@@ -1,0 +1,247 @@
+"""Checker framework: findings, suppressions, baseline, runner.
+
+Design points:
+
+  * **Findings have a stable identity** (``checker:path:code:symbol``)
+    that deliberately excludes the line number, so the baseline file
+    survives unrelated edits above a finding.  Identical findings in
+    one file are *counted* — the baseline stores ``key -> count`` and
+    only a count increase is "new".
+  * **Suppressions are in-line and reasoned.**  A
+    ``# deppy: lint-ok[checker] reason`` comment on the flagged line
+    (or the line above it) suppresses that checker there; ``[*]``
+    suppresses all.  The reason is mandatory culture, not syntax — the
+    burn-down satellite removes suppressions, it never adds bare ones.
+  * **The runner is pure stdlib** (``ast`` + ``json``): ``deppy lint``
+    must run in CI before JAX imports are even possible.
+
+See docs/analysis.md for the operator view of each checker.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+# checker name -> in-line suppression token.
+SUPPRESS_RE = re.compile(r"#\s*deppy:\s*lint-ok\[([a-z*\-]+)\]")
+
+
+def repo_root() -> Path:
+    """The checkout root: the parent of the ``deppy_tpu`` package."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def baseline_path() -> Path:
+    return Path(__file__).resolve().parent / "baseline.json"
+
+
+@dataclass
+class Finding:
+    """One checker hit.  ``symbol`` names the offending thing (an env
+    var, a lock attribute, a function) — it is part of the baseline
+    identity, the line number is display-only."""
+
+    checker: str
+    path: str       # repo-relative, forward slashes
+    line: int
+    code: str       # short kebab-case slug of the rule
+    symbol: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.checker}:{self.path}:{self.code}:{self.symbol}"
+
+    def to_dict(self) -> dict:
+        return {"checker": self.checker, "path": self.path,
+                "line": self.line, "code": self.code,
+                "symbol": self.symbol, "message": self.message}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.checker}/{self.code}] "
+                f"{self.message}")
+
+
+@dataclass
+class SourceFile:
+    """One parsed module handed to every checker (parse once)."""
+
+    path: Path          # absolute
+    rel: str            # repo-relative
+    text: str
+    lines: List[str] = field(default_factory=list)
+    tree: Optional[ast.AST] = None
+    parse_error: Optional[str] = None
+
+    @classmethod
+    def load(cls, path: Path, root: Path) -> "SourceFile":
+        text = path.read_text(encoding="utf-8")
+        sf = cls(path=path, rel=path.relative_to(root).as_posix(),
+                 text=text, lines=text.splitlines())
+        try:
+            sf.tree = ast.parse(text)
+        except SyntaxError as e:  # a broken file is itself a finding
+            sf.parse_error = str(e)
+        return sf
+
+    def suppressed(self, line: int, checker: str) -> bool:
+        """True when ``line`` (1-based) or the line above carries a
+        ``# deppy: lint-ok[checker]`` (or ``[*]``) comment."""
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                for m in SUPPRESS_RE.finditer(self.lines[ln - 1]):
+                    if m.group(1) in (checker, "*"):
+                        return True
+        return False
+
+
+class Checker:
+    """Base: subclasses set ``name``/``default_scope`` and implement
+    ``check``.  ``default_scope`` is a list of repo-relative glob
+    prefixes the checker runs over when the CLI is given none."""
+
+    name = "checker"
+    default_scope: Tuple[str, ...] = ("deppy_tpu",)
+
+    def check(self, files: List[SourceFile], root: Path) -> List[Finding]:
+        raise NotImplementedError
+
+    # Helper for subclasses: emit unless suppressed.
+    def finding(self, out: List[Finding], sf: SourceFile, line: int,
+                code: str, symbol: str, message: str) -> None:
+        if sf.suppressed(line, self.name):
+            return
+        out.append(Finding(checker=self.name, path=sf.rel, line=line,
+                           code=code, symbol=symbol, message=message))
+
+
+class Baseline:
+    """``key -> count`` of accepted findings (``analysis/baseline.json``).
+
+    ``diff`` returns the findings beyond the baseline's counts — the
+    ones a CI run fails on — and the stale keys the baseline carries
+    for findings that no longer exist (burn-down bookkeeping: stale
+    keys warn, they do not fail)."""
+
+    def __init__(self, counts: Optional[Dict[str, int]] = None):
+        self.counts: Dict[str, int] = dict(counts or {})
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.exists():
+            return cls()
+        doc = json.loads(path.read_text(encoding="utf-8"))
+        if not isinstance(doc, dict) or not isinstance(
+                doc.get("findings"), dict):
+            raise ValueError(
+                f"{path}: expected {{\"findings\": {{key: count}}}}")
+        return cls({str(k): int(v) for k, v in doc["findings"].items()})
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        counts: Dict[str, int] = {}
+        for f in findings:
+            counts[f.key] = counts.get(f.key, 0) + 1
+        return cls(counts)
+
+    def save(self, path: Path) -> None:
+        doc = {
+            "_comment": [
+                "deppy lint findings baseline: key -> accepted count.",
+                "CI fails on findings NOT covered here; burn this file",
+                "down, never grow it by hand (deppy lint",
+                "--update-baseline regenerates it).",
+            ],
+            "findings": {k: self.counts[k] for k in sorted(self.counts)},
+        }
+        path.write_text(json.dumps(doc, indent=2) + "\n", encoding="utf-8")
+
+    def diff(self, findings: List[Finding]) -> Tuple[List[Finding],
+                                                     List[str]]:
+        seen: Dict[str, int] = {}
+        new: List[Finding] = []
+        for f in findings:
+            seen[f.key] = seen.get(f.key, 0) + 1
+            if seen[f.key] > self.counts.get(f.key, 0):
+                new.append(f)
+        stale = [k for k, n in sorted(self.counts.items())
+                 if seen.get(k, 0) < n]
+        return new, stale
+
+
+# ---------------------------------------------------------------- runner
+
+
+def _iter_py_files(root: Path, scopes: Iterable[str]) -> List[Path]:
+    out: List[Path] = []
+    seen = set()
+    for scope in scopes:
+        base = root / scope
+        if base.is_file():
+            paths = [base]
+        else:
+            paths = sorted(base.rglob("*.py"))
+        for p in paths:
+            if "__pycache__" in p.parts or p in seen:
+                continue
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+def checker_registry() -> Dict[str, Callable[[], Checker]]:
+    # Local imports: each checker module is tiny, but keeping the
+    # registry lazy means a syntax error in one checker doesn't take
+    # down `deppy lint --checker <other>`.
+    from . import concurrency, exceptions, purity, registry_sync
+
+    return {
+        purity.TracePurityChecker.name: purity.TracePurityChecker,
+        concurrency.ConcurrencyChecker.name:
+            concurrency.ConcurrencyChecker,
+        registry_sync.RegistrySyncChecker.name:
+            registry_sync.RegistrySyncChecker,
+        exceptions.ExceptionHygieneChecker.name:
+            exceptions.ExceptionHygieneChecker,
+    }
+
+
+CHECKERS = ("trace-purity", "concurrency-discipline", "registry-sync",
+            "exception-hygiene")
+
+
+def run_checkers(root: Optional[Path] = None,
+                 names: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the named checkers (default all) over the repo; returns
+    findings sorted by path/line for stable output."""
+    root = root or repo_root()
+    registry = checker_registry()
+    wanted = list(names) if names else list(registry)
+    unknown = [n for n in wanted if n not in registry]
+    if unknown:
+        raise ValueError(f"unknown checker(s) {unknown}; "
+                         f"have {sorted(registry)}")
+    findings: List[Finding] = []
+    cache: Dict[Path, SourceFile] = {}
+    for name in wanted:
+        checker = registry[name]()
+        files = []
+        for path in _iter_py_files(root, checker.default_scope):
+            sf = cache.get(path)
+            if sf is None:
+                sf = cache[path] = SourceFile.load(path, root)
+            files.append(sf)
+        for sf in files:
+            if sf.parse_error is not None:
+                checker.finding(findings, sf, 1, "syntax-error",
+                                sf.rel, f"file does not parse: "
+                                f"{sf.parse_error}")
+        findings.extend(checker.check(
+            [f for f in files if f.tree is not None], root))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.code))
+    return findings

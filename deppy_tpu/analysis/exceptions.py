@@ -1,0 +1,137 @@
+"""exception-hygiene: blind broad catches on the recovery path.
+
+A ``try/except Exception: pass`` in a recovery layer converts a novel
+failure into silence — no fault counter charged, no trace event
+stamped, nothing in the flight recorder.  PR 2-6 built an entire
+observability vocabulary for failures; this checker makes using it the
+default.
+
+A broad handler (bare ``except``, ``except Exception``, ``except
+BaseException`` — alone or in a tuple) is flagged as a **blind
+swallow** unless its body does at least one of:
+
+  * re-``raise`` (the error propagates, typed or wrapped);
+  * call an observability hook — ``Registry.event``, a counter's
+    ``.inc``, a histogram's ``.observe``, ``fault_counter``,
+    ``note_deadline_exceeded``, ``mark_error``, ``record_span``, a
+    logger — so the failure lands on the telemetry sink / trace;
+  * capture the failure into state another path surfaces — an
+    ``Assign`` whose *value* references the bound exception or whose
+    target is an attribute (``self._unavailable = ...``,
+    ``g.error = e``).
+
+``print`` deliberately does NOT count: stderr is invisible to the
+flight recorder, ``/metrics``, and ``deppy trace`` — the exact gap
+this checker exists to close.  Deliberately-silent sites (platform
+probes whose failure IS the verdict) carry
+``# deppy: lint-ok[exception-hygiene] reason`` suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional
+
+from .core import Checker, Finding, SourceFile
+
+_BROAD = {"Exception", "BaseException"}
+_OBSERVABILITY_CALLS = {
+    "event", "inc", "observe", "fault_counter", "note_deadline_exceeded",
+    "mark_error", "record_span", "set", "warning", "error", "exception",
+    "log", "dump",
+}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> Optional[str]:
+    """The broad type name this handler catches, or None."""
+    t = handler.type
+    if t is None:
+        return "bare"
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e for e in t.elts]
+    else:
+        names = [t]
+    for n in names:
+        leaf = None
+        if isinstance(n, ast.Name):
+            leaf = n.id
+        elif isinstance(n, ast.Attribute):
+            leaf = n.attr
+        if leaf in _BROAD:
+            return leaf
+    return None
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body observably handles the failure."""
+    exc_name = handler.name  # `as e` binding, may be None
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            leaf = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if leaf in _OBSERVABILITY_CALLS:
+                return True
+            # Handing the exception VALUE onward (errors.append(e),
+            # queue.put(e)) is handling — someone re-raises or renders
+            # it.  print is the one exception: stderr is exactly the
+            # place the flight recorder cannot see.
+            if leaf != "print" and exc_name is not None and any(
+                    isinstance(sub, ast.Name) and sub.id == exc_name
+                    for a in list(node.args)
+                    + [k.value for k in node.keywords]
+                    for sub in ast.walk(a)):
+                return True
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in targets):
+                return True  # state capture another path surfaces
+            if exc_name is not None and any(
+                    isinstance(sub, ast.Name) and sub.id == exc_name
+                    for sub in ast.walk(node.value)):
+                return True  # the exception value is kept
+        if isinstance(node, ast.Return) and node.value is not None:
+            # Returning a value DERIVED from the exception is handling;
+            # returning a bare constant ("probe failed -> False") is a
+            # verdict only when the site says so via suppression.
+            if exc_name is not None and any(
+                    isinstance(sub, ast.Name) and sub.id == exc_name
+                    for sub in ast.walk(node.value)):
+                return True
+    return False
+
+
+class ExceptionHygieneChecker(Checker):
+    name = "exception-hygiene"
+    default_scope = ("deppy_tpu",)
+
+    def check(self, files: List[SourceFile], root: Path) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in files:
+            self._walk(out, sf, sf.tree, "<module>")
+        return out
+
+    def _walk(self, out: List[Finding], sf: SourceFile, node: ast.AST,
+              func: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            name = func
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = child.name
+            if isinstance(child, ast.ExceptHandler):
+                broad = _is_broad(child)
+                if broad is not None and not _handles(child):
+                    self.finding(
+                        out, sf, child.lineno, "blind-swallow",
+                        f"{func}:{broad}",
+                        f"broad `except {broad}` in `{func}` swallows "
+                        f"the failure with no fault counter, telemetry "
+                        f"event, or re-raise — charge a counter / stamp "
+                        f"an event, narrow the catch, or suppress with "
+                        f"a reason")
+            self._walk(out, sf, child, name)

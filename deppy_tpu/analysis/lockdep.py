@@ -1,0 +1,235 @@
+"""Runtime lock-order assertions (``DEPPY_TPU_LOCKDEP=1``).
+
+The static concurrency checker sees lexical ``with`` nesting; this is
+its runtime twin for the orders that only materialize through call
+chains (pool lock → registry lock via a metrics call, breaker lock →
+sink lock via an event).  The threaded subsystems create their locks
+through the named factories below; with the mode armed, every
+acquisition is checked against the process's observed acquisition-order
+graph:
+
+  * acquiring B while holding A records the edge A→B (by lock *name* —
+    instances of the same subsystem lock share a name and an order);
+  * a subsequent acquisition implying B→A (directly or through a
+    path) raises :class:`LockdepError` **before** the threads can
+    deadlock, and emits a ``lockdep`` event onto the telemetry sink —
+    stamped onto the active request trace when one is live, so the
+    violation is visible in the flight recorder and ``deppy trace``,
+    not just a stderr traceback;
+  * re-acquiring a non-reentrant lock on the same thread (self-
+    deadlock) raises the same way.
+
+Disarmed (the default), the factories return plain ``threading``
+primitives — the hot paths (one registry-lock acquire per counter
+increment) pay nothing.  Armed, acquisition costs one thread-local
+list walk plus a dict probe per held lock; the chaos/sched/hostpool
+suites run under it in CI (``make test-lockdep``).
+
+Same-name nesting is exempt from ordering (two Registry instances
+mirror families into each other under one shared name); self-deadlock
+detection is by lock *identity*, so that exemption never masks a real
+recursive acquire.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class LockdepError(AssertionError):
+    """A lock-order inversion or self-deadlock caught before it hangs."""
+
+
+def lockdep_enabled() -> bool:
+    """Read ``DEPPY_TPU_LOCKDEP`` live (not cached): tests arm the mode
+    and then construct fresh subsystems; module-level locks created at
+    import time stay plain either way."""
+    from .. import config
+
+    return config.env_bool("DEPPY_TPU_LOCKDEP", False)
+
+
+# Acquisition-order graph: (held_name, acquired_name) -> witness site.
+_EDGES: Dict[Tuple[str, str], str] = {}
+_EDGES_LOCK = threading.Lock()  # plain on purpose: lockdep's own lock
+_TLS = threading.local()
+
+
+def _held_stack() -> List["_LockdepLock"]:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+def _reset_graph() -> None:
+    """Drop the observed order graph (tests)."""
+    with _EDGES_LOCK:
+        _EDGES.clear()
+
+
+def _path_exists(src: str, dst: str) -> Optional[List[str]]:
+    """A recorded acquisition-order path src -> ... -> dst, if any
+    (caller holds _EDGES_LOCK)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for (a, b) in _EDGES:
+            if a == node and b not in seen:
+                seen.add(b)
+                stack.append((b, path + [b]))
+    return None
+
+
+def _violation(kind: str, **fields) -> None:
+    """Emit the ``lockdep`` telemetry event, then raise.  The event
+    goes first: the raise may be swallowed by a broad recovery catch,
+    and the whole point is a record that survives to the sink / flight
+    recorder.  ``_TLS.reporting`` suspends instrumentation while the
+    event is written — the sink's own (instrumented) lock must not
+    recurse into the checker."""
+    _TLS.reporting = True
+    try:
+        from .. import telemetry
+
+        telemetry.default_registry().event("lockdep", violation=kind,
+                                           **fields)
+    except Exception:  # deppy: lint-ok[exception-hygiene] the assertion below must fire even if telemetry is mid-teardown
+        pass
+    finally:
+        _TLS.reporting = False
+    detail = " ".join(f"{k}={v}" for k, v in fields.items())
+    raise LockdepError(f"lockdep: {kind} ({detail})")
+
+
+class _LockdepLock:
+    """Order-checking proxy around one threading lock."""
+
+    def __init__(self, inner, name: str, reentrant: bool):
+        self._inner = inner
+        self.name = name
+        self._reentrant = reentrant
+
+    # ------------------------------------------------------------ checks
+
+    def _before_acquire(self) -> None:
+        if getattr(_TLS, "reporting", False):
+            # Violation reporting itself acquires instrumented locks
+            # (the telemetry sink's): don't recurse into the checker.
+            return
+        stack = _held_stack()
+        if not self._reentrant and any(h is self for h in stack):
+            _violation("self-deadlock", lock=self.name)
+        if any(h is self for h in stack):
+            return  # reentrant re-acquire: no new ordering information
+        held_names = []
+        for h in stack:
+            if h.name != self.name and h.name not in held_names:
+                held_names.append(h.name)
+        if not held_names:
+            return
+        # Decide under the graph lock, report AFTER releasing it: the
+        # report path (telemetry event) acquires instrumented locks,
+        # which would re-enter this checker and self-deadlock on the
+        # plain _EDGES_LOCK.
+        inversion = None
+        with _EDGES_LOCK:
+            for held in held_names:
+                back = _path_exists(self.name, held)
+                if back is not None:
+                    inversion = (held, back)
+                    break
+                _EDGES.setdefault((held, self.name),
+                                  f"{held} -> {self.name}")
+        if inversion is not None:
+            held, back = inversion
+            _violation("order-inversion", lock=self.name, held=held,
+                       observed_order=" -> ".join(back))
+
+    # ------------------------------------------------------------ lock API
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._before_acquire()
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _held_stack().append(self)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    # Condition-variable integration (threading.Condition probes these
+    # on its lock; delegate and keep the held stack truthful across
+    # wait()'s release/re-acquire).
+
+    def _is_owned(self):
+        probe = getattr(self._inner, "_is_owned", None)
+        if probe is not None:
+            return probe()
+        return any(h is self for h in _held_stack())
+
+    def _release_save(self):
+        save = getattr(self._inner, "_release_save", None)
+        state = save() if save is not None else self._inner.release()
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        restore = getattr(self._inner, "_acquire_restore", None)
+        # Re-acquiring after a wait() re-enters at the BOTTOM of the
+        # order (we held it before everything acquired since); skip the
+        # order check — the wait itself proved no deadlock.
+        if restore is not None:
+            restore(state)
+        else:
+            self._inner.acquire()
+        _held_stack().append(self)
+
+
+# ------------------------------------------------------------- factories
+
+
+def make_lock(name: str):
+    """A named mutex: plain ``threading.Lock`` unless lockdep is armed."""
+    if lockdep_enabled():
+        return _LockdepLock(threading.Lock(), name, reentrant=False)
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    """A named reentrant lock (the registry's render-under-lock
+    pattern)."""
+    if lockdep_enabled():
+        return _LockdepLock(threading.RLock(), name, reentrant=True)
+    return threading.RLock()
+
+
+def make_condition(name: str):
+    """A named condition variable (the scheduler's queue CV)."""
+    if lockdep_enabled():
+        return threading.Condition(
+            _LockdepLock(threading.RLock(), name, reentrant=True))
+    return threading.Condition()

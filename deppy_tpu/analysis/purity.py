@@ -1,0 +1,233 @@
+"""trace-purity: host effects and sync hazards inside traced code.
+
+Any function reachable from a ``jit`` / ``pjit`` / ``shard_map`` /
+``pallas_call`` wrapping (or used as a ``lax`` control-flow body) runs
+under a JAX trace.  Three hazard classes hide there:
+
+  * **host side effects** — ``print``/``open``/``os.environ`` inside a
+    traced function fire once per *compile*, not per call: silent at
+    steady state, misleading during debugging, and a recompile tell;
+  * **wall clock / randomness** — ``time.*`` and Python-level
+    ``random`` are baked in at trace time; the value the author thinks
+    is per-call is a compile-time constant (the trip-overhead model in
+    ROADMAP item 3 measures dispatch wall clock *around* traced code
+    for exactly this reason);
+  * **device syncs / tracer branching** — ``.item()`` /
+    ``np.asarray`` / ``.tolist()`` / ``block_until_ready`` force a
+    host round-trip per call, and a Python ``if``/``while`` on a
+    ``jnp``/``lax`` expression either recompiles per value or raises
+    a ``TracerBoolConversionError`` in production shapes that never
+    ran in tests.
+
+The call graph is module-local and name-based (the engine's traced
+kernels are module functions calling module functions), which keeps
+the checker dependency-free and the false-positive surface small; the
+baseline absorbs deliberate exceptions (each carries a suppression
+with its reason where the hazard is intended, e.g. interpret-mode
+debugging helpers).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Set
+
+from .core import Checker, Finding, SourceFile
+
+# Call spellings that make their function argument(s) traced code.
+_TRACING_WRAPPERS = {
+    "jit", "pjit", "shard_map", "pallas_call", "vmap", "grad",
+    "value_and_grad", "checkify", "custom_vjp", "custom_jvp", "scan",
+    "while_loop", "cond", "fori_loop", "switch", "remat", "checkpoint",
+}
+# Attribute roots that mark an expression as a device-tensor expression.
+_TENSOR_ROOTS = {"jnp", "lax", "pltpu", "pl"}
+
+# (qualified-call -> code slug).  Matched against the dotted name of a
+# Call's func (``time.perf_counter``, ``np.asarray``, ...).
+_HOST_CALLS = {
+    "print": "host-effect",
+    "open": "host-effect",
+    "input": "host-effect",
+    "os.environ.get": "host-effect",
+    "os.getenv": "host-effect",
+    "os.system": "host-effect",
+    "time.time": "wall-clock",
+    "time.monotonic": "wall-clock",
+    "time.perf_counter": "wall-clock",
+    "time.sleep": "wall-clock",
+    "datetime.datetime.now": "wall-clock",
+    "random.random": "randomness",
+    "random.randint": "randomness",
+    "random.choice": "randomness",
+    "np.random.default_rng": "randomness",
+    "np.asarray": "device-sync",
+    "np.array": "device-sync",
+    "numpy.asarray": "device-sync",
+    "numpy.array": "device-sync",
+    "jax.device_get": "device-sync",
+}
+# Method names that force a device→host sync on whatever they hang off.
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _func_names_in(node: ast.AST, known: Set[str]) -> Set[str]:
+    """Names of module functions referenced anywhere inside ``node``
+    (the argument expression of a tracing wrapper)."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in known:
+            out.add(sub.id)
+    return out
+
+
+class _ModuleIndex:
+    """Per-module function table, call graph, and traced entry set."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.funcs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Last definition wins (overloads by platform guard);
+                # name-keyed on purpose — the engine's kernels are
+                # module-level functions.
+                self.funcs[node.name] = node
+        self.calls: Dict[str, Set[str]] = {}
+        for name, fn in self.funcs.items():
+            callees: Set[str] = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    target = _dotted(sub.func)
+                    if target in self.funcs:
+                        callees.add(target)
+                    # A local function handed onward as a value (e.g.
+                    # functools.partial(body_fn, ...)) stays traced.
+                    for arg in list(sub.args) + [k.value
+                                                 for k in sub.keywords]:
+                        callees |= _func_names_in(
+                            arg, set(self.funcs))
+            self.calls[name] = callees
+        self.entries = self._traced_entries()
+
+    def _traced_entries(self) -> Set[str]:
+        known = set(self.funcs)
+        entries: Set[str] = set()
+        for node in ast.walk(self.sf.tree):
+            if isinstance(node, ast.Call):
+                target = _dotted(node.func) or ""
+                leaf = target.rsplit(".", 1)[-1]
+                if leaf in _TRACING_WRAPPERS:
+                    for arg in list(node.args) + [k.value
+                                                  for k in node.keywords]:
+                        entries |= _func_names_in(arg, known)
+        for name, fn in self.funcs.items():
+            for dec in fn.decorator_list:
+                target = _dotted(dec if not isinstance(dec, ast.Call)
+                                 else dec.func) or ""
+                leaf = target.rsplit(".", 1)[-1]
+                if leaf in _TRACING_WRAPPERS or (
+                        isinstance(dec, ast.Call)
+                        and leaf == "partial"
+                        and any((_dotted(a) or "").rsplit(".", 1)[-1]
+                                in _TRACING_WRAPPERS
+                                for a in dec.args)):
+                    entries.add(name)
+        return entries
+
+    def reachable(self) -> Set[str]:
+        seen: Set[str] = set()
+        stack = list(self.entries)
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            stack.extend(self.calls.get(name, ()))
+        return seen
+
+
+# Static array metadata: branching on these is trace-time Python, not
+# a tracer branch (shapes/dtypes are concrete during tracing).
+_STATIC_ATTRS = {"dtype", "shape", "ndim", "size"}
+_DTYPE_NAMES = {"bool_", "int8", "int16", "int32", "int64", "uint8",
+                "uint16", "uint32", "uint64", "float16", "float32",
+                "float64", "bfloat16"}
+
+
+def _is_tensor_expr(node: ast.AST) -> bool:
+    """Heuristic: the expression is (or contains) a device-tensor
+    computation — a call or attribute rooted at jnp/lax/pltpu — and is
+    not a static shape/dtype check."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+            return False
+    for sub in ast.walk(node):
+        if not isinstance(sub, (ast.Attribute, ast.Name)):
+            continue
+        root = _dotted(sub)
+        if not root or root.split(".", 1)[0] not in _TENSOR_ROOTS:
+            continue
+        if root.rsplit(".", 1)[-1] in _DTYPE_NAMES:
+            continue  # jnp.int32 as a dtype constant, not a tensor
+        return True
+    return False
+
+
+class TracePurityChecker(Checker):
+    name = "trace-purity"
+    default_scope = ("deppy_tpu",)
+
+    def check(self, files: List[SourceFile], root: Path) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in files:
+            index = _ModuleIndex(sf)
+            traced = index.reachable()
+            for fname in sorted(traced):
+                self._check_function(out, sf, fname, index.funcs[fname])
+        return out
+
+    def _check_function(self, out: List[Finding], sf: SourceFile,
+                        fname: str, fn: ast.FunctionDef) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                target = _dotted(node.func)
+                if target in _HOST_CALLS:
+                    self.finding(
+                        out, sf, node.lineno, _HOST_CALLS[target],
+                        f"{fname}:{target}",
+                        f"`{target}(...)` inside traced function "
+                        f"`{fname}` — runs at trace time (once per "
+                        f"compile), not per call")
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SYNC_METHODS):
+                    self.finding(
+                        out, sf, node.lineno, "device-sync",
+                        f"{fname}:.{node.func.attr}",
+                        f"`.{node.func.attr}()` inside traced function "
+                        f"`{fname}` forces a device→host sync per call")
+            elif isinstance(node, (ast.If, ast.While)):
+                if _is_tensor_expr(node.test):
+                    kind = ("if" if isinstance(node, ast.If)
+                            else "while")
+                    self.finding(
+                        out, sf, node.lineno, "tracer-branch",
+                        f"{fname}:{kind}",
+                        f"Python `{kind}` on a jnp/lax expression "
+                        f"inside traced function `{fname}` — branches "
+                        f"on a tracer (recompile per value or "
+                        f"TracerBoolConversionError); use lax.cond/"
+                        f"lax.while_loop or jnp.where")

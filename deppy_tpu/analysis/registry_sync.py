@@ -1,0 +1,251 @@
+"""registry-sync: string-keyed surfaces vs their typed registries.
+
+The repo has four string-keyed surfaces whose drift was previously
+caught only at runtime (or never): the test_doc_sync pattern — scan the
+literals, pin them against the declaration — promoted from test into
+lint:
+
+  * **env knobs** — every ``DEPPY_TPU_*`` token anywhere in the tree
+    (call sites, docstrings, helper strings) must be declared in
+    :data:`deppy_tpu.config.REGISTRY` (``undeclared-env``), and every
+    declared knob must still be mentioned by some code
+    (``unused-env``);
+  * **fault points** — every ``faults.inject("point")`` literal must
+    be registered in :data:`deppy_tpu.faults.inject.KNOWN_POINTS`
+    (``unknown-fault-point``), and every registered point must still
+    have an inject site (``stale-fault-point``) — a fault plan written
+    against a renamed point silently injects nothing;
+  * **telemetry families** — a family name passed to
+    ``faults.fault_counter`` / ``hostpool.metrics.gauge|counter|
+    histogram`` must exist in its declaration dict
+    (``unknown-family``) — today that's a runtime KeyError on the
+    *recovery* path, the worst place to discover it;
+  * **pytest markers** — every custom ``pytest.mark.X`` used under
+    ``tests/`` must be registered in pyproject.toml's ``markers``
+    (``unknown-marker``) — an unregistered marker silently drops out
+    of ``-m`` tier selection.
+
+The declaration side imports only the registry modules (config,
+faults.metrics, hostpool.metrics) — none of them pull JAX.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Set
+
+from .core import Checker, Finding, SourceFile
+
+_ENV_TOKEN = re.compile(r"DEPPY_TPU_[A-Z0-9_]+")
+# Builtin / plugin markers that need no registration.
+_BUILTIN_MARKS = {"skip", "skipif", "xfail", "parametrize",
+                  "usefixtures", "filterwarnings", "timeout"}
+
+
+def _dotted(node: ast.AST):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class RegistrySyncChecker(Checker):
+    name = "registry-sync"
+    default_scope = ("deppy_tpu", "scripts", "tests", "bench.py",
+                     "__graft_entry__.py")
+
+    def check(self, files: List[SourceFile], root: Path) -> List[Finding]:
+        out: List[Finding] = []
+        self._check_env(out, files)
+        self._check_fault_points(out, files)
+        self._check_families(out, files)
+        self._check_markers(out, files, root)
+        return out
+
+    # ------------------------------------------------------------ env vars
+
+    def _check_env(self, out: List[Finding],
+                   files: List[SourceFile]) -> None:
+        from .. import config
+
+        mentioned: Set[str] = set()
+        for sf in files:
+            if sf.rel == "deppy_tpu/config.py":
+                continue  # the registry itself
+            for i, line in enumerate(sf.lines, start=1):
+                for m in _ENV_TOKEN.finditer(line):
+                    token = m.group(0)
+                    if token.endswith("_"):
+                        continue  # prose wildcard ("DEPPY_TPU_BREAKER_*")
+                    mentioned.add(token)
+                    if not config.declared(token):
+                        self.finding(
+                            out, sf, i, "undeclared-env", token,
+                            f"`{token}` is not declared in "
+                            f"deppy_tpu.config.REGISTRY — declare it "
+                            f"(type, default, consumer, help) or fix "
+                            f"the name")
+        for name in sorted(set(config.REGISTRY) - mentioned):
+            # Anchor registry-side findings on the registry file.
+            reg_sf = next((f for f in files
+                           if f.rel == "deppy_tpu/config.py"), None)
+            if reg_sf is not None:
+                line = next((i for i, text in enumerate(reg_sf.lines, 1)
+                             if name in text), 1)
+                self.finding(
+                    out, reg_sf, line, "unused-env", name,
+                    f"`{name}` is declared in config.REGISTRY but no "
+                    f"code mentions it — dead knob or renamed reader")
+
+    # -------------------------------------------------------- fault points
+
+    def _check_fault_points(self, out: List[Finding],
+                            files: List[SourceFile]) -> None:
+        # NB: `from ..faults import inject` would resolve to the
+        # inject() FUNCTION (faults/__init__ re-exports it, shadowing
+        # the submodule) — import the submodule path explicitly.
+        from ..faults.inject import KNOWN_POINTS
+
+        known = set(KNOWN_POINTS)
+        injected: Set[str] = set()
+        for sf in files:
+            if not sf.rel.startswith("deppy_tpu/"):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = _dotted(node.func) or ""
+                if target.rsplit(".", 1)[-1] != "inject":
+                    continue
+                if (node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    point = node.args[0].value
+                    injected.add(point)
+                    if point not in known:
+                        self.finding(
+                            out, sf, node.lineno, "unknown-fault-point",
+                            point,
+                            f"fault point `{point}` is not registered "
+                            f"in faults.inject.KNOWN_POINTS — plans "
+                            f"written against it cannot be validated")
+        inj_sf = next((f for f in files
+                       if f.rel == "deppy_tpu/faults/inject.py"), None)
+        for point in sorted(known - injected):
+            # Dynamic points reach inject() through variables
+            # (`_recovering(point="driver.dispatch")`, per-device
+            # suffix globs): the point is live if its prefix appears
+            # anywhere in package source outside the registry itself.
+            prefix = point.rstrip("*").rstrip(".")
+            if any(prefix in sf.text for sf in files
+                   if sf.rel.startswith("deppy_tpu/")
+                   and sf.rel != "deppy_tpu/faults/inject.py"):
+                continue
+            if inj_sf is not None:
+                line = next((i for i, text in enumerate(inj_sf.lines, 1)
+                             if f'"{point}"' in text), 1)
+                self.finding(
+                    out, inj_sf, line, "stale-fault-point", point,
+                    f"registered fault point `{point}` has no "
+                    f"inject() site — plans naming it silently "
+                    f"inject nothing")
+
+    # ---------------------------------------------------- telemetry families
+
+    def _check_families(self, out: List[Finding],
+                        files: List[SourceFile]) -> None:
+        from ..faults import metrics as fmetrics
+        from ..hostpool import metrics as hmetrics
+
+        tables: Dict[str, Set[str]] = {
+            "fault_counter": set(fmetrics.FAMILIES),
+            "gauge": set(hmetrics.GAUGES),
+            "counter": set(hmetrics.COUNTERS),
+            "histogram": set(hmetrics.HISTOGRAMS),
+        }
+        for sf in files:
+            if not sf.rel.startswith("deppy_tpu/"):
+                continue
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call) and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                target = _dotted(node.func) or ""
+                leaf = target.rsplit(".", 1)[-1]
+                name = node.args[0].value
+                if leaf == "fault_counter":
+                    table = tables["fault_counter"]
+                elif (leaf in ("gauge", "counter", "histogram")
+                        and ("metrics." in target
+                             or sf.rel == "deppy_tpu/hostpool/metrics.py")
+                        and name.startswith("deppy_hostpool_")):
+                    table = tables[leaf]
+                else:
+                    continue
+                if name not in table:
+                    self.finding(
+                        out, sf, node.lineno, "unknown-family", name,
+                        f"telemetry family `{name}` is not declared in "
+                        f"its metrics table — this is a runtime "
+                        f"KeyError on the recovery path")
+
+    # ------------------------------------------------------------- markers
+
+    def _check_markers(self, out: List[Finding], files: List[SourceFile],
+                       root: Path) -> None:
+        try:
+            import tomllib
+        except ImportError:  # py<3.11: fall back to a literal scan
+            tomllib = None
+        registered: Set[str] = set()
+        pyproject = root / "pyproject.toml"
+        if tomllib is not None and pyproject.exists():
+            doc = tomllib.loads(pyproject.read_text(encoding="utf-8"))
+            for entry in (doc.get("tool", {}).get("pytest", {})
+                          .get("ini_options", {}).get("markers", [])):
+                registered.add(str(entry).split(":", 1)[0].strip())
+        elif pyproject.exists():
+            in_markers = False
+            for line in pyproject.read_text(encoding="utf-8").splitlines():
+                if line.strip().startswith("markers"):
+                    in_markers = True
+                    continue
+                if in_markers:
+                    if line.strip().startswith("]"):
+                        break
+                    m = re.match(r'\s*"([a-zA-Z0-9_]+)\s*:', line)
+                    if m:
+                        registered.add(m.group(1))
+        for sf in files:
+            if not sf.rel.startswith("tests/"):
+                continue
+            for node in ast.walk(sf.tree):
+                mark = self._mark_name(node)
+                if (mark and mark not in _BUILTIN_MARKS
+                        and mark not in registered):
+                    self.finding(
+                        out, sf, node.lineno, "unknown-marker", mark,
+                        f"pytest marker `{mark}` is not registered in "
+                        f"pyproject.toml [tool.pytest.ini_options] "
+                        f"markers — it silently drops out of -m tier "
+                        f"selection")
+
+    @staticmethod
+    def _mark_name(node: ast.AST):
+        """``pytest.mark.X`` (bare or called) -> ``X``."""
+        if isinstance(node, ast.Call):
+            node = node.func
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "mark"
+                and isinstance(node.value.value, ast.Name)
+                and node.value.value.id == "pytest"):
+            return node.attr
+        return None

@@ -247,6 +247,46 @@ def _build_parser() -> argparse.ArgumentParser:
         help="output format (default: text)",
     )
 
+    # Lint flags are declared inline (not imported from analysis.cli):
+    # the parser is built for EVERY command, and a broken checker module
+    # must only take down `deppy lint`, never `deppy serve`.
+    p_lint = sub.add_parser(
+        "lint",
+        help="run the static-analysis checkers (trace-purity, "
+        "concurrency-discipline, registry-sync, exception-hygiene) "
+        "and fail on findings not in analysis/baseline.json (see "
+        "docs/analysis.md)",
+    )
+    p_lint.add_argument(
+        "--checker", action="append", default=None, metavar="NAME",
+        help="run only the named checker (repeatable; default: all of "
+        "trace-purity, concurrency-discipline, registry-sync, "
+        "exception-hygiene)")
+    p_lint.add_argument(
+        "--json", action="store_true",
+        help="emit the findings (and the new-vs-baseline split) as one "
+        "JSON document on stdout")
+    p_lint.add_argument(
+        "--github", action="store_true",
+        help="emit ::warning workflow annotations for NEW findings "
+        "(sanity CI)")
+    p_lint.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="findings baseline (default: deppy_tpu/analysis/"
+        "baseline.json)")
+    p_lint.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report and fail on EVERY finding")
+    p_lint.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline to the current findings and exit 0 "
+        "(burn-down bookkeeping; review the diff; with --checker, only "
+        "that checker's keys are replaced)")
+    p_lint.add_argument(
+        "--strict-baseline", action="store_true",
+        help="also fail when the baseline carries stale keys for "
+        "findings that no longer exist (keeps burn-down honest)")
+
     p_doctor = sub.add_parser(
         "doctor",
         help="diagnose the accelerator backend (probe in a killable "
@@ -308,16 +348,22 @@ def _load_serve_config(path: str) -> dict:
 
 
 def _arm_fault_plan(spec) -> int:
-    """Install a --fault-plan spec; returns 0 or a usage-error code."""
+    """Install a --fault-plan spec; returns 0 or a usage-error code.
+    Rules naming no registered fault point warn (registry-sync): an
+    operator chaos plan against a renamed point must not report green
+    while injecting nothing."""
     if not spec:
         return 0
     from . import faults
+    from .faults.inject import _warn_unmatched
 
     try:
-        faults.configure_plan(faults.plan_from_spec(spec))
+        plan = faults.plan_from_spec(spec)
     except (OSError, ValueError) as e:
         print(f"error: invalid fault plan: {e}", file=sys.stderr)
         return 2
+    _warn_unmatched(plan)
+    faults.configure_plan(plan)
     return 0
 
 
@@ -431,9 +477,9 @@ def _cmd_stats(args) -> int:
     recorded solve report — the same report `deppy resolve --report`
     and the bench harness print.  ``--span NAME`` narrows the summary
     to one span family."""
-    import os
+    from . import config
 
-    path = args.file or os.environ.get("DEPPY_TPU_TELEMETRY_FILE")
+    path = args.file or config.env_raw("DEPPY_TPU_TELEMETRY_FILE")
     if not path:
         print("error: no telemetry file (pass FILE or set "
               "DEPPY_TPU_TELEMETRY_FILE)", file=sys.stderr)
@@ -442,12 +488,15 @@ def _cmd_stats(args) -> int:
     last_report = None
     n_events = 0
     n_bad = 0
+    kinds: dict = {}
     try:
         for ev in _iter_sink_events(path):
             if ev is None:
                 n_bad += 1
                 continue
             n_events += 1
+            kind = ev.get("kind", "?")
+            kinds[kind] = kinds.get(kind, 0) + 1
             if ev.get("kind") == "span":
                 name = ev.get("name", "?")
                 if args.span is not None and name != args.span:
@@ -484,6 +533,7 @@ def _cmd_stats(args) -> int:
 
     if args.output == "json":
         json.dump({"events": n_events, "malformed_lines": n_bad,
+                   "event_kinds": kinds,
                    "spans": spans,
                    # --span narrows to one span family in BOTH formats.
                    "last_report": (last_report if args.span is None
@@ -494,6 +544,13 @@ def _cmd_stats(args) -> int:
 
     print(f"telemetry: {n_events} events from {path}"
           + (f" ({n_bad} malformed lines skipped)" if n_bad else ""))
+    # Non-span kinds get a one-line tally so fault/breaker/lockdep
+    # events are visible from `deppy stats` without a trace id in hand.
+    other = {k: n for k, n in sorted(kinds.items())
+             if k not in ("span", "report")}
+    if other and args.span is None:
+        print("events: " + "  ".join(f"{k}={n}"
+                                     for k, n in other.items()))
     if spans:
         width = max(len(n) for n in spans)
         print(f"{'span'.ljust(width)}  {'count':>7}  {'total_s':>9}  "
@@ -527,9 +584,9 @@ def _cmd_trace(args) -> int:
     traces grafted via their span links, so a request served by a
     coalesced dispatch shows queue-wait → dispatch (with retry/fallback
     events) → decode as one tree."""
-    import os
+    from . import config
 
-    path = args.file or os.environ.get("DEPPY_TPU_TELEMETRY_FILE")
+    path = args.file or config.env_raw("DEPPY_TPU_TELEMETRY_FILE")
     if not path:
         print("error: no telemetry file (pass --file or set "
               "DEPPY_TPU_TELEMETRY_FILE)", file=sys.stderr)
@@ -586,7 +643,7 @@ def _cmd_trace(args) -> int:
                     _take_span(sp)
                 for fe in trace.get("events", []):
                     _take_event(fe)
-            elif kind in ("fault", "breaker"):
+            elif kind in ("fault", "breaker", "lockdep"):
                 _take_event(ev)
     except FileNotFoundError:
         print(f"error: no such file: {path}", file=sys.stderr)
@@ -777,6 +834,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_stats(args)
     if args.command == "trace":
         return _cmd_trace(args)
+    if args.command == "lint":
+        from .analysis.cli import run_lint
+
+        return run_lint(args)
     if args.command == "doctor":
         from .utils.tpu_doctor import run_from_args
 

@@ -1,0 +1,323 @@
+"""Typed registry of every ``DEPPY_TPU_*`` environment knob (ISSUE 7).
+
+The env surface grew one knob at a time across six subsystems — 100+
+read sites over 20+ files — with the docs chasing the code by hand.
+This module is the single declaration point: every knob's name, type,
+default, consuming module, and help text live HERE, and three things
+hang off the declaration:
+
+  * **Typed reads.**  :func:`env_raw` (and the typed wrappers
+    :func:`env_str` / :func:`env_int` / :func:`env_float` /
+    :func:`env_bool`) resolve the environment *through* the registry —
+    reading an undeclared ``DEPPY_TPU_*`` name raises
+    :class:`UndeclaredEnvVar` at the call site instead of silently
+    minting a knob nobody documented.  The fault layer's defensive
+    parsers (``faults.env_float``, the subsystems' ``_env_int``) call
+    :func:`require` first, so every legacy read site resolves through
+    the registry without changing its parse-or-degrade semantics.
+  * **Generated docs.**  :func:`render_markdown` emits the
+    docs/configuration.md table (``python -m deppy_tpu.config``);
+    tests/test_doc_sync.py pins the checked-in file against it both
+    ways, the same way the observability metric tables are pinned.
+  * **Lint.**  The ``registry-sync`` checker (``deppy lint``,
+    :mod:`deppy_tpu.analysis.registry_sync`) scans the whole tree for
+    ``DEPPY_TPU_*`` tokens and fails on any name missing from this
+    registry — and on any declared name no code mentions.
+
+Import-light on purpose (stdlib ``os``/``dataclasses`` only): every
+subsystem — including :mod:`deppy_tpu.faults.policy` at the bottom of
+the import order — can import it without cycles.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+_PREFIX = "DEPPY_TPU_"
+
+
+class UndeclaredEnvVar(KeyError):
+    """A ``DEPPY_TPU_*`` read of a name missing from :data:`REGISTRY`."""
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One declared environment knob."""
+
+    name: str
+    type: str       # "int" | "float" | "str" | "bool" | "path"
+    default: object  # documented default; None = unset/off
+    consumer: str   # primary reading module (dotted path)
+    help: str
+
+
+def _v(name: str, type: str, default, consumer: str, help: str) -> EnvVar:
+    return EnvVar(name=name, type=type, default=default,
+                  consumer=consumer, help=help)
+
+
+# Declaration order groups by subsystem; rendering sorts by name so the
+# doc table is stable under insertion.
+_DECLARATIONS: List[EnvVar] = [
+    # --- telemetry -------------------------------------------------------
+    _v("DEPPY_TPU_TELEMETRY_FILE", "path", None, "deppy_tpu.telemetry.registry",
+       "JSONL event sink for spans/reports/fault events (also "
+       "--telemetry-file); summarize with `deppy stats`."),
+    _v("DEPPY_TPU_TRACE_RING", "int", 64, "deppy_tpu.telemetry.trace",
+       "Flight-recorder capacity: recent completed request traces."),
+    _v("DEPPY_TPU_TRACE_ERROR_RING", "int", 256, "deppy_tpu.telemetry.trace",
+       "Flight-recorder error ring: errored traces retained separately "
+       "so healthy bursts cannot evict incident context."),
+    # --- faults ----------------------------------------------------------
+    _v("DEPPY_TPU_FAULT_PLAN", "str", None, "deppy_tpu.faults.inject",
+       "Fault-injection plan: inline JSON, @FILE, or a file path (also "
+       "--fault-plan); see docs/robustness.md."),
+    _v("DEPPY_TPU_FAULT_RETRIES", "int", 2, "deppy_tpu.faults.policy",
+       "Total attempts per device dispatch group (2 = one retry)."),
+    _v("DEPPY_TPU_FAULT_BACKOFF_S", "float", 0.05, "deppy_tpu.faults.policy",
+       "Base exponential-backoff sleep between dispatch retries."),
+    _v("DEPPY_TPU_FAULT_BACKOFF_MAX_S", "float", 2.0,
+       "deppy_tpu.faults.policy",
+       "Backoff clamp: no retry sleeps longer than this."),
+    _v("DEPPY_TPU_CHUNK_DEADLINE_S", "float", 0.0, "deppy_tpu.faults.policy",
+       "Wall-clock bound on ONE dispatch attempt; exceeding it counts "
+       "deppy_deadline_exceeded and charges the breaker (0 = off)."),
+    _v("DEPPY_TPU_BATCH_DEADLINE_S", "float", None, "deppy_tpu.faults.policy",
+       "Ambient wall-clock budget for a whole resolve batch (also "
+       "--deadline / X-Deppy-Deadline-S); expiry degrades undispatched "
+       "lanes to Incomplete."),
+    _v("DEPPY_TPU_BREAKER_THRESHOLD", "int", 3, "deppy_tpu.faults.breaker",
+       "Consecutive device failures that trip the accelerator circuit "
+       "breaker open (host-only serving)."),
+    _v("DEPPY_TPU_BREAKER_RESET_S", "float", 30.0, "deppy_tpu.faults.breaker",
+       "Breaker cooldown before one half-open probe dispatch."),
+    # --- scheduler / cache ----------------------------------------------
+    _v("DEPPY_TPU_SCHED", "str", "on", "deppy_tpu.service",
+       "Cross-request continuous-batching scheduler ('off' restores "
+       "byte-identical per-request dispatch; also --sched)."),
+    _v("DEPPY_TPU_SCHED_MAX_WAIT_MS", "float", 5.0,
+       "deppy_tpu.sched.scheduler",
+       "Flush policy: max milliseconds the oldest queued problem waits "
+       "for batchmates (also --sched-max-wait-ms)."),
+    _v("DEPPY_TPU_SCHED_MAX_FILL", "int", 256, "deppy_tpu.sched.scheduler",
+       "Flush policy: dispatch once a size class has this many lanes "
+       "queued (also --sched-max-fill)."),
+    _v("DEPPY_TPU_SCHED_MAX_DEPTH", "int", 4096, "deppy_tpu.sched.scheduler",
+       "Queue depth past which admission returns 503 + Retry-After "
+       "(0 = unbounded)."),
+    _v("DEPPY_TPU_SCHED_LANES_PER_DEVICE", "int", 256,
+       "deppy_tpu.sched.scheduler",
+       "Mesh serving: a full flush targets n_devices x this many lanes "
+       "so every device gets a full shard."),
+    _v("DEPPY_TPU_CACHE_SIZE", "int", 1024, "deppy_tpu.sched.scheduler",
+       "Canonical-form result-cache capacity in entries (0 disables; "
+       "also --cache-size)."),
+    # --- service ---------------------------------------------------------
+    _v("DEPPY_TPU_REQUEST_DEADLINE_S", "float", None, "deppy_tpu.service",
+       "Default wall-clock budget per /v1/resolve request (clients "
+       "override via X-Deppy-Deadline-S; also --request-deadline)."),
+    _v("DEPPY_TPU_DRAIN_S", "float", None, "deppy_tpu.service",
+       "Graceful-shutdown bound on draining in-flight requests "
+       "(default: the request deadline, else 10s)."),
+    _v("DEPPY_TPU_REPROBE", "float", 600.0, "deppy_tpu.service",
+       "Seconds between background accelerator re-probes while serving "
+       "degraded (0 disables)."),
+    # --- hostpool --------------------------------------------------------
+    _v("DEPPY_TPU_HOST_WORKERS", "int", None, "deppy_tpu.hostpool.pool",
+       "Host-engine worker pool size (default min(cpu_count, 8); 0 = "
+       "inline serial engine; also --host-workers)."),
+    _v("DEPPY_TPU_HOST_WORKER_RECYCLE", "int", 256,
+       "deppy_tpu.hostpool.pool",
+       "Solves per worker before it is retired and replaced (leak "
+       "hygiene; 0 = never)."),
+    _v("DEPPY_TPU_HOSTPOOL_SPAWN_TIMEOUT_S", "float", 30.0,
+       "deppy_tpu.hostpool.pool",
+       "Bound on a spawned worker's ready handshake; a sandbox that "
+       "allows fork but hangs it must not hang the solve path."),
+    _v("DEPPY_TPU_HOSTPOOL_START_METHOD", "str", "forkserver",
+       "deppy_tpu.hostpool.pool",
+       "multiprocessing start method for pool workers."),
+    # --- mesh serving ----------------------------------------------------
+    _v("DEPPY_TPU_MESH_DEVICES", "int", None, "deppy_tpu.parallel.mesh",
+       "Shard each coalesced micro-batch across N devices ('all'/-1 = "
+       "every local device; unset/0/1 = single-device dispatch; also "
+       "--mesh-devices)."),
+    # --- engine ----------------------------------------------------------
+    _v("DEPPY_TPU_MAX_LANES", "int", 512, "deppy_tpu.engine.driver",
+       "Per-dispatch lane cap; oversized programs crash the tunneled "
+       "TPU worker, so batches chunk to this width."),
+    _v("DEPPY_TPU_PROBE_LANES", "int", 512, "deppy_tpu.engine.driver",
+       "Lane width of the backend-usability probe dispatch."),
+    _v("DEPPY_TPU_HOST_CORE_NCONS", "int", 768, "deppy_tpu.engine.driver",
+       "Constraint count above which UNSAT-core extraction routes to "
+       "the host engine."),
+    _v("DEPPY_TPU_SPEC_CORE", "str", "auto", "deppy_tpu.engine.driver",
+       "Speculative phase-3 core extraction: auto/on/off."),
+    _v("DEPPY_TPU_SPEC_CORE_CAP", "int", 32768, "deppy_tpu.engine.driver",
+       "Cost-proxy cap above which speculative core extraction is "
+       "skipped."),
+    _v("DEPPY_TPU_STAGE1_STEPS", "int", 0, "deppy_tpu.engine.driver",
+       "Stage-1 step budget of the escalation ladder (0 = measured "
+       "default)."),
+    _v("DEPPY_TPU_BCP", "str", "auto", "deppy_tpu.engine.core",
+       "BCP kernel implementation: auto/bits/dense/pallas/blockwise."),
+    _v("DEPPY_TPU_BCP_UNROLL", "int", 1, "deppy_tpu.engine.core",
+       "Propagation-loop unroll factor (trip-overhead amortization)."),
+    _v("DEPPY_TPU_DPLL_UNROLL", "int", 1, "deppy_tpu.engine.core",
+       "DPLL decision-loop unroll factor."),
+    _v("DEPPY_TPU_CTL_UNROLL", "int", 1, "deppy_tpu.engine.core",
+       "Control-loop unroll factor."),
+    _v("DEPPY_TPU_SEARCH", "str", "auto", "deppy_tpu.engine.core",
+       "Search-phase implementation: auto/xla/fused (fused = the "
+       "whole-search Pallas kernel)."),
+    _v("DEPPY_TPU_MEASURED_DEFAULTS", "path", None, "deppy_tpu.engine.core",
+       "Override path of the measured-defaults registry JSON (default: "
+       "the package-local engine/measured_defaults.json)."),
+    _v("DEPPY_TPU_BLOCK_ROWS", "int", 2048,
+       "deppy_tpu.engine.pallas_blockwise",
+       "Clause-row block height of the blockwise BCP kernel."),
+    # --- platform / tooling ---------------------------------------------
+    _v("DEPPY_TPU_COMPILE_CACHE", "path", None,
+       "deppy_tpu.utils.platform_env",
+       "Persistent XLA compile-cache directory ('off'/'0' disables; "
+       "default on only for non-CPU platforms)."),
+    _v("DEPPY_TPU_REVAL_LOG", "path", None, "scripts.tpu_revalidate",
+       "JSONL record log shared by the revalidation ladder and "
+       "bench.py's accelerator records."),
+    # --- analysis --------------------------------------------------------
+    _v("DEPPY_TPU_LOCKDEP", "bool", False, "deppy_tpu.analysis.lockdep",
+       "Runtime lock-order assertion mode: named locks track "
+       "acquisition order per thread, raise on lock-order inversions "
+       "and self-deadlocks, and emit `lockdep` telemetry events."),
+]
+
+REGISTRY: "dict[str, EnvVar]" = {v.name: v for v in _DECLARATIONS}
+assert len(REGISTRY) == len(_DECLARATIONS), "duplicate EnvVar declaration"
+
+
+def declared(name: str) -> bool:
+    return name in REGISTRY
+
+
+def require(name: str) -> Optional[EnvVar]:
+    """Assert ``name`` is a declared knob.  Only ``DEPPY_TPU_*`` names
+    are enforced — the defensive parse helpers are shared with
+    non-namespaced knobs (tests, DEPPY_BENCH_*) that this registry does
+    not own.  Returns the declaration (None for foreign names)."""
+    if not name.startswith(_PREFIX):
+        return None
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise UndeclaredEnvVar(
+            f"{name} is not declared in deppy_tpu.config.REGISTRY — "
+            f"declare it (name, type, default, consumer, help) so "
+            f"docs/configuration.md and `deppy lint` stay in sync"
+        ) from None
+
+
+def env_raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """``os.environ.get`` through the registry: the declaration is
+    asserted, the value comes back verbatim (callers keep their own
+    parse-or-degrade semantics)."""
+    require(name)
+    return os.environ.get(name, default)
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    raw = env_raw(name)
+    return raw if raw is not None and raw.strip() else default
+
+
+def env_int(name: str, default: Optional[int] = None,
+            strict: bool = True) -> Optional[int]:
+    """Typed int read.  ``strict`` raises on a malformed value (the
+    engine's import-time knobs fail loud); ``strict=False`` degrades to
+    the default like the fault layer's parsers."""
+    raw = env_raw(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        if strict:
+            raise
+        return default
+
+
+def env_float(name: str, default: Optional[float] = None,
+              strict: bool = True) -> Optional[float]:
+    raw = env_raw(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        if strict:
+            raise
+        return default
+
+
+_TRUE = frozenset(("1", "true", "yes", "on"))
+_FALSE = frozenset(("0", "false", "no", "off", ""))
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    raw = env_raw(name)
+    if raw is None:
+        return default
+    token = raw.strip().lower()
+    if token in _TRUE:
+        return True
+    if token in _FALSE:
+        return False
+    return default
+
+
+# ------------------------------------------------------------------ docs
+
+
+def _fmt_default(v: EnvVar) -> str:
+    if v.default is None:
+        return "(unset)"
+    if v.type == "bool":
+        return "on" if v.default else "off"
+    return str(v.default)
+
+
+def render_markdown() -> str:
+    """The docs/configuration.md body, generated from the registry.
+    ``python -m deppy_tpu.config`` regenerates the file;
+    tests/test_doc_sync.py pins the checked-in copy against this."""
+    lines = [
+        "# Configuration",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand.",
+        "     Regenerate with: python -m deppy_tpu.config > "
+        "docs/configuration.md",
+        "     Source of truth: deppy_tpu/config.py (REGISTRY). -->",
+        "",
+        "Every `DEPPY_TPU_*` environment knob, generated from the typed",
+        "registry in `deppy_tpu/config.py`.  The `registry-sync` checker",
+        "(`deppy lint`) fails on any knob read in code but missing here,",
+        "and `tests/test_doc_sync.py` pins this file against the",
+        "registry both ways.",
+        "",
+        "| Name | Type | Default | Consumer | Description |",
+        "| --- | --- | --- | --- | --- |",
+    ]
+    for name in sorted(REGISTRY):
+        v = REGISTRY[name]
+        lines.append(
+            f"| `{v.name}` | {v.type} | `{_fmt_default(v)}` | "
+            f"`{v.consumer}` | {v.help} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.stdout.write(render_markdown())

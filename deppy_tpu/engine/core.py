@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import config
+
 # Assignment values (same convention as the host engine).
 TRUE = 1
 FALSE = -1
@@ -478,7 +480,7 @@ def bcp_round(pt: ProblemTensors, assign: jax.Array,
 # VPU lanes, while a vmapped pallas_call serializes problems into grid
 # steps.  The kernel pays off only for single very large problems (clause
 # planes near VMEM capacity), so it stays opt-in.
-_BCP_IMPL = os.environ.get("DEPPY_TPU_BCP", "auto")
+_BCP_IMPL = config.env_raw("DEPPY_TPU_BCP", "auto")
 
 # Propagation rounds applied per fixpoint while_loop trip (the "bits"
 # path only).  >1 trades redundant work on converged lanes for fewer
@@ -488,7 +490,7 @@ _BCP_IMPL = os.environ.get("DEPPY_TPU_BCP", "auto")
 # 1 vs 6563/s at 2 vs 2631/s at 3; random catalog the same shape) —
 # per-trip overhead is negligible there and the redundant gated round
 # dominates.  Default 1; A/B on a real TPU before ever raising it.
-_BCP_UNROLL = max(1, int(os.environ.get("DEPPY_TPU_BCP_UNROLL", "1")))
+_BCP_UNROLL = max(1, int(config.env_raw("DEPPY_TPU_BCP_UNROLL", "1")))
 
 # Decision steps applied per dpll while_loop trip — the decision-level
 # twin of _BCP_UNROLL, one level up the trip hierarchy (search trips =
@@ -500,14 +502,14 @@ _BCP_UNROLL = max(1, int(os.environ.get("DEPPY_TPU_BCP_UNROLL", "1")))
 # shape as _BCP_UNROLL — redundant gated work for fewer ~175µs trips —
 # and same policy: default 1 everywhere until a real-chip A/B row
 # exists (scripts/tpu_ab.py carries dpll-unroll variants).
-_DPLL_UNROLL = max(1, int(os.environ.get("DEPPY_TPU_DPLL_UNROLL", "1")))
+_DPLL_UNROLL = max(1, int(config.env_raw("DEPPY_TPU_DPLL_UNROLL", "1")))
 
 # Episode-control steps (guess-stack pushes/pops) applied per control
 # while_loop trip — the outermost factor of the trip product.  Same
 # gated-repeat construction and same identity contract as _DPLL_UNROLL
 # (the control body's arms are selected under a ``live`` predicate);
 # default 1 until an on-chip A/B row exists.
-_CTL_UNROLL = max(1, int(os.environ.get("DEPPY_TPU_CTL_UNROLL", "1")))
+_CTL_UNROLL = max(1, int(config.env_raw("DEPPY_TPU_CTL_UNROLL", "1")))
 
 
 def _batch_planes(clauses: jax.Array, W: int) -> Tuple[jax.Array, jax.Array]:
@@ -621,12 +623,12 @@ def set_bcp_impl(name: str) -> None:
 # A/B win + full headline bench under the knob; every device bet in
 # this tree defaults off until such a measured row exists).  The env
 # knob and set_search_impl always override.
-_SEARCH_IMPL = os.environ.get("DEPPY_TPU_SEARCH", "auto")
+_SEARCH_IMPL = config.env_raw("DEPPY_TPU_SEARCH", "auto")
 
 # Measured-default registry: {backend: {"search": "fused"|"xla", ...}}.
 # Package-local so an installed wheel carries its measured defaults;
 # DEPPY_TPU_MEASURED_DEFAULTS overrides the path (tests, the ladder).
-_MEASURED_DEFAULTS_PATH = os.environ.get(
+_MEASURED_DEFAULTS_PATH = config.env_raw(
     "DEPPY_TPU_MEASURED_DEFAULTS",
     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                  "measured_defaults.json"))

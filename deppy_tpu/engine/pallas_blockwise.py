@@ -54,13 +54,14 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import config
 from . import core
 
 # Clause rows per block: 2 (pos+neg) x 2 (double-buffered DMA) x
 # BLOCK_ROWS x Wv x 4B of streamed VMEM; at the default and Wv = 128
 # that is 4 MiB, leaving headroom for the resident accumulators and
 # cardinality planes inside the ~16 MiB/core budget.
-BLOCK_ROWS = int(os.environ.get("DEPPY_TPU_BLOCK_ROWS", "2048"))
+BLOCK_ROWS = int(config.env_raw("DEPPY_TPU_BLOCK_ROWS", "2048"))
 
 
 def _kernel(minw_ref, en_ref, pos_ref, neg_ref, mem_ref, act_ref,

@@ -64,7 +64,9 @@ class CircuitBreaker:
         self.reset_after_s = float(reset_after_s)
         self.device = device
         self._clock = clock
-        self._lock = threading.Lock()
+        from ..analysis import lockdep
+
+        self._lock = lockdep.make_lock("faults.breaker")
         self._state = BREAKER_CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
@@ -114,7 +116,7 @@ class CircuitBreaker:
             # Half-open: claim the single probe slot.
             if self._probe_in_flight:
                 return False
-            ev = self._transition(BREAKER_HALF_OPEN)
+            ev = self._transition_locked(BREAKER_HALF_OPEN)
             self._probe_in_flight = True
         self._publish(ev)
         return True
@@ -126,7 +128,7 @@ class CircuitBreaker:
         with self._lock:
             self._consecutive_failures = 0
             self._probe_in_flight = False
-            ev = self._transition(BREAKER_CLOSED)
+            ev = self._transition_locked(BREAKER_CLOSED)
         self._publish(ev)
 
     def record_failure(self) -> bool:
@@ -140,11 +142,11 @@ class CircuitBreaker:
             if state == BREAKER_HALF_OPEN or self._probe_in_flight:
                 # The probe failed: back to a fresh cooldown.
                 self._probe_in_flight = False
-                ev = self._open()
+                ev = self._open_locked()
                 tripped = True
             elif (state == BREAKER_CLOSED
                     and self._consecutive_failures >= self.failure_threshold):
-                ev = self._open()
+                ev = self._open_locked()
                 tripped = True
         self._publish(ev)
         return tripped
@@ -165,7 +167,7 @@ class CircuitBreaker:
         with self._lock:
             self._consecutive_failures = 0
             self._probe_in_flight = False
-            ev = self._transition(BREAKER_CLOSED)
+            ev = self._transition_locked(BREAKER_CLOSED)
         self._publish(ev)
 
     # ------------------------------------------------------------ internal
@@ -178,15 +180,17 @@ class CircuitBreaker:
             return BREAKER_HALF_OPEN
         return self._state
 
-    def _open(self) -> "Optional[dict]":
+    def _open_locked(self) -> "Optional[dict]":
+        """Caller holds the breaker lock (the ``_locked`` convention the
+        concurrency-discipline checker keys on)."""
         prev = self._state
         self._opened_at = self._clock()
-        ev = self._transition(BREAKER_OPEN)
+        ev = self._transition_locked(BREAKER_OPEN)
         if ev is not None:
             ev["from"] = _STATE_NAMES[prev]
         return ev
 
-    def _transition(self, new_state: int) -> "Optional[dict]":
+    def _transition_locked(self, new_state: int) -> "Optional[dict]":
         """Mutate state only (caller holds the lock) and return the
         transition record for :meth:`_publish`, or None on no change.
         Telemetry — gauge, counter, JSONL sink write — happens OUTSIDE

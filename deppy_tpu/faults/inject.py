@@ -68,6 +68,43 @@ from fnmatch import fnmatch
 from typing import List, Optional, Union
 
 
+# The registered fault-point vocabulary (ISSUE 7 registry-sync): every
+# literal ``inject("point")`` site must name one of these (pinned by
+# `deppy lint`), and the operator plan paths (env / --fault-plan) warn
+# on rules that match none of them — a chaos plan written against a
+# renamed point would otherwise inject nothing and report green.
+# Entries ending ``.*`` are prefixes for dynamically-suffixed points
+# (one per mesh device).
+KNOWN_POINTS = (
+    "driver.dispatch",
+    "driver.device_put",
+    "driver.host_fallback",
+    "driver.shard_dispatch.*",
+    "checkpoint.save_group",
+    "service.resolve",
+    "sched.dispatch",
+    "hostpool.dispatch",
+    "hostpool.worker_crash",
+)
+
+
+def unmatched_points(plan: "FaultPlan") -> List[str]:
+    """Rule points that match no registered fault point (exact, or
+    either side globbing).  The operator plan paths warn on these; the
+    unit-test path (``FaultPlan.from_doc`` with synthetic points) stays
+    silent."""
+    out = []
+    for rule in plan.rules:
+        matched = any(
+            rule.point == known
+            or fnmatch(known, rule.point)
+            or fnmatch(rule.point, known)
+            for known in KNOWN_POINTS)
+        if not matched:
+            out.append(rule.point)
+    return out
+
+
 class InjectedFault(RuntimeError):
     """The scripted failure raised at an ``error`` fault point."""
 
@@ -135,8 +172,10 @@ class FaultPlan:
     """A parsed, hit-counting set of fault rules."""
 
     def __init__(self, rules: List[FaultRule]):
+        from ..analysis import lockdep
+
         self.rules = rules
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("faults.fault_plan")
 
     @classmethod
     def from_doc(cls, doc: Union[dict, list]) -> "FaultPlan":
@@ -209,10 +248,23 @@ def plan_from_env() -> Optional[FaultPlan]:
     unset/empty → None.  A malformed plan raises — a chaos run that
     silently injects nothing would report green without testing
     anything."""
-    raw = os.environ.get("DEPPY_TPU_FAULT_PLAN", "").strip()
+    from .. import config
+
+    raw = (config.env_raw("DEPPY_TPU_FAULT_PLAN", "") or "").strip()
     if not raw:
         return None
-    return plan_from_spec(raw)
+    plan = plan_from_spec(raw)
+    _warn_unmatched(plan)
+    return plan
+
+
+def _warn_unmatched(plan: FaultPlan) -> None:
+    import sys
+
+    for point in unmatched_points(plan):
+        print(f"[deppy] fault-plan rule point {point!r} matches no "
+              f"registered fault point ({', '.join(KNOWN_POINTS)}); "
+              f"it will never fire", file=sys.stderr, flush=True)
 
 
 def configure_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:

@@ -39,7 +39,12 @@ def env_float(name: str, default: Optional[float],
     """Shared defensive float-env parsing for every fault-domain knob
     (and the service's): a typo'd value degrades to the default — the
     fault layer must never be the thing that crashes a solve — with an
-    optional stderr warning for operator-facing knobs."""
+    optional stderr warning for operator-facing knobs.  ``DEPPY_TPU_*``
+    names resolve through the typed registry (ISSUE 7): an undeclared
+    knob raises at the read site instead of silently existing."""
+    from .. import config
+
+    config.require(name)
     raw = os.environ.get(name, "")
     if not raw:
         return default

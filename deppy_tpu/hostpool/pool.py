@@ -37,7 +37,7 @@ import threading
 from collections import deque
 from typing import List, Optional, Sequence, Union
 
-from .. import faults, telemetry
+from .. import config, faults, telemetry
 from . import metrics
 from .worker import HostLaneResult, _degraded_result, solve_lane, worker_main
 
@@ -75,7 +75,7 @@ def pool_workers() -> int:
     ``min(cpu_count, 8)``."""
     if _OVERRIDE_WORKERS is not None:
         return _OVERRIDE_WORKERS
-    raw = os.environ.get("DEPPY_TPU_HOST_WORKERS")
+    raw = config.env_raw("DEPPY_TPU_HOST_WORKERS")
     if raw is not None and raw.strip():
         return max(_env_int("DEPPY_TPU_HOST_WORKERS", 0), 0)
     return min(os.cpu_count() or 1, DEFAULT_MAX_WORKERS)
@@ -84,7 +84,7 @@ def pool_workers() -> int:
 def _workers_explicit() -> bool:
     if _OVERRIDE_WORKERS is not None:
         return True
-    raw = os.environ.get("DEPPY_TPU_HOST_WORKERS")
+    raw = config.env_raw("DEPPY_TPU_HOST_WORKERS")
     return raw is not None and bool(raw.strip())
 
 
@@ -140,12 +140,14 @@ class HostPool:
                 DEFAULT_SPAWN_TIMEOUT_S, warn=True)
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.start_method = (start_method
-                             or os.environ.get(
+                             or config.env_raw(
                                  "DEPPY_TPU_HOSTPOOL_START_METHOD")
                              or "forkserver")
+        from ..analysis import lockdep
+
         # One lock serializes dispatches AND lifecycle; a dispatch in
         # flight therefore drains before shutdown proceeds.
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("hostpool.pool")
         self._ctx = None
         self._workers: List[_Worker] = []
         self._next_wid = 0
@@ -271,11 +273,18 @@ class HostPool:
 
     @property
     def running(self) -> bool:
-        return self._started and not self._shutdown and bool(self._workers)
+        # Consistent triple under the pool lock (ISSUE 7 concurrency-
+        # discipline); never called while holding it — the in-class
+        # consumers are the *_locked* helpers, which read the fields
+        # directly.
+        with self._lock:
+            return (self._started and not self._shutdown
+                    and bool(self._workers))
 
     @property
     def available(self) -> bool:
-        return self._unavailable is None and not self._shutdown
+        with self._lock:
+            return self._unavailable is None and not self._shutdown
 
     def worker_pids(self) -> List[int]:
         with self._lock:
@@ -554,10 +563,14 @@ class HostPool:
             self._last_crashes += 1
         try:
             self._workers.append(self._spawn_locked())
-        except Exception:  # any spawn failure, HostPoolError included
+        except Exception as e:  # any spawn failure, HostPoolError included
             # Respawn refused (sandbox tightened mid-run): shrink; the
-            # solve loop drains inline once the pool empties.
-            pass
+            # solve loop drains inline once the pool empties.  Loud on
+            # the sink (ISSUE 7 exception-hygiene): a pool silently
+            # shrinking to empty is the flight recorder's business.
+            telemetry.default_registry().event(
+                "fault", fault="hostpool_respawn_failed",
+                error=type(e).__name__, workers=len(self._workers))
         metrics.gauge("deppy_hostpool_workers").set(len(self._workers))
 
 

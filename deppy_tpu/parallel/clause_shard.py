@@ -114,7 +114,7 @@ def solve_sharded(
             "clause_shard", core._resolved_impl(),
             hint="clause-sharded solve carries its per-round OR "
             "collective only in the 'bits' BCP round kernel; unset "
-            "DEPPY_TPU_BCP_IMPL or select bits",
+            "DEPPY_TPU_BCP or select bits",
         )
     if mesh is None:
         mesh = clause_mesh()

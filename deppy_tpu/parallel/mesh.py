@@ -44,7 +44,9 @@ def mesh_devices_from_env() -> Optional[int]:
     unset/empty/``0``/``1`` → None (mesh serving off — the historical
     single-device dispatch).  Malformed values warn and degrade to off,
     like every other fault-layer env knob."""
-    raw = (os.environ.get("DEPPY_TPU_MESH_DEVICES") or "").strip().lower()
+    from .. import config
+
+    raw = (config.env_raw("DEPPY_TPU_MESH_DEVICES") or "").strip().lower()
     if not raw or raw in ("0", "1", "off", "none"):
         return None
     if raw == "all":
@@ -147,6 +149,7 @@ def initialize_distributed(**kwargs) -> None:
             from jax._src.clusters import ClusterEnv
 
             detected = any(c.is_env_present() for c in ClusterEnv._cluster_types)
+        # deppy: lint-ok[exception-hygiene] probe fallback: absence of a cluster env IS the verdict
         except Exception:  # private API moved: assume plain single-host
             detected = False
         if not detected:
@@ -158,6 +161,7 @@ def initialize_distributed(**kwargs) -> None:
         # so a jax that drops the option degrades to its own default.
         try:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # deppy: lint-ok[exception-hygiene] optional config on older jax; initialize() below fails loud
         except Exception:
             pass
     jax.distributed.initialize(**kwargs)

@@ -293,6 +293,7 @@ def _probe_verdict() -> bool:
     the ``_ENGINE_USABLE`` cache and its lock)."""
     try:
         from ..engine import driver  # noqa: F401
+    # deppy: lint-ok[exception-hygiene] probe: an unusable engine import IS the False verdict
     except Exception:
         return False
     import os
@@ -304,6 +305,7 @@ def _probe_verdict() -> bool:
 
             jax.devices()
             return True
+        # deppy: lint-ok[exception-hygiene] probe: failure IS the False verdict
         except Exception:
             return False
     import subprocess
@@ -328,5 +330,6 @@ def _probe_verdict() -> bool:
             env=env,
         )
         return probe.returncode == 0
+    # deppy: lint-ok[exception-hygiene] probe: a hung/failed spawn IS the False verdict
     except Exception:  # TimeoutExpired (hung init) or spawn failure
         return False

@@ -96,8 +96,10 @@ class ResultCache:
 
     def __init__(self, capacity: int = 1024,
                  registry: Optional[telemetry.Registry] = None):
+        from ..analysis import lockdep
+
         self.capacity = max(int(capacity), 0)
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("sched.cache")
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         reg = registry if registry is not None \
             else telemetry.default_registry()

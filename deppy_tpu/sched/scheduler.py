@@ -188,7 +188,10 @@ class Scheduler:
             "Queue flushes by trigger (wait = max-wait elapsed, fill = "
             "lane target reached, drain = shutdown, inline = loop not "
             "running).", labelname="reason")
-        self._cv = threading.Condition()
+        from ..analysis import lockdep
+
+        # Named CV (ISSUE 7): lockdep-instrumented when armed.
+        self._cv = lockdep.make_condition("sched.queue")
         self._queue: List[_Group] = []
         self._depth = 0
         self._stop = False
@@ -226,6 +229,12 @@ class Scheduler:
 
             print(f"[sched] mesh resolution failed ({e}); serving "
                   f"single-device", file=sys.stderr, flush=True)
+            # On the sink too (ISSUE 7 exception-hygiene): a service
+            # meant to shard across 8 chips silently serving
+            # single-device is an incident, not a log line.
+            telemetry.default_registry().event(
+                "fault", fault="sched_mesh_unavailable",
+                error=type(e).__name__)
             self._mesh = None
         self._mesh_resolved = True
         self._apply_mesh_sizing(self._mesh)
@@ -279,8 +288,14 @@ class Scheduler:
 
     @property
     def running(self) -> bool:
-        t = self._thread
-        return t is not None and t.is_alive() and not self._stop
+        # Under the CV (ISSUE 7 concurrency-discipline): _thread/_stop
+        # are written by start()/stop() on other threads, and a torn
+        # pair here could route a submit inline while the loop drains
+        # the same group.  Reentrant: _enqueue reads this while holding
+        # the CV.
+        with self._cv:
+            t = self._thread
+            return t is not None and t.is_alive() and not self._stop
 
     # ------------------------------------------------------------- admission
 
@@ -300,8 +315,10 @@ class Scheduler:
             depth = self._depth
         if depth < self.max_depth:
             return None
+        with self._cv:
+            ewma = self._dispatch_ewma_s
         flushes = max(depth / float(self.max_fill), 1.0)
-        return max(flushes * self._dispatch_ewma_s, 1.0)
+        return max(flushes * ewma, 1.0)
 
     # ---------------------------------------------------------------- submit
 
@@ -500,8 +517,14 @@ class Scheduler:
                 g.error = e
         finally:
             dur = time.monotonic() - t0
-            self._dispatch_ewma_s = (0.8 * self._dispatch_ewma_s
-                                     + 0.2 * dur)
+            # Read-modify-write under the CV: admission_retry_after
+            # reads the EWMA from handler threads while the dispatch
+            # loop updates it here (the first real finding the
+            # concurrency audit fixed; pinned by
+            # tests/test_analysis.py::TestSchedulerEwmaRegression).
+            with self._cv:
+                self._dispatch_ewma_s = (0.8 * self._dispatch_ewma_s
+                                         + 0.2 * dur)
             timing["dispatch_s"] = dur
             for g in groups:
                 g.timing.update(timing)

@@ -32,7 +32,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
-from . import faults
+from . import config, faults
 from . import io as problem_io
 from . import telemetry
 from .sat.errors import (BackendCapabilityError, DuplicateIdentifier,
@@ -176,6 +176,7 @@ class Metrics:
         if self._engine_probe is not None:
             try:
                 usable = self._engine_probe()
+            # deppy: lint-ok[exception-hygiene] a broken probe must not break scrapes; gauge goes absent
             except Exception:
                 usable = None  # a broken probe must not break scrapes
         lines = self.registry.render_lines()
@@ -242,7 +243,7 @@ class Server:
         # either way.  The scheduler registers its queue/cache metric
         # families on this server's registry, so they ride /metrics.
         if sched is None:
-            sched = os.environ.get("DEPPY_TPU_SCHED", "on")
+            sched = config.env_raw("DEPPY_TPU_SCHED", "on")
         self.scheduler = None
         if str(sched).strip().lower() not in ("off", "0", "false", "no"):
             from .sched import Scheduler
@@ -269,7 +270,9 @@ class Server:
             drain_s = request_deadline_s if request_deadline_s else 10.0
         self._drain_s = max(float(drain_s), 0.0)
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        from .analysis import lockdep
+
+        self._inflight_lock = lockdep.make_lock("service.inflight")
         self._idle = threading.Event()
         self._idle.set()
         # Optional active-passive HA (the reference manager's leader
@@ -287,14 +290,14 @@ class Server:
             self.elector.on_change = self._on_leader_change
         try:
             self._reprobe_s = float(
-                os.environ.get("DEPPY_TPU_REPROBE", "600")
+                config.env_raw("DEPPY_TPU_REPROBE", "600")
             )
         except ValueError:
             # A typo'd env var must degrade to the default, not kill the
             # server at startup (matches DEPPY_BENCH_SELF_DESTRUCT's
             # defensive parsing).
             print("[service] ignoring non-numeric DEPPY_TPU_REPROBE="
-                  f"{os.environ.get('DEPPY_TPU_REPROBE')!r}; using 600",
+                  f"{config.env_raw('DEPPY_TPU_REPROBE')!r}; using 600",
                   file=sys.stderr, flush=True)
             self._reprobe_s = 600.0
         self._api = _make_http_server(
@@ -476,6 +479,7 @@ class Server:
                 try:
                     if sat_solver.resolve_backend("auto") == "tpu":
                         return
+                # deppy: lint-ok[exception-hygiene] request-path resolution surfaces the real error
                 except Exception:
                     pass  # request-path resolution will surface errors
                 while self._reprobe_s > 0 and not self._stop.wait(
@@ -483,6 +487,7 @@ class Server:
                     try:
                         if sat_solver.reprobe_engine():
                             return
+                    # deppy: lint-ok[exception-hygiene] transient reprobe failure; next tick retries
                     except Exception:
                         continue  # transient; keep trying next tick
 

@@ -108,16 +108,21 @@ class Counter:
             return dict(self._labeled)
 
     def _render(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} counter"]
-        if self.labelname is None:
-            lines.append(f"{self.name} {_fmt(self._value)}")
-        else:
-            for lab, n in sorted(self._labeled.items()):
-                lines.append(
-                    f'{self.name}{{{self.labelname}="{lab}"}} {_fmt(n)}'
-                )
-        return lines
+        # The shared registry RLock: re-entrant under render_lines'
+        # snapshot, real protection for a standalone render (ISSUE 7
+        # concurrency-discipline: a concurrent first-time label was a
+        # dict-changed-during-iteration away).
+        with self._lock:
+            lines = [f"# HELP {self.name} {self.help}",
+                     f"# TYPE {self.name} counter"]
+            if self.labelname is None:
+                lines.append(f"{self.name} {_fmt(self._value)}")
+            else:
+                for lab, n in sorted(self._labeled.items()):
+                    lines.append(
+                        f'{self.name}{{{self.labelname}="{lab}"}} {_fmt(n)}'
+                    )
+            return lines
 
 
 class Gauge:
@@ -142,11 +147,12 @@ class Gauge:
             return self._value
 
     def _render(self) -> List[str]:
-        if self._value is None:
-            return []
-        return [f"# HELP {self.name} {self.help}",
-                f"# TYPE {self.name} gauge",
-                f"{self.name} {_fmt(self._value)}"]
+        with self._lock:
+            if self._value is None:
+                return []
+            return [f"# HELP {self.name} {self.help}",
+                    f"# TYPE {self.name} gauge",
+                    f"{self.name} {_fmt(self._value)}"]
 
 
 class Histogram:
@@ -197,13 +203,14 @@ class Histogram:
         return out
 
     def _render(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} histogram"]
-        for le, n in self.cumulative():
-            lines.append(f'{self.name}_bucket{{le="{le}"}} {n}')
-        lines.append(f"{self.name}_sum {_fmt(self._sum)}")
-        lines.append(f"{self.name}_count {self._count}")
-        return lines
+        with self._lock:  # re-entrant: cumulative() re-takes it
+            lines = [f"# HELP {self.name} {self.help}",
+                     f"# TYPE {self.name} histogram"]
+            for le, n in self.cumulative():
+                lines.append(f'{self.name}_bucket{{le="{le}"}} {n}')
+            lines.append(f"{self.name}_sum {_fmt(self._sum)}")
+            lines.append(f"{self.name}_count {self._count}")
+            return lines
 
 
 class Span:
@@ -278,14 +285,18 @@ class Registry:
     """
 
     def __init__(self, sink_path: Optional[str] = None):
+        from ..analysis import lockdep
+
         # RLock: render_lines holds it across every family's _render so a
         # scrape is one consistent snapshot (no torn histograms, no
         # dict-changed-during-iteration from a concurrent first-time
         # label), while the family accessors re-enter it freely.
-        self._lock = threading.RLock()
+        # Named factory (ISSUE 7): DEPPY_TPU_LOCKDEP=1 swaps in the
+        # order-asserting proxy; disarmed, this IS threading.RLock().
+        self._lock = lockdep.make_rlock("telemetry.registry")
         self._families: Dict[str, object] = {}
         self._order: List[str] = []
-        self._sink_lock = threading.Lock()
+        self._sink_lock = lockdep.make_lock("telemetry.registry.sink")
         self._sink_path = sink_path
         self._sink_file = None
         # Bounded in-memory span tail for `deppy stats` on a live
@@ -366,6 +377,7 @@ class Registry:
         from . import trace as _trace
 
         traced = _trace.current_context() is not None
+        # deppy: lint-ok[concurrency-discipline] deliberate unlocked fast-path read; emit() re-checks under the lock
         if self._sink_path is None and not traced:
             return
         event = {"ts": round(time.time(), 3), "kind": kind, **fields}
@@ -390,12 +402,14 @@ class Registry:
 
     @property
     def sink_path(self) -> Optional[str]:
-        return self._sink_path
+        with self._sink_lock:
+            return self._sink_path
 
     def emit(self, event: dict) -> None:
         """Append one event object to the sink, if configured.  Sink I/O
         failures disable the sink rather than failing the solve — the
         pipeline must never die to observability."""
+        # deppy: lint-ok[concurrency-discipline] double-checked: the unlocked read only skips work, the locked one decides
         if self._sink_path is None:
             return
         with self._sink_lock:
@@ -462,8 +476,10 @@ def default_registry() -> Registry:
     if _DEFAULT is None:
         with _DEFAULT_LOCK:
             if _DEFAULT is None:
+                from .. import config
+
                 _DEFAULT = Registry(
-                    sink_path=os.environ.get("DEPPY_TPU_TELEMETRY_FILE")
+                    sink_path=config.env_raw("DEPPY_TPU_TELEMETRY_FILE")
                     or None
                 )
     return _DEFAULT

@@ -101,7 +101,9 @@ class TraceContext:
         self.error = False
         self.ts = round(time.time(), 3)
         self.parents: Tuple["ParentRef", ...] = tuple(parents)
-        self._lock = threading.Lock()
+        from ..analysis import lockdep
+
+        self._lock = lockdep.make_lock("telemetry.trace_context")
 
     def note(self, event: dict, kind: str,
              errored: Optional[bool] = None) -> None:
@@ -121,7 +123,9 @@ class TraceContext:
             if kind == "fault" and event.get("fault") == "deadline_exceeded":
                 errored = not self.parents
             else:
-                errored = (kind == "fault"
+                # lockdep violations (ISSUE 7) are incidents like
+                # faults: the trace lands in the error ring.
+                errored = (kind in ("fault", "lockdep")
                            or (kind == "breaker"
                                and event.get("state") == "open")
                            or "error" in event.get("attrs", {}))
@@ -326,8 +330,10 @@ DEFAULT_ERROR_RING = 256
 
 
 def _env_cap(name: str, default: int) -> int:
+    from .. import config
+
     try:
-        return max(int(os.environ.get(name, "") or default), 1)
+        return max(int(config.env_raw(name, "") or default), 1)
     except ValueError:
         return default
 
@@ -348,7 +354,9 @@ class FlightRecorder:
             else _env_cap("DEPPY_TPU_TRACE_RING", DEFAULT_RING)
         self.error_capacity = error_capacity if error_capacity is not None \
             else _env_cap("DEPPY_TPU_TRACE_ERROR_RING", DEFAULT_ERROR_RING)
-        self._lock = threading.Lock()
+        from ..analysis import lockdep
+
+        self._lock = lockdep.make_lock("telemetry.flight_recorder")
         # Rings keyed by a per-record sequence number, NOT the trace id:
         # several requests legitimately share one inbound W3C trace id
         # (a proxy fanning out under one distributed trace), and keying
@@ -493,5 +501,6 @@ def notify_breaker_open() -> None:
     breaker's own transition must not die to observability."""
     try:
         default_recorder().dump(reason="breaker_open")
+    # deppy: lint-ok[exception-hygiene] the breaker transition must never die to observability
     except Exception:
         pass

@@ -200,6 +200,7 @@ class LeaseElector:
         renew interval."""
         try:
             verdict = self._tick_inner()
+        # deppy: lint-ok[exception-hygiene] fail-closed by design: verdict=False flips /readyz
         except Exception:
             # Fail closed (see module docstring): unreachable OR
             # misbehaving API ⇒ not leader, so /readyz flips rather than
@@ -261,6 +262,7 @@ class LeaseElector:
                 return
             spec["holderIdentity"] = ""
             self._request("PUT", self.config.url, doc)
+        # deppy: lint-ok[exception-hygiene] best-effort release; lease expiry bounds the outage
         except Exception:
             pass  # best effort; expiry still bounds the outage
 
@@ -276,6 +278,7 @@ class LeaseElector:
             if self.on_change is not None:
                 try:
                     self.on_change(value)
+                # deppy: lint-ok[exception-hygiene] observer errors must not break election
                 except Exception:
                     pass  # observer errors must not break election
 

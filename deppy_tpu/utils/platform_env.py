@@ -188,7 +188,9 @@ def enable_compile_cache() -> None:
     env var explicitly opts in.  ``bench.py`` opts its accelerator
     subprocess in explicitly (the platform env is unset there so the
     PJRT plugin resolves)."""
-    path = os.environ.get("DEPPY_TPU_COMPILE_CACHE")
+    from .. import config
+
+    path = config.env_raw("DEPPY_TPU_COMPILE_CACHE")
     if path is not None:
         token = path.strip().lower()
         if token in ("off", "0", ""):
@@ -209,6 +211,7 @@ def enable_compile_cache() -> None:
         # per-shape executables are exactly what we want cached.
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # deppy: lint-ok[exception-hygiene] cache is an optimization: read-only home / old jax leaves it off
     except Exception:
         pass
 

@@ -136,9 +136,9 @@ def main() -> None:
     # The parity check is fused-vs-XLA: ambient engine knobs could
     # reroute the "XLA twin" dispatchers onto the very kernels under
     # test (DEPPY_TPU_SEARCH=fused) or change the batch construction
-    # (DEPPY_TPU_IMPL/BCP) — strip them before the engine import reads
+    # (DEPPY_TPU_BCP) — strip them before the engine import reads
     # them.
-    for knob in ("DEPPY_TPU_SEARCH", "DEPPY_TPU_IMPL", "DEPPY_TPU_BCP",
+    for knob in ("DEPPY_TPU_SEARCH", "DEPPY_TPU_BCP",
                  "DEPPY_TPU_BCP_UNROLL", "DEPPY_TPU_STAGE1_STEPS"):
         os.environ.pop(knob, None)
 

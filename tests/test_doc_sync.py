@@ -84,3 +84,37 @@ def test_scan_scope_is_sane():
             "deppy_sched_dispatches_total",
             "deppy_hostpool_queue_depth",
             "deppy_request_queue_wait_seconds"} <= registered
+
+
+# --------------------------------------------------- configuration.md
+#
+# ISSUE 7: docs/configuration.md is GENERATED from the typed env
+# registry (deppy_tpu/config.py).  Pin it both ways: the checked-in
+# file matches a fresh render byte for byte (stale doc fails), and the
+# registry itself covers the knobs the other docs talk about (vacuous-
+# scan guard, mirroring test_scan_scope_is_sane).
+
+CONFIG_DOC = REPO / "docs" / "configuration.md"
+
+
+def test_configuration_doc_matches_registry():
+    from deppy_tpu import config
+
+    rendered = config.render_markdown()
+    on_disk = CONFIG_DOC.read_text(encoding="utf-8")
+    assert on_disk == rendered, (
+        "docs/configuration.md is stale — regenerate with: "
+        "python -m deppy_tpu.config > docs/configuration.md")
+
+
+def test_registry_scope_is_sane():
+    from deppy_tpu import config
+
+    assert {"DEPPY_TPU_TELEMETRY_FILE", "DEPPY_TPU_FAULT_PLAN",
+            "DEPPY_TPU_SCHED", "DEPPY_TPU_HOST_WORKERS",
+            "DEPPY_TPU_MESH_DEVICES", "DEPPY_TPU_LOCKDEP",
+            "DEPPY_TPU_MAX_LANES"} <= set(config.REGISTRY)
+    # Every declared knob names its consumer and carries help text —
+    # the generated table must never grow empty cells.
+    for var in config.REGISTRY.values():
+        assert var.consumer and var.help and var.type
