@@ -85,8 +85,12 @@ test-shard: ## Mesh-serving shard subsystem tests only (the `shard` pytest marke
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m shard
 
 .PHONY: lint
-lint: ## Static analysis: the four deppy-lint checkers vs analysis/baseline.json (ISSUE 7 acceptance; docs/analysis.md).
+lint: ## Static analysis: the six deppy-lint checkers vs analysis/baseline.json (ISSUE 7/8 acceptance; docs/analysis.md).
 	$(PYTHON) -m deppy_tpu lint
+
+.PHONY: lint-fast
+lint-fast: ## Pre-commit loop: checkers restricted to files changed vs HEAD (skips the repo-wide walk and absence-proving rules; run `make lint` before merging).
+	$(PYTHON) -m deppy_tpu lint --changed
 
 .PHONY: test-analysis
 test-analysis: ## Static-analysis framework + lockdep tests only (the `analysis` pytest marker).
@@ -99,6 +103,15 @@ test-lockdep: ## The threaded-subsystem suites under runtime lock-order assertio
 .PHONY: lockdep-smoke
 lockdep-smoke: ## Scripted lock-order inversion end to end: LockdepError + sink event + flight recorder + stats/trace CLIs.
 	$(PYTHON) scripts/lockdep_smoke.py
+
+.PHONY: test-compileguard
+test-compileguard: ## Compile-contract suite (the `compileguard` pytest marker) plus the sched/shard tiers under the runtime guard (ISSUE 8 acceptance).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m compileguard
+	JAX_PLATFORMS=cpu DEPPY_TPU_COMPILE_GUARD=1 DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m "(sched or shard) and not slow"
+
+.PHONY: compileguard-smoke
+compileguard-smoke: ## Scripted jit-in-loop compile storm end to end: CompileGuardError + stamped sink events + `deppy compiles`/`deppy stats` + the static jit-no-memo finding.
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/compileguard_smoke.py
 
 ##@ Benchmarks
 

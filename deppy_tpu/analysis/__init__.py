@@ -6,22 +6,32 @@ tier can see: silent host-sync/recompile hazards *inside* traced code,
 and unsynchronized shared state *across* threads.  This package is the
 invariant gate those classes are held to:
 
-  * **checkers** — four AST checkers behind ``deppy lint``
+  * **checkers** — six AST checkers behind ``deppy lint``
     (:mod:`.purity`, :mod:`.concurrency`, :mod:`.registry_sync`,
-    :mod:`.exceptions`), with a findings baseline
-    (``analysis/baseline.json``) so CI fails only on NEW findings while
-    the existing ones burn down (see docs/analysis.md);
+    :mod:`.exceptions`, and the ISSUE 8 compile-contract tier
+    :mod:`.compile_surface` + :mod:`.block_contract`), with a findings
+    baseline (``analysis/baseline.json``) so CI fails only on NEW
+    findings while the existing ones burn down (see docs/analysis.md);
   * **lockdep** — a runtime lock-order assertion mode
     (``DEPPY_TPU_LOCKDEP=1``, :mod:`.lockdep`): the subsystems' locks
     are created through named factories, and with the mode armed every
     acquisition is checked against the process's observed lock order —
     inversions and self-deadlocks raise *before* they deadlock, and
-    emit ``lockdep`` events onto the telemetry sink / flight recorder.
+    emit ``lockdep`` events onto the telemetry sink / flight recorder;
+  * **compileguard** — lockdep's compile-discipline twin
+    (``DEPPY_TPU_COMPILE_GUARD=1``, :mod:`.compileguard`): the
+    engine's jit/pjit entries are created through
+    ``compileguard.observe``, every trace/compile is recorded as a
+    ``compileguard`` sink event, and retracing one abstract signature
+    past its budget raises *before* a compile storm eats the serving
+    path (``deppy compiles`` summarizes the sink).
 
 The checkers are import-light (stdlib ``ast`` only) so ``deppy lint``
-runs without JAX; lockdep imports telemetry lazily, only on violation.
+runs without JAX; lockdep and compileguard import telemetry lazily.
 """
 
+from . import compileguard
+from .compileguard import CompileGuardError
 from .core import (
     CHECKERS,
     Baseline,
@@ -41,9 +51,11 @@ from .lockdep import (
 __all__ = [
     "Baseline",
     "CHECKERS",
+    "CompileGuardError",
     "Finding",
     "LockdepError",
     "baseline_path",
+    "compileguard",
     "lockdep_enabled",
     "make_condition",
     "make_lock",

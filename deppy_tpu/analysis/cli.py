@@ -12,16 +12,34 @@ import json
 import sys
 from typing import List
 
-from .core import (Baseline, Finding, baseline_path, repo_root,
-                   run_checkers)
+from .core import (Baseline, Finding, baseline_path, changed_files,
+                   repo_root, run_checkers)
 
 
 def run_lint(args) -> int:
     from pathlib import Path
 
     root = repo_root()
+    paths = None
+    changed = getattr(args, "changed", None)
+    if changed is not None:
+        if args.update_baseline:
+            # A subset scan would be saved as THE baseline, erasing
+            # every accepted key in unscanned files.
+            print("error: --changed cannot be combined with "
+                  "--update-baseline (baseline bookkeeping needs the "
+                  "full scan)", file=sys.stderr)
+            return 2
+        try:
+            paths = changed_files(root, changed)
+        except RuntimeError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if not paths:
+            print(f"0 finding(s) — no files changed vs {changed}")
+            return 0
     try:
-        findings = run_checkers(root, names=args.checker)
+        findings = run_checkers(root, names=args.checker, paths=paths)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -56,6 +74,9 @@ def run_lint(args) -> int:
                   file=sys.stderr)
             return 2
     new, stale = baseline.diff(findings)
+    if changed is not None:
+        # A subset scan can't prove a baseline key's finding is gone.
+        stale = []
     partial = args.checker is not None and not args.no_baseline
     if partial:
         # A single-checker run must not report every OTHER checker's

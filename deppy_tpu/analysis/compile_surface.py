@@ -1,0 +1,469 @@
+"""compile-surface: construction discipline of the jit/pjit surface.
+
+The engine's cost model is dominated by compile/dispatch discipline,
+not arithmetic (~175µs of while-trip overhead per ~10µs of useful
+work), and the failure classes all live at jit *construction* sites:
+a jit built inside a per-call path rebuilds its cache every call
+(compile storm), an undeclared static retraces per value, a closure
+over mutable module state silently pins a stale config into the
+compiled program, and a Mosaic-hostile op inside a kernel body fails
+only on real hardware (the PR 6 integer ``reduce_*`` class).  This
+checker walks every ``jit`` / ``pjit`` / ``shard_map`` /
+``pallas_call`` wrapping in the tree into a **jit-surface registry**
+(:func:`jit_surface`) and enforces four rules over it:
+
+  * ``jit-no-memo`` — ``jax.jit``/``pjit`` called inside a function
+    with no memo (``functools.lru_cache``/``cache``) on it or any
+    enclosing def: each call builds a fresh jit cache, so every call
+    retraces (the runtime compile-guard's ``retrace-budget`` assertion
+    is this rule's trace-time twin);
+  * ``undeclared-static-arg`` — the wrapped function has keyword-only
+    parameters (the repo's static-configuration idiom: ``*, V, NCON,
+    NV``) that are neither bound by a ``functools.partial`` in the
+    wrapping chain nor named in ``static_argnames``: a tracer leaks
+    into shape arithmetic, or the value silently retraces per call;
+  * ``mutable-closure`` — a traced function (transitively, via the
+    module-local call graph) reads a module global that some function
+    rebinds (``global X``) or that the module assigns more than once:
+    the value is baked in at trace time and the compiled program goes
+    stale without a cache invalidation;
+  * ``mosaic-int-reduce`` — a Pallas kernel body (the function handed
+    to ``pallas_call``, plus its module-local callees) calls an
+    integer reduction (``jnp.sum``/``.min``/``.max``/``.prod``/
+    ``argmin``/``argmax`` or the method forms): the installed Mosaic
+    lowering rejects every integer ``reduce_*`` primitive — use the
+    halving-tree folds (``core.tree_sum``/``tree_min``/``tree_max``),
+    the permanent encoding of the PR 6 fix.
+
+Wrapping chains are resolved through the transparent combinators the
+repo composes (``vmap``, ``functools.partial``,
+``compileguard.observe``, ``shard_map``), so
+``jax.jit(observe("e", vmap(partial(fn, V=V))))`` attributes to
+``fn``.  Like every checker here: stdlib ``ast`` only, module-local
+call graphs, baseline/suppression semantics from :mod:`.core`.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, SourceFile
+from .core import dotted as _dotted
+
+# Calls that register a jit-surface entry.
+_SURFACE = {"jit", "pjit", "shard_map", "pallas_call"}
+# Only these rebuild a trace cache per construction (pallas_call inside
+# an already-traced function is the normal idiom; shard_map without jit
+# is eager).
+_CACHED_SURFACE = {"jit", "pjit"}
+_MEMO_DECORATORS = {"lru_cache", "cache"}
+# Combinators that forward to an inner function without ending the
+# wrapping chain; the value maps a combinator to the positional index
+# of its function argument.
+_TRANSPARENT = {"vmap": 0, "partial": 0, "observe": 1, "shard_map": 0,
+                "wraps": 0, "checkify": 0, "remat": 0, "checkpoint": 0}
+_INT_REDUCES = {"sum", "min", "max", "prod", "argmin", "argmax"}
+
+
+
+def _leaf(node: ast.AST) -> str:
+    return (_dotted(node) or "").rsplit(".", 1)[-1]
+
+
+@dataclass
+class JitEntry:
+    """One jit-surface registry row."""
+
+    path: str        # repo-relative
+    line: int
+    kind: str        # jit | pjit | shard_map | pallas_call
+    name: str        # enclosing def / assigned target / wrapped fn
+    memoized: bool   # under an lru_cache/cache factory
+    observed: bool   # wrapped with compileguard.observe
+    in_function: bool  # constructed per-call (vs once at import)
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "kind": self.kind,
+                "name": self.name, "memoized": self.memoized,
+                "observed": self.observed,
+                "in_function": self.in_function}
+
+
+class _Parents(ast.NodeVisitor):
+    """child -> parent map (the stdlib ast has no parent pointers)."""
+
+    def __init__(self, tree: ast.AST):
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+
+    def enclosing_defs(self, node: ast.AST) -> List[ast.FunctionDef]:
+        """Innermost-first function chain around ``node``.  A call
+        sitting in a def's decorator list executes at the *enclosing*
+        scope, not inside the def — skip that def."""
+        out: List[ast.FunctionDef] = []
+        cur: Optional[ast.AST] = node
+        prev: Optional[ast.AST] = None
+        while cur is not None:
+            parent = self.parent.get(cur)
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                in_decorators = prev is not None and any(
+                    prev is d or any(prev is sub for sub in ast.walk(d))
+                    for d in cur.decorator_list)
+                if not in_decorators:
+                    out.append(cur)
+            prev, cur = cur, parent
+        return out
+
+
+def _has_memo(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if _leaf(target) in _MEMO_DECORATORS:
+            return True
+    return False
+
+
+def _local_env(fn: Optional[ast.FunctionDef]) -> Dict[str, ast.AST]:
+    """Single-target local assignments inside ``fn`` (the factory
+    idiom: ``fn = functools.partial(solve_full, V=V); jax.jit(vmap(fn))``
+    — the chain resolver follows the name back to its value)."""
+    if fn is None:
+        return {}
+    env: Dict[str, ast.AST] = {}
+    for stmt in ast.walk(fn):
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            env[stmt.targets[0].id] = stmt.value
+    return env
+
+
+def _unwrap(node: ast.AST, module_funcs: Dict[str, ast.FunctionDef],
+            local_env: Optional[Dict[str, ast.AST]] = None
+            ) -> Tuple[Optional[str], Set[str], bool]:
+    """Follow a wrapping chain down to a module-local function name.
+    Returns (name-or-None, keyword names bound by partials along the
+    way, whether compileguard.observe appears in the chain)."""
+    bound: Set[str] = set()
+    observed = False
+    local_env = local_env or {}
+    cur: Optional[ast.AST] = node
+    for _ in range(16):  # chains are short; bound-loop paranoia
+        if isinstance(cur, ast.Name):
+            if cur.id in module_funcs:
+                return cur.id, bound, observed
+            nxt = local_env.get(cur.id)
+            if nxt is None or nxt is cur:
+                return None, bound, observed
+            cur = nxt
+            continue
+        if not isinstance(cur, ast.Call):
+            return None, bound, observed
+        leaf = _leaf(cur.func)
+        if leaf not in _TRANSPARENT:
+            return None, bound, observed
+        if leaf == "observe":
+            observed = True
+        if leaf == "partial":
+            bound |= {kw.arg for kw in cur.keywords if kw.arg}
+        idx = _TRANSPARENT[leaf]
+        if len(cur.args) <= idx:
+            return None, bound, observed
+        cur = cur.args[idx]
+    return None, bound, observed
+
+
+def _static_names(call: ast.Call) -> Optional[Set[str]]:
+    """Names in ``static_argnames`` (None when the keyword is absent —
+    distinct from an explicit empty declaration)."""
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names: Set[str] = set()
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str):
+                    names.add(sub.value)
+            return names
+    return None
+
+
+def _surface_calls(sf: SourceFile):
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and _leaf(node.func) in _SURFACE:
+            yield node
+
+
+def _entry_name(call: ast.Call, parents: _Parents,
+                module_funcs: Dict[str, ast.FunctionDef]) -> str:
+    defs = parents.enclosing_defs(call)
+    if defs:
+        return defs[0].name
+    # Module-level construction: prefer the assignment target.
+    cur: Optional[ast.AST] = call
+    while cur is not None:
+        parent = parents.parent.get(cur)
+        if isinstance(parent, ast.Assign) and parent.targets:
+            target = parent.targets[0]
+            name = _dotted(target)
+            if name:
+                return name
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Module)):
+            break
+        cur = parent
+    wrapped, _, _ = _unwrap(call.args[0] if call.args else call,
+                            module_funcs)
+    return wrapped or "<module>"
+
+
+def jit_surface(files: Optional[List[SourceFile]] = None
+                ) -> List[JitEntry]:
+    """The repo-wide jit-surface registry: one row per ``jit`` /
+    ``pjit`` / ``shard_map`` / ``pallas_call`` construction, with its
+    memoization and compile-guard status.  ``deppy compiles --surface``
+    prints it; tests pin the engine's known entries against it."""
+    if files is None:
+        from .core import SourceFile as SF
+        from .core import _iter_py_files, repo_root
+
+        root = repo_root()
+        files = [SF.load(p, root)
+                 for p in _iter_py_files(root, ("deppy_tpu",))]
+    entries: List[JitEntry] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        parents = _Parents(sf.tree)
+        module_funcs = {
+            n.name: n for n in ast.walk(sf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for call in _surface_calls(sf):
+            kind = _leaf(call.func)
+            defs = parents.enclosing_defs(call)
+            memoized = any(_has_memo(fn) for fn in defs)
+            env = _local_env(defs[0]) if defs else {}
+            _, _, observed = _unwrap(
+                call.args[0] if call.args else call, module_funcs, env)
+            entries.append(JitEntry(
+                path=sf.rel, line=call.lineno, kind=kind,
+                name=_entry_name(call, parents, module_funcs),
+                memoized=memoized, observed=observed,
+                in_function=bool(defs)))
+    entries.sort(key=lambda e: (e.path, e.line))
+    return entries
+
+
+class CompileSurfaceChecker(Checker):
+    name = "compile-surface"
+    default_scope = ("deppy_tpu",)
+
+    def check(self, files: List[SourceFile], root: Path) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in files:
+            self._check_module(out, sf)
+        return out
+
+    # ------------------------------------------------------------- module
+
+    def _check_module(self, out: List[Finding], sf: SourceFile) -> None:
+        parents = _Parents(sf.tree)
+        module_funcs = {
+            n.name: n for n in ast.walk(sf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+        kernel_roots: Set[str] = set()
+        traced_roots: Set[str] = set()
+        for call in _surface_calls(sf):
+            kind = _leaf(call.func)
+            defs = parents.enclosing_defs(call)
+            env = _local_env(defs[0]) if defs else {}
+            if kind in _CACHED_SURFACE:
+                self._check_no_memo(out, sf, call, parents)
+                self._check_static_args(out, sf, call, module_funcs,
+                                        env)
+            wrapped, _, _ = _unwrap(
+                call.args[0] if call.args else call, module_funcs, env)
+            if wrapped:
+                (kernel_roots if kind == "pallas_call"
+                 else traced_roots).add(wrapped)
+        # Decorator-wrapped defs join the traced set (@jax.jit).
+        for name, fn in module_funcs.items():
+            for dec in fn.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _leaf(target) in _CACHED_SURFACE:
+                    traced_roots.add(name)
+                    if isinstance(dec, ast.Call):
+                        self._check_decorated_static(out, sf, fn, dec)
+                elif (isinstance(dec, ast.Call)
+                        and _leaf(target) == "partial" and dec.args
+                        and _leaf(dec.args[0]) in _CACHED_SURFACE):
+                    traced_roots.add(name)
+                    self._check_decorated_static(out, sf, fn, dec)
+
+        calls = self._callgraph(module_funcs)
+        self._check_mutable_closure(
+            out, sf, self._reach(traced_roots | kernel_roots, calls),
+            module_funcs)
+        self._check_mosaic(out, sf, self._reach(kernel_roots, calls),
+                           module_funcs)
+
+    @staticmethod
+    def _callgraph(module_funcs) -> Dict[str, Set[str]]:
+        graph: Dict[str, Set[str]] = {}
+        for name, fn in module_funcs.items():
+            callees: Set[str] = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Name) and sub.id in module_funcs:
+                    callees.add(sub.id)
+            callees.discard(name)
+            graph[name] = callees
+        return graph
+
+    @staticmethod
+    def _reach(roots: Set[str], graph: Dict[str, Set[str]]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = list(roots)
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            stack.extend(graph.get(name, ()))
+        return seen
+
+    # ------------------------------------------------------------ rule 1
+
+    def _check_no_memo(self, out: List[Finding], sf: SourceFile,
+                       call: ast.Call, parents: _Parents) -> None:
+        defs = parents.enclosing_defs(call)
+        if not defs:
+            return  # module-level construction compiles once per import
+        if any(_has_memo(fn) for fn in defs):
+            return
+        kind = _leaf(call.func)
+        self.finding(
+            out, sf, call.lineno, "jit-no-memo",
+            f"{defs[0].name}:{kind}",
+            f"`{kind}(...)` constructed inside `{defs[0].name}` with no "
+            f"lru_cache/cache memo on the call path — every call builds "
+            f"a fresh trace cache and recompiles; memoize the factory "
+            f"or hoist the wrapping to module level")
+
+    # ------------------------------------------------------------ rule 2
+
+    def _missing_statics(self, fn: ast.FunctionDef, bound: Set[str],
+                         declared: Optional[Set[str]]) -> List[str]:
+        kwonly = [a.arg for a in fn.args.kwonlyargs]
+        declared = declared or set()
+        return [n for n in kwonly if n not in bound and n not in declared]
+
+    def _check_static_args(self, out: List[Finding], sf: SourceFile,
+                           call: ast.Call, module_funcs,
+                           local_env=None) -> None:
+        if not call.args:
+            return
+        wrapped, bound, _ = _unwrap(call.args[0], module_funcs,
+                                    local_env)
+        if wrapped is None:
+            return
+        missing = self._missing_statics(module_funcs[wrapped], bound,
+                                        _static_names(call))
+        if missing:
+            self._static_finding(out, sf, call.lineno, wrapped, missing)
+
+    def _check_decorated_static(self, out: List[Finding], sf: SourceFile,
+                                fn: ast.FunctionDef,
+                                dec: ast.Call) -> None:
+        missing = self._missing_statics(fn, set(), _static_names(dec))
+        if missing:
+            self._static_finding(out, sf, fn.lineno, fn.name, missing)
+
+    def _static_finding(self, out, sf, line, fname, missing) -> None:
+        names = ", ".join(missing)
+        self.finding(
+            out, sf, line, "undeclared-static-arg",
+            f"{fname}:{names}",
+            f"keyword-only parameter(s) `{names}` of jitted `{fname}` "
+            f"are neither bound by functools.partial nor declared in "
+            f"static_argnames — a tracer leaks into shape arithmetic, "
+            f"or the value silently retraces per call")
+
+    # ------------------------------------------------------------ rule 3
+
+    def _check_mutable_closure(self, out: List[Finding], sf: SourceFile,
+                               traced: Set[str], module_funcs) -> None:
+        mutable: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Global):
+                mutable.update(node.names)
+        assigned_counts: Dict[str, int] = {}
+        for stmt in sf.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    assigned_counts[t.id] = assigned_counts.get(t.id,
+                                                                0) + 1
+        mutable |= {n for n, c in assigned_counts.items() if c > 1}
+        if not mutable:
+            return
+        for fname in sorted(traced):
+            fn = module_funcs[fname]
+            local = {a.arg for a in (fn.args.args + fn.args.kwonlyargs
+                                     + fn.args.posonlyargs)}
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.FunctionDef):
+                    local.update(a.arg for a in sub.args.args)
+            for sub in ast.walk(fn):
+                if (isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Load)
+                        and sub.id in mutable and sub.id not in local):
+                    self.finding(
+                        out, sf, sub.lineno, "mutable-closure",
+                        f"{fname}:{sub.id}",
+                        f"traced function `{fname}` reads mutable "
+                        f"module state `{sub.id}` — the value is baked "
+                        f"in at trace time and the compiled program "
+                        f"goes stale unless every write invalidates "
+                        f"the jit caches")
+
+    # ------------------------------------------------------------ rule 4
+
+    def _check_mosaic(self, out: List[Finding], sf: SourceFile,
+                      kernels: Set[str], module_funcs) -> None:
+        # Module roots whose .sum/.min/... are host-side calls, not
+        # array-method reductions (jnp/lax ARE flagged — they lower to
+        # the rejected reduce_* primitives like the method forms).
+        host_roots = {"np", "numpy", "math", "os", "functools",
+                      "builtins"}
+        for fname in sorted(kernels):
+            for sub in ast.walk(module_funcs[fname]):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if not isinstance(sub.func, ast.Attribute):
+                    continue  # bare min()/max() builtins: trace-time
+                leaf = sub.func.attr
+                if leaf not in _INT_REDUCES:
+                    continue
+                target = _dotted(sub.func) or f".{leaf}"
+                root = target.rsplit(".", 1)[0].split(".", 1)[0]
+                if root in host_roots:
+                    continue
+                hit = (target if root in ("jnp", "jax", "lax")
+                       else f".{leaf}")
+                if hit:
+                    self.finding(
+                        out, sf, sub.lineno, "mosaic-int-reduce",
+                        f"{fname}:{hit}",
+                        f"`{hit}(...)` inside Pallas kernel `{fname}` — "
+                        f"the installed Mosaic lowering rejects integer "
+                        f"reduce_* primitives on hardware (PR 6); use "
+                        f"the halving-tree folds core.tree_sum/"
+                        f"tree_min/tree_max")

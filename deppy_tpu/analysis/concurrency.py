@@ -37,6 +37,7 @@ from pathlib import Path
 from typing import Dict, List, Optional, Set, Tuple
 
 from .core import Checker, Finding, SourceFile
+from .core import dotted as _dotted
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
                    "BoundedSemaphore", "make_lock", "make_rlock",
@@ -45,16 +46,6 @@ _MUTATOR_METHODS = {"append", "extend", "insert", "pop", "popleft",
                     "appendleft", "remove", "clear", "update",
                     "setdefault", "add", "discard"}
 
-
-def _dotted(node: ast.AST) -> Optional[str]:
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
 
 
 def _is_lock_ctor(node: ast.AST) -> bool:

@@ -31,6 +31,19 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 SUPPRESS_RE = re.compile(r"#\s*deppy:\s*lint-ok\[([a-z*\-]+)\]")
 
 
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None — the one AST
+    helper every checker needs (shared here so a fix lands once)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
 def repo_root() -> Path:
     """The checkout root: the parent of the ``deppy_tpu`` package."""
     return Path(__file__).resolve().parent.parent.parent
@@ -89,10 +102,58 @@ class SourceFile:
             sf.parse_error = str(e)
         return sf
 
+    _anchor_map: Optional[Dict[int, int]] = None
+
+    def _anchors(self) -> Dict[int, int]:
+        """line -> anchor line for findings attributed mid-statement.
+
+        Two cases (ISSUE 8 satellite — the pre-span rule only looked at
+        the flagged line and the one above, so a suppression on a
+        multi-line statement's first line missed findings attributed to
+        its continuation lines, and one on a ``def`` line missed
+        findings on its decorator lines):
+
+          * a **simple multi-line statement** (call, assignment,
+            return, ...): every continuation line anchors to the
+            statement's first line — compound statements (``if``/
+            ``with``/``for``/``def`` bodies) deliberately do NOT
+            anchor, a suppression on an ``if`` must not blanket its
+            whole body;
+          * a **decorated def/class**: every decorator line anchors to
+            the ``def``/``class`` line (checkers attribute decorator
+            hazards to the decorator expression's own line).
+        """
+        if self._anchor_map is None:
+            anchors: Dict[int, int] = {}
+            simple = (ast.Expr, ast.Assign, ast.AugAssign, ast.AnnAssign,
+                      ast.Return, ast.Assert, ast.Raise, ast.Delete)
+            if self.tree is not None:
+                for node in ast.walk(self.tree):
+                    if isinstance(node, simple):
+                        end = getattr(node, "end_lineno", node.lineno)
+                        for ln in range(node.lineno + 1, end + 1):
+                            anchors.setdefault(ln, node.lineno)
+                    elif isinstance(node, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.ClassDef)):
+                        for dec in node.decorator_list:
+                            end = getattr(dec, "end_lineno", dec.lineno)
+                            for ln in range(dec.lineno, end + 1):
+                                anchors[ln] = node.lineno
+            self._anchor_map = anchors
+        return self._anchor_map
+
     def suppressed(self, line: int, checker: str) -> bool:
-        """True when ``line`` (1-based) or the line above carries a
-        ``# deppy: lint-ok[checker]`` (or ``[*]``) comment."""
-        for ln in (line, line - 1):
+        """True when ``line`` (1-based), the line above it, or the
+        line's statement anchor (first line of a multi-line simple
+        statement; the ``def`` line for decorator lines — see
+        :meth:`_anchors`) carries a ``# deppy: lint-ok[checker]`` (or
+        ``[*]``) comment."""
+        candidates = [line, line - 1]
+        anchor = self._anchors().get(line)
+        if anchor is not None and anchor != line:
+            candidates += [anchor, anchor - 1]
+        for ln in candidates:
             if 1 <= ln <= len(self.lines):
                 for m in SUPPRESS_RE.finditer(self.lines[ln - 1]):
                     if m.group(1) in (checker, "*"):
@@ -103,10 +164,17 @@ class SourceFile:
 class Checker:
     """Base: subclasses set ``name``/``default_scope`` and implement
     ``check``.  ``default_scope`` is a list of repo-relative glob
-    prefixes the checker runs over when the CLI is given none."""
+    prefixes the checker runs over when the CLI is given none.
+
+    ``partial`` is set by the runner on ``--changed`` runs (the file
+    set is a git-diff subset, not the whole scope): checkers whose
+    reverse-direction rules need the full tree (declared-but-unused
+    knobs, stale fault points, flag mirrors) must skip those when it
+    is True — a subset scan proves presence, never absence."""
 
     name = "checker"
     default_scope: Tuple[str, ...] = ("deppy_tpu",)
+    partial = False
 
     def check(self, files: List[SourceFile], root: Path) -> List[Finding]:
         raise NotImplementedError
@@ -198,7 +266,8 @@ def checker_registry() -> Dict[str, Callable[[], Checker]]:
     # Local imports: each checker module is tiny, but keeping the
     # registry lazy means a syntax error in one checker doesn't take
     # down `deppy lint --checker <other>`.
-    from . import concurrency, exceptions, purity, registry_sync
+    from . import (block_contract, compile_surface, concurrency,
+                   exceptions, purity, registry_sync)
 
     return {
         purity.TracePurityChecker.name: purity.TracePurityChecker,
@@ -208,17 +277,52 @@ def checker_registry() -> Dict[str, Callable[[], Checker]]:
             registry_sync.RegistrySyncChecker,
         exceptions.ExceptionHygieneChecker.name:
             exceptions.ExceptionHygieneChecker,
+        compile_surface.CompileSurfaceChecker.name:
+            compile_surface.CompileSurfaceChecker,
+        block_contract.BlockContractChecker.name:
+            block_contract.BlockContractChecker,
     }
 
 
 CHECKERS = ("trace-purity", "concurrency-discipline", "registry-sync",
-            "exception-hygiene")
+            "exception-hygiene", "compile-surface", "block-contract")
+
+
+def changed_files(root: Path, base: str = "HEAD") -> List[str]:
+    """Repo-relative paths changed vs ``base`` (``git diff
+    --name-only`` plus untracked files): the ``deppy lint --changed``
+    fast-mode file set.  Raises ``RuntimeError`` when git is absent or
+    the ref is unknown — fast mode must fail loudly, not silently lint
+    nothing."""
+    import subprocess
+
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", base, "--"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise RuntimeError(f"cannot run git for --changed: {e}") from e
+    if diff.returncode != 0:
+        raise RuntimeError(
+            f"git diff --name-only {base} failed: "
+            f"{diff.stderr.strip() or diff.returncode}")
+    names = set(diff.stdout.splitlines())
+    if untracked.returncode == 0:
+        names.update(untracked.stdout.splitlines())
+    return sorted(n.strip() for n in names if n.strip())
 
 
 def run_checkers(root: Optional[Path] = None,
-                 names: Optional[Iterable[str]] = None) -> List[Finding]:
+                 names: Optional[Iterable[str]] = None,
+                 paths: Optional[Iterable[str]] = None) -> List[Finding]:
     """Run the named checkers (default all) over the repo; returns
-    findings sorted by path/line for stable output."""
+    findings sorted by path/line for stable output.  ``paths`` (repo-
+    relative) restricts every checker to that file subset — the
+    ``--changed`` fast mode; checkers see ``partial=True`` and skip
+    their reverse-direction (absence-proving) rules."""
     root = root or repo_root()
     registry = checker_registry()
     wanted = list(names) if names else list(registry)
@@ -226,12 +330,19 @@ def run_checkers(root: Optional[Path] = None,
     if unknown:
         raise ValueError(f"unknown checker(s) {unknown}; "
                          f"have {sorted(registry)}")
+    wanted_paths = None
+    if paths is not None:
+        wanted_paths = {str(p).replace("\\", "/") for p in paths}
     findings: List[Finding] = []
     cache: Dict[Path, SourceFile] = {}
     for name in wanted:
         checker = registry[name]()
+        checker.partial = wanted_paths is not None
         files = []
         for path in _iter_py_files(root, checker.default_scope):
+            rel = path.relative_to(root).as_posix()
+            if wanted_paths is not None and rel not in wanted_paths:
+                continue
             sf = cache.get(path)
             if sf is None:
                 sf = cache[path] = SourceFile.load(path, root)

@@ -34,6 +34,7 @@ from pathlib import Path
 from typing import Dict, List, Optional, Set
 
 from .core import Checker, Finding, SourceFile
+from .core import dotted as _dotted
 
 # Call spellings that make their function argument(s) traced code.
 _TRACING_WRAPPERS = {
@@ -71,17 +72,6 @@ _HOST_CALLS = {
 # Method names that force a device→host sync on whatever they hang off.
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 
-
-def _dotted(node: ast.AST) -> Optional[str]:
-    """``a.b.c`` for a Name/Attribute chain, else None."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
 
 
 def _func_names_in(node: ast.AST, known: Set[str]) -> Set[str]:

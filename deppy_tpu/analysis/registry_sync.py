@@ -37,22 +37,14 @@ from pathlib import Path
 from typing import Dict, List, Set
 
 from .core import Checker, Finding, SourceFile
+from .core import dotted as _dotted
 
 _ENV_TOKEN = re.compile(r"DEPPY_TPU_[A-Z0-9_]+")
+_RE_CAMEL = re.compile(r"(?<!^)([A-Z])")  # camelCase -> snake boundary
 # Builtin / plugin markers that need no registration.
 _BUILTIN_MARKS = {"skip", "skipif", "xfail", "parametrize",
                   "usefixtures", "filterwarnings", "timeout"}
 
-
-def _dotted(node: ast.AST):
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
 
 
 class RegistrySyncChecker(Checker):
@@ -60,12 +52,17 @@ class RegistrySyncChecker(Checker):
     default_scope = ("deppy_tpu", "scripts", "tests", "bench.py",
                      "__graft_entry__.py")
 
+    def __init__(self, mirror_registry=None):
+        # Tests seed a small registry; production uses the real one.
+        self._mirror_registry = mirror_registry
+
     def check(self, files: List[SourceFile], root: Path) -> List[Finding]:
         out: List[Finding] = []
         self._check_env(out, files)
         self._check_fault_points(out, files)
         self._check_families(out, files)
         self._check_markers(out, files, root)
+        self._check_mirrors(out, files)
         return out
 
     # ------------------------------------------------------------ env vars
@@ -91,6 +88,8 @@ class RegistrySyncChecker(Checker):
                             f"deppy_tpu.config.REGISTRY — declare it "
                             f"(type, default, consumer, help) or fix "
                             f"the name")
+        if self.partial:
+            return  # a subset scan cannot prove a knob is unused
         for name in sorted(set(config.REGISTRY) - mentioned):
             # Anchor registry-side findings on the registry file.
             reg_sf = next((f for f in files
@@ -135,6 +134,8 @@ class RegistrySyncChecker(Checker):
                             f"fault point `{point}` is not registered "
                             f"in faults.inject.KNOWN_POINTS — plans "
                             f"written against it cannot be validated")
+        if self.partial:
+            return  # a subset scan cannot prove a point is stale
         inj_sf = next((f for f in files
                        if f.rel == "deppy_tpu/faults/inject.py"), None)
         for point in sorted(known - injected):
@@ -236,6 +237,160 @@ class RegistrySyncChecker(Checker):
                         f"pyproject.toml [tool.pytest.ini_options] "
                         f"markers — it silently drops out of -m tier "
                         f"selection")
+
+    # ------------------------------------------------------------ mirrors
+
+    def _check_mirrors(self, out: List[Finding],
+                       files: List[SourceFile]) -> None:
+        """CLI flag <-> env var <-> config-file key, pinned both ways
+        (ISSUE 8 satellite).  The registry declares each knob's mirrors
+        (``EnvVar.flag`` / ``EnvVar.config_key``); ``deppy_tpu/cli.py``
+        carries the actual ``add_argument`` flags and the
+        ``_CONFIG_KEYS`` dict.  Drift in either direction is a finding:
+
+          * ``missing-flag-mirror`` / ``missing-config-key`` — the
+            registry declares a mirror cli.py no longer has;
+          * ``undeclared-flag-mirror`` — an ``add_argument`` whose help
+            names a ``DEPPY_TPU_*`` knob ("also via ..."), but the
+            knob's declaration does not name that flag back;
+          * ``undeclared-config-key`` — a ``_CONFIG_KEYS`` entry whose
+            serve kwarg matches a flag-mirrored knob, with no
+            ``config_key`` declared for it.
+        """
+        from .. import config
+
+        registry = (self._mirror_registry if self._mirror_registry
+                    is not None else config.REGISTRY)
+
+        cli_sf = next((f for f in files
+                       if f.rel == "deppy_tpu/cli.py"), None)
+        if cli_sf is None:
+            # --changed run that did not touch cli.py: presence can't
+            # be proven from a subset, and absence findings would all
+            # be false.
+            return
+
+        flags: Dict[str, int] = {}          # --flag -> line
+        flag_envs: Dict[str, Set[str]] = {}  # --flag -> env names in help
+        for node in ast.walk(cli_sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("--")):
+                continue
+            flag = node.args[0].value
+            flags[flag] = node.lineno
+            help_text = ""
+            for kw in node.keywords:
+                if kw.arg == "help":
+                    help_text = "".join(
+                        sub.value for sub in ast.walk(kw.value)
+                        if isinstance(sub, ast.Constant)
+                        and isinstance(sub.value, str))
+            # Only the "also via <ENV>" convention marks a MIRROR; a
+            # help string merely mentioning a knob (trace --file's
+            # "default: $DEPPY_TPU_TELEMETRY_FILE") is not one.
+            envs: Set[str] = set()
+            for seg in help_text.split("also via")[1:]:
+                envs.update(m.group(0)
+                            for m in _ENV_TOKEN.finditer(seg)
+                            if not m.group(0).endswith("_"))
+            flag_envs[flag] = envs
+
+        config_keys: Dict[str, int] = {}
+        for node in ast.walk(cli_sf.tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "_CONFIG_KEYS"
+                            for t in node.targets)
+                    and isinstance(node.value, ast.Dict)):
+                for key in node.value.keys:
+                    if (isinstance(key, ast.Constant)
+                            and isinstance(key.value, str)):
+                        config_keys[key.value] = key.lineno
+
+        declared_flags = {v.flag: v.name
+                          for v in registry.values() if v.flag}
+        declared_keys = {v.config_key: v.name
+                         for v in registry.values()
+                         if v.config_key}
+
+        # Registry -> cli.py direction.
+        reg_sf = next((f for f in files
+                       if f.rel == "deppy_tpu/config.py"), None)
+
+        def _reg_line(token: str) -> int:
+            if reg_sf is None:
+                return 1
+            return next((i for i, text in enumerate(reg_sf.lines, 1)
+                         if token in text), 1)
+
+        anchor = reg_sf or cli_sf
+        for flag, env in sorted(declared_flags.items()):
+            if flag not in flags:
+                self.finding(
+                    out, anchor, _reg_line(env), "missing-flag-mirror",
+                    f"{env}:{flag}",
+                    f"`{env}` declares CLI mirror `{flag}` but cli.py "
+                    f"has no such add_argument — the flag was removed "
+                    f"or renamed without updating the registry")
+        for key, env in sorted(declared_keys.items()):
+            if key not in config_keys:
+                self.finding(
+                    out, anchor, _reg_line(env), "missing-config-key",
+                    f"{env}:{key}",
+                    f"`{env}` declares config-file mirror `{key}` but "
+                    f"cli.py's _CONFIG_KEYS has no such entry")
+
+        # cli.py -> registry direction: the "also via <env knob>"
+        # help convention must be declared back.
+        for flag, envs in sorted(flag_envs.items()):
+            for env in sorted(envs):
+                if env not in registry:
+                    continue  # undeclared-env already fired
+                if registry[env].flag != flag:
+                    self.finding(
+                        out, cli_sf, flags[flag],
+                        "undeclared-flag-mirror", f"{flag}:{env}",
+                        f"`{flag}`'s help names `{env}` but the knob's "
+                        f"registry declaration does not name "
+                        f"`{flag}` as its flag mirror — declare "
+                        f"flag=\"{flag}\" on the EnvVar (or fix the "
+                        f"help text)")
+        # _CONFIG_KEYS -> registry: a camelCase key whose snake-cased
+        # form matches a declared knob must be declared back as that
+        # knob's config_key.  Matching is by shared prefix either way
+        # (longest declared knob wins), not exact reconstruction —
+        # `requestDeadlineSeconds` must find DEPPY_TPU_REQUEST_
+        # DEADLINE_S even though the spellings differ.  Keys with no
+        # env twin (bindAddress, backend) are legitimately
+        # registry-free.
+        knob_roots = {name[len("DEPPY_TPU_"):]: name
+                      for name in registry}
+        for key, line in sorted(config_keys.items()):
+            snake = _RE_CAMEL.sub(r"_\1", key).upper()
+            env = None
+            for root_name in sorted(knob_roots, key=len, reverse=True):
+                # Exact, or the key extends the knob (SECONDS vs _S
+                # suffix drift); a short key must NOT claim a longer
+                # knob (`sched` is not `SCHED_MAX_WAIT_MS`'s key).
+                if snake == root_name or (len(root_name) > 4
+                                          and snake.startswith(
+                                              root_name)):
+                    env = knob_roots[root_name]
+                    break
+            if env is None:
+                continue
+            if registry[env].config_key != key:
+                self.finding(
+                    out, cli_sf, line, "undeclared-config-key",
+                    f"{key}:{env}",
+                    f"config key `{key}` mirrors `{env}` but the "
+                    f"knob's registry declaration does not name it — "
+                    f"declare config_key=\"{key}\" on the EnvVar")
 
     @staticmethod
     def _mark_name(node: ast.AST):

@@ -74,6 +74,8 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
     # in their record, not ~0 measured after the fact.
     probe_s = probe_wall_s()
 
+    from ..analysis import compileguard
+
     sample = problems[: min(host_sample, n)]
     t_start = time.perf_counter()
     pass_times = []
@@ -95,6 +97,7 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
     host_s = min(pass_times)
     log(f"host: {host_s * 1e3:.2f} ms/problem ({1.0 / host_s:.1f}/s serial)")
 
+    compiles_before = compileguard.trace_count()
     t0 = time.perf_counter()
     dispatch()  # includes compile
     warm_s = time.perf_counter() - t0
@@ -144,6 +147,12 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
         # MULTICHIP/BENCH round tracks.
         "n_devices": n_devices,
         "per_device_rate": rate / n_devices,
+        # Compile-guard ledger delta across warm-up + timed dispatches
+        # (ISSUE 8): how many jit-entry traces the measured section
+        # paid.  The warm-up should absorb them all — a nonzero count
+        # beyond it in later rounds is the compile-storm tell the
+        # runtime guard asserts on under DEPPY_TPU_COMPILE_GUARD=1.
+        "n_compiles": compileguard.trace_count() - compiles_before,
         "sat": n_sat,
         "unsat": n_unsat,
     }

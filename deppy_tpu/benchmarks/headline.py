@@ -85,6 +85,9 @@ def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
         # dispatch sharded over + throughput per device.
         "n_devices": m["n_devices"],
         "per_device_rate": round(m["per_device_rate"], 2),
+        # Compile-guard ledger delta over warm-up + timed dispatches
+        # (ISSUE 8): how many jit-entry traces the record paid.
+        "n_compiles": m["n_compiles"],
     }
     if "telemetry" in m:
         # Occupancy and fallback columns ride in every BENCH row (ISSUE

@@ -126,6 +126,9 @@ def _bench_config(cfg: Dict, host_sample: int = 16) -> Dict:
         # warm-up, so probe/retry stalls are visible in the JSON.
         "probe_wall_s": round(m["probe_wall_s"], 3),
         "warmup_seconds": round(m["warmup_seconds"], 3),
+        # Compile-guard ledger delta (ISSUE 8): jit-entry traces paid
+        # by this config's warm-up + timed dispatches.
+        "n_compiles": m["n_compiles"],
         "sat": m["sat"],
         "unsat": m["unsat"],
     }

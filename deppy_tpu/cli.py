@@ -253,15 +253,24 @@ def _build_parser() -> argparse.ArgumentParser:
     p_lint = sub.add_parser(
         "lint",
         help="run the static-analysis checkers (trace-purity, "
-        "concurrency-discipline, registry-sync, exception-hygiene) "
-        "and fail on findings not in analysis/baseline.json (see "
-        "docs/analysis.md)",
+        "concurrency-discipline, registry-sync, exception-hygiene, "
+        "compile-surface, block-contract) and fail on findings not in "
+        "analysis/baseline.json (see docs/analysis.md)",
     )
     p_lint.add_argument(
         "--checker", action="append", default=None, metavar="NAME",
         help="run only the named checker (repeatable; default: all of "
         "trace-purity, concurrency-discipline, registry-sync, "
-        "exception-hygiene)")
+        "exception-hygiene, compile-surface, block-contract)")
+    p_lint.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None,
+        metavar="BASE",
+        help="fast mode: restrict the checkers to files changed vs "
+        "BASE (`git diff --name-only BASE` + untracked; default "
+        "HEAD).  Reverse-direction rules that must prove absence "
+        "(unused knobs, stale fault points, flag mirrors) are skipped "
+        "— run the full lint before merging (make lint-fast / make "
+        "lint)")
     p_lint.add_argument(
         "--json", action="store_true",
         help="emit the findings (and the new-vs-baseline split) as one "
@@ -286,6 +295,28 @@ def _build_parser() -> argparse.ArgumentParser:
         "--strict-baseline", action="store_true",
         help="also fail when the baseline carries stale keys for "
         "findings that no longer exist (keeps burn-down honest)")
+
+    p_compiles = sub.add_parser(
+        "compiles",
+        help="summarize compile-guard trace/retrace counts per jit "
+        "entry from a telemetry JSONL sink (events recorded under "
+        "DEPPY_TPU_COMPILE_GUARD=1; see docs/analysis.md), or print "
+        "the static jit-surface registry with --surface",
+    )
+    p_compiles.add_argument(
+        "file", nargs="?", default=None,
+        help="telemetry JSONL file (default: $DEPPY_TPU_TELEMETRY_FILE)",
+    )
+    p_compiles.add_argument(
+        "--output", choices=["text", "json"], default="text",
+        help="output format (default: text)",
+    )
+    p_compiles.add_argument(
+        "--surface", action="store_true",
+        help="print the STATIC jit-surface registry (every jit/pjit/"
+        "shard_map/pallas_call construction, with memo and "
+        "compile-guard status) instead of reading a sink",
+    )
 
     p_doctor = sub.add_parser(
         "doctor",
@@ -754,6 +785,97 @@ def _cmd_trace(args) -> int:
     return 0
 
 
+def _cmd_compiles(args) -> int:
+    """Summarize ``compileguard`` events from a telemetry JSONL sink:
+    per jit entry, total traces, distinct abstract signatures, retraces
+    (traces beyond the first per signature), trace wall time, and any
+    retrace-budget violations — the offline view of a compile storm.
+    ``--surface`` instead prints the static jit-surface registry the
+    ``compile-surface`` checker builds (no sink needed)."""
+    if args.surface:
+        from .analysis.compile_surface import jit_surface
+
+        entries = jit_surface()
+        if args.output == "json":
+            json.dump({"entries": [e.to_dict() for e in entries]},
+                      sys.stdout, indent=2)
+            print()
+            return 0
+        width = max((len(f"{e.path}:{e.line}") for e in entries),
+                    default=4)
+        print(f"{'site'.ljust(width)}  {'kind'.ljust(11)}  "
+              f"{'memo':>4}  {'guard':>5}  name")
+        for e in entries:
+            site = f"{e.path}:{e.line}"
+            print(f"{site.ljust(width)}  {e.kind.ljust(11)}  "
+                  f"{'yes' if e.memoized else '-':>4}  "
+                  f"{'yes' if e.observed else '-':>5}  {e.name}")
+        return 0
+
+    from . import config
+
+    path = args.file or config.env_raw("DEPPY_TPU_TELEMETRY_FILE")
+    if not path:
+        print("error: no telemetry file (pass FILE or set "
+              "DEPPY_TPU_TELEMETRY_FILE)", file=sys.stderr)
+        return 2
+    per_entry: dict = {}
+    violations = []
+    try:
+        for ev in _iter_sink_events(path):
+            if ev is None or ev.get("kind") != "compileguard":
+                continue
+            entry = ev.get("entry", "?")
+            agg = per_entry.setdefault(
+                entry, {"traces": 0, "signatures": set(),
+                        "retraces": 0, "trace_s": 0.0})
+            if ev.get("violation"):
+                violations.append(ev)
+                continue
+            agg["traces"] += 1
+            sig = ev.get("signature")
+            if sig in agg["signatures"]:
+                agg["retraces"] += 1
+            elif sig is not None:
+                agg["signatures"].add(sig)
+            try:
+                agg["trace_s"] += float(ev.get("dur_s", 0.0))
+            except (TypeError, ValueError):
+                pass
+    except FileNotFoundError:
+        print(f"error: no such file: {path}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+
+    for agg in per_entry.values():
+        agg["signatures"] = len(agg["signatures"])
+
+    if args.output == "json":
+        json.dump({"entries": per_entry, "violations": violations},
+                  sys.stdout, indent=2, sort_keys=True, default=str)
+        print()
+        return 0
+    if not per_entry:
+        print(f"no compileguard events in {path} (arm with "
+              f"DEPPY_TPU_COMPILE_GUARD=1 and a telemetry sink)")
+        return 0
+    width = max(len(n) for n in per_entry)
+    print(f"{'entry'.ljust(width)}  {'traces':>7}  {'sigs':>5}  "
+          f"{'retraces':>8}  {'trace_s':>8}")
+    for name in sorted(per_entry):
+        agg = per_entry[name]
+        print(f"{name.ljust(width)}  {agg['traces']:>7}  "
+              f"{agg['signatures']:>5}  {agg['retraces']:>8}  "
+              f"{agg['trace_s']:>8.3f}")
+    for v in violations:
+        print(f"VIOLATION {v.get('entry')}: signature traced "
+              f"{v.get('n_trace')} times (budget {v.get('budget')}) "
+              f"at {v.get('site')}")
+    return 0
+
+
 def _cmd_serve(args) -> int:
     from .service import serve
 
@@ -834,6 +956,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_stats(args)
     if args.command == "trace":
         return _cmd_trace(args)
+    if args.command == "compiles":
+        return _cmd_compiles(args)
     if args.command == "lint":
         from .analysis.cli import run_lint
 

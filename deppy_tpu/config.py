@@ -44,18 +44,27 @@ class UndeclaredEnvVar(KeyError):
 
 @dataclass(frozen=True)
 class EnvVar:
-    """One declared environment knob."""
+    """One declared environment knob.  ``flag`` / ``config_key`` declare
+    the knob's CLI-flag and ResolverConfig-file mirrors (ISSUE 8): the
+    ``registry-sync`` checker pins them against ``deppy_tpu/cli.py``
+    both ways, so a flag added without its env mirror (or a mirror
+    declared here without its flag) is a lint finding."""
 
     name: str
     type: str       # "int" | "float" | "str" | "bool" | "path"
     default: object  # documented default; None = unset/off
     consumer: str   # primary reading module (dotted path)
     help: str
+    flag: Optional[str] = None        # mirrored CLI flag (--foo-bar)
+    config_key: Optional[str] = None  # mirrored ResolverConfig file key
 
 
-def _v(name: str, type: str, default, consumer: str, help: str) -> EnvVar:
+def _v(name: str, type: str, default, consumer: str, help: str,
+       flag: Optional[str] = None,
+       config_key: Optional[str] = None) -> EnvVar:
     return EnvVar(name=name, type=type, default=default,
-                  consumer=consumer, help=help)
+                  consumer=consumer, help=help, flag=flag,
+                  config_key=config_key)
 
 
 # Declaration order groups by subsystem; rendering sorts by name so the
@@ -64,7 +73,8 @@ _DECLARATIONS: List[EnvVar] = [
     # --- telemetry -------------------------------------------------------
     _v("DEPPY_TPU_TELEMETRY_FILE", "path", None, "deppy_tpu.telemetry.registry",
        "JSONL event sink for spans/reports/fault events (also "
-       "--telemetry-file); summarize with `deppy stats`."),
+       "--telemetry-file); summarize with `deppy stats`.",
+       flag="--telemetry-file"),
     _v("DEPPY_TPU_TRACE_RING", "int", 64, "deppy_tpu.telemetry.trace",
        "Flight-recorder capacity: recent completed request traces."),
     _v("DEPPY_TPU_TRACE_ERROR_RING", "int", 256, "deppy_tpu.telemetry.trace",
@@ -73,7 +83,8 @@ _DECLARATIONS: List[EnvVar] = [
     # --- faults ----------------------------------------------------------
     _v("DEPPY_TPU_FAULT_PLAN", "str", None, "deppy_tpu.faults.inject",
        "Fault-injection plan: inline JSON, @FILE, or a file path (also "
-       "--fault-plan); see docs/robustness.md."),
+       "--fault-plan); see docs/robustness.md.",
+       flag="--fault-plan"),
     _v("DEPPY_TPU_FAULT_RETRIES", "int", 2, "deppy_tpu.faults.policy",
        "Total attempts per device dispatch group (2 = one retry)."),
     _v("DEPPY_TPU_FAULT_BACKOFF_S", "float", 0.05, "deppy_tpu.faults.policy",
@@ -87,7 +98,8 @@ _DECLARATIONS: List[EnvVar] = [
     _v("DEPPY_TPU_BATCH_DEADLINE_S", "float", None, "deppy_tpu.faults.policy",
        "Ambient wall-clock budget for a whole resolve batch (also "
        "--deadline / X-Deppy-Deadline-S); expiry degrades undispatched "
-       "lanes to Incomplete."),
+       "lanes to Incomplete.",
+       flag="--deadline"),
     _v("DEPPY_TPU_BREAKER_THRESHOLD", "int", 3, "deppy_tpu.faults.breaker",
        "Consecutive device failures that trip the accelerator circuit "
        "breaker open (host-only serving)."),
@@ -96,14 +108,17 @@ _DECLARATIONS: List[EnvVar] = [
     # --- scheduler / cache ----------------------------------------------
     _v("DEPPY_TPU_SCHED", "str", "on", "deppy_tpu.service",
        "Cross-request continuous-batching scheduler ('off' restores "
-       "byte-identical per-request dispatch; also --sched)."),
+       "byte-identical per-request dispatch; also --sched).",
+       flag="--sched", config_key="sched"),
     _v("DEPPY_TPU_SCHED_MAX_WAIT_MS", "float", 5.0,
        "deppy_tpu.sched.scheduler",
        "Flush policy: max milliseconds the oldest queued problem waits "
-       "for batchmates (also --sched-max-wait-ms)."),
+       "for batchmates (also --sched-max-wait-ms).",
+       flag="--sched-max-wait-ms", config_key="schedMaxWaitMs"),
     _v("DEPPY_TPU_SCHED_MAX_FILL", "int", 256, "deppy_tpu.sched.scheduler",
        "Flush policy: dispatch once a size class has this many lanes "
-       "queued (also --sched-max-fill)."),
+       "queued (also --sched-max-fill).",
+       flag="--sched-max-fill", config_key="schedMaxFill"),
     _v("DEPPY_TPU_SCHED_MAX_DEPTH", "int", 4096, "deppy_tpu.sched.scheduler",
        "Queue depth past which admission returns 503 + Retry-After "
        "(0 = unbounded)."),
@@ -113,11 +128,13 @@ _DECLARATIONS: List[EnvVar] = [
        "so every device gets a full shard."),
     _v("DEPPY_TPU_CACHE_SIZE", "int", 1024, "deppy_tpu.sched.scheduler",
        "Canonical-form result-cache capacity in entries (0 disables; "
-       "also --cache-size)."),
+       "also --cache-size).",
+       flag="--cache-size", config_key="cacheSize"),
     # --- service ---------------------------------------------------------
     _v("DEPPY_TPU_REQUEST_DEADLINE_S", "float", None, "deppy_tpu.service",
        "Default wall-clock budget per /v1/resolve request (clients "
-       "override via X-Deppy-Deadline-S; also --request-deadline)."),
+       "override via X-Deppy-Deadline-S; also --request-deadline).",
+       flag="--request-deadline", config_key="requestDeadlineSeconds"),
     _v("DEPPY_TPU_DRAIN_S", "float", None, "deppy_tpu.service",
        "Graceful-shutdown bound on draining in-flight requests "
        "(default: the request deadline, else 10s)."),
@@ -127,7 +144,8 @@ _DECLARATIONS: List[EnvVar] = [
     # --- hostpool --------------------------------------------------------
     _v("DEPPY_TPU_HOST_WORKERS", "int", None, "deppy_tpu.hostpool.pool",
        "Host-engine worker pool size (default min(cpu_count, 8); 0 = "
-       "inline serial engine; also --host-workers)."),
+       "inline serial engine; also --host-workers).",
+       flag="--host-workers", config_key="hostWorkers"),
     _v("DEPPY_TPU_HOST_WORKER_RECYCLE", "int", 256,
        "deppy_tpu.hostpool.pool",
        "Solves per worker before it is retired and replaced (leak "
@@ -143,7 +161,8 @@ _DECLARATIONS: List[EnvVar] = [
     _v("DEPPY_TPU_MESH_DEVICES", "int", None, "deppy_tpu.parallel.mesh",
        "Shard each coalesced micro-batch across N devices ('all'/-1 = "
        "every local device; unset/0/1 = single-device dispatch; also "
-       "--mesh-devices)."),
+       "--mesh-devices).",
+       flag="--mesh-devices", config_key="meshDevices"),
     # --- engine ----------------------------------------------------------
     _v("DEPPY_TPU_MAX_LANES", "int", 512, "deppy_tpu.engine.driver",
        "Per-dispatch lane cap; oversized programs crash the tunneled "
@@ -191,6 +210,17 @@ _DECLARATIONS: List[EnvVar] = [
        "Runtime lock-order assertion mode: named locks track "
        "acquisition order per thread, raise on lock-order inversions "
        "and self-deadlocks, and emit `lockdep` telemetry events."),
+    _v("DEPPY_TPU_COMPILE_GUARD", "bool", False,
+       "deppy_tpu.analysis.compileguard",
+       "Runtime compile-guard mode: every registered jit entry's "
+       "trace/compile is emitted as a `compileguard` telemetry event, "
+       "and retracing one abstract signature past the entry's budget "
+       "raises CompileGuardError (summarize with `deppy compiles`)."),
+    _v("DEPPY_TPU_COMPILE_BUDGET", "int", None,
+       "deppy_tpu.analysis.compileguard",
+       "Per-signature trace budget for compile-guarded jit entries "
+       "(default: 2 x local device count — per-device placement keys "
+       "jit's cache once per device)."),
 ]
 
 REGISTRY: "dict[str, EnvVar]" = {v.name: v for v in _DECLARATIONS}
@@ -303,16 +333,20 @@ def render_markdown() -> str:
         "registry in `deppy_tpu/config.py`.  The `registry-sync` checker",
         "(`deppy lint`) fails on any knob read in code but missing here,",
         "and `tests/test_doc_sync.py` pins this file against the",
-        "registry both ways.",
+        "registry both ways.  The Mirrors column names the knob's",
+        "declared CLI-flag / ResolverConfig-key twins; `registry-sync`",
+        "pins those against `deppy_tpu/cli.py` both ways too.",
         "",
-        "| Name | Type | Default | Consumer | Description |",
-        "| --- | --- | --- | --- | --- |",
+        "| Name | Type | Default | Consumer | Mirrors | Description |",
+        "| --- | --- | --- | --- | --- | --- |",
     ]
     for name in sorted(REGISTRY):
         v = REGISTRY[name]
+        mirrors = " ".join(
+            f"`{m}`" for m in (v.flag, v.config_key) if m) or "—"
         lines.append(
             f"| `{v.name}` | {v.type} | `{_fmt_default(v)}` | "
-            f"`{v.consumer}` | {v.help} |")
+            f"`{v.consumer}` | {mirrors} | {v.help} |")
     lines.append("")
     return "\n".join(lines)
 

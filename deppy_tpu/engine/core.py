@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import config
+from ..analysis import compileguard
 
 # Assignment values (same convention as the host engine).
 TRUE = 1
@@ -601,6 +602,9 @@ def clear_batched_caches() -> None:
     batched_core.cache_clear()
     batched_minimize_gated.cache_clear()
     batched_core_gated.cache_clear()
+    # A deliberate drop means the recompiles that follow are expected:
+    # zero the compile-guard ledger so they don't read as a storm.
+    compileguard.reset_counts()
 
 
 def set_bcp_impl(name: str) -> None:
@@ -713,9 +717,9 @@ def _has_full_planes(pts, V: int) -> bool:
 
 
 def _resolved_impl() -> str:
-    if _BCP_IMPL == "auto":
-        return "bits"
-    return _BCP_IMPL
+    # deppy: lint-ok[compile-surface] trace-time impl dispatch by design: set_bcp_impl's write invalidates every compiled program via clear_batched_caches
+    impl = _BCP_IMPL
+    return "bits" if impl == "auto" else impl
 
 
 def _bcp_gather(pt: ProblemTensors, assign: jax.Array,
@@ -1569,7 +1573,9 @@ def batched_solve(V: int, NCON: int, NV: int, T: int = 0,
     host-routes core extraction for giant single problems)."""
     fn = functools.partial(solve_full, V=V, NCON=NCON, NV=NV, T=T,
                            with_core=with_core)
-    return jax.jit(jax.vmap(fn, in_axes=(0, None)))
+    return jax.jit(compileguard.observe(
+        "core.batched_solve", jax.vmap(fn, in_axes=(0, None)),
+        static=(V, NCON, NV, T, with_core)))
 
 
 @functools.lru_cache(maxsize=128)
@@ -1582,7 +1588,9 @@ def batched_search(V: int, NCON: int, NV: int, T: int = 0):
     red = phases_reduced()
     fn = functools.partial(search_phase, V=NV if red else V,
                            NCON=NCON, NV=NV, T=T, red=red)
-    xla_fn = jax.jit(jax.vmap(fn, in_axes=(0, None, 0)))
+    xla_fn = jax.jit(compileguard.observe(
+        "core.batched_search", jax.vmap(fn, in_axes=(0, None, 0)),
+        static=(V, NCON, NV, T, red)))
     if T == 0 and red and _resolved_search_impl() == "fused":
         from . import pallas_search
 
@@ -1602,7 +1610,9 @@ def batched_core(V: int, NCON: int, NV: int):
     deletion-sweep kernel (same dispatch rules as
     :func:`batched_search`)."""
     fn = functools.partial(core_phase, V=V, NCON=NCON, NV=NV)
-    xla_fn = jax.jit(jax.vmap(fn, in_axes=(0, None, 0, 0)))
+    xla_fn = jax.jit(compileguard.observe(
+        "core.batched_core", jax.vmap(fn, in_axes=(0, None, 0, 0)),
+        static=(V, NCON, NV)))
     if _resolved_search_impl() == "fused":
         from . import pallas_search
 
@@ -1655,7 +1665,9 @@ def batched_probe_fixpoint(V: int, NCON: int):
     """Jitted stage-1 probe batch: problem broadcast, drop indices
     vmapped."""
     fn = functools.partial(probe_fixpoint_phase, V=V, NCON=NCON)
-    return jax.jit(jax.vmap(fn, in_axes=(None, 0)))
+    return jax.jit(compileguard.observe(
+        "core.batched_probe_fixpoint", jax.vmap(fn, in_axes=(None, 0)),
+        static=(V, NCON)))
 
 
 def probe_phase(pt: ProblemTensors, act_enabled: jax.Array,
@@ -1678,7 +1690,9 @@ def probe_phase(pt: ProblemTensors, act_enabled: jax.Array,
 def batched_probe(V: int, NCON: int, NV: int):
     """Jitted stage-2 probe batch: problem broadcast, act masks vmapped."""
     fn = functools.partial(probe_phase, V=V, NCON=NCON, NV=NV)
-    return jax.jit(jax.vmap(fn, in_axes=(None, 0, None)))
+    return jax.jit(compileguard.observe(
+        "core.batched_probe", jax.vmap(fn, in_axes=(None, 0, None)),
+        static=(V, NCON, NV)))
 
 
 def _minimize_gated(pt, result, model, guessed, budget, steps, en_lanes,
@@ -1701,7 +1715,10 @@ def batched_minimize_gated(V: int, NCON: int, NV: int):
     red = phases_reduced()
     fn = functools.partial(_minimize_gated, V=NV if red else V,
                            NCON=NCON, NV=NV, red=red)
-    xla_fn = jax.jit(jax.vmap(fn, in_axes=(0, 0, 0, 0, None, 0, 0)))
+    xla_fn = jax.jit(compileguard.observe(
+        "core.batched_minimize_gated",
+        jax.vmap(fn, in_axes=(0, 0, 0, 0, None, 0, 0)),
+        static=(V, NCON, NV, red)))
     if red and _resolved_search_impl() == "fused":
         from . import pallas_search
 
@@ -1729,7 +1746,10 @@ def batched_core_gated(V: int, NCON: int, NV: int):
     everything for no lane savings.  Routes to the fused kernel under
     ``DEPPY_TPU_SEARCH=fused`` like :func:`batched_core`."""
     fn = functools.partial(_core_gated, V=V, NCON=NCON, NV=NV)
-    xla_fn = jax.jit(jax.vmap(fn, in_axes=(0, 0, None, 0, 0)))
+    xla_fn = jax.jit(compileguard.observe(
+        "core.batched_core_gated",
+        jax.vmap(fn, in_axes=(0, 0, None, 0, 0)),
+        static=(V, NCON, NV)))
     if _resolved_search_impl() == "fused":
         from . import pallas_search
 

@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from .. import config, faults, telemetry
+from ..analysis import compileguard
 from ..sat.constraints import Variable
 from ..sat.encode import Problem, encode
 from ..sat.errors import Incomplete, InternalSolverError, NotSatisfiable
@@ -336,10 +337,12 @@ _COMPACT_FIELDS = (
 
 @_functools.lru_cache(maxsize=128)
 def _planes_fn(Wv: int, Wr: int, red: bool, full: bool):
-    return jax.jit(
+    return jax.jit(compileguard.observe(
+        "driver.planes_fn",
         _functools.partial(core.derive_planes, Wv=Wv, Wr=Wr, red=red,
-                           full=full)
-    )
+                           full=full),
+        static=(Wv, Wr, red, full),
+    ))
 
 
 def _derive_planes(pts: core.ProblemTensors, d: _Dims,
@@ -1371,7 +1374,12 @@ def batched_solve_sharded(mesh, V: int, NCON: int, NV: int,
     )
     out_sh = core.SolveResult(
         *([s_lane] * len(core.SolveResult._fields)))
-    return jax.jit(vfn, in_shardings=in_sh, out_shardings=out_sh)
+    devices = tuple(d.id for d in mesh.devices.flat)
+    return jax.jit(
+        compileguard.observe(
+            "driver.batched_solve_sharded", vfn,
+            static=(devices, V, NCON, NV, trace_cap, with_core)),
+        in_shardings=in_sh, out_shardings=out_sh)
 
 
 @_functools.lru_cache(maxsize=64)

@@ -61,6 +61,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..analysis import compileguard
 from . import core
 
 WORD = core.WORD
@@ -574,9 +575,8 @@ def _min_kernel(en_ref, nx_ref, budget_ref, steps_ref,
     m2t_ref[0] = m2_t
 
 
-@jax.jit
-def _batched_minimize_fused(pts: core.ProblemTensors, result, model,
-                            guessed, budget, steps, en_lanes):
+def _minimize_fused_impl(pts: core.ProblemTensors, result, model,
+                         guessed, budget, steps, en_lanes):
     """Phase-2 minimization via the fused kernel — the drop-in twin of
     ``core.batched_minimize_gated(...)(pts, result, model, guessed,
     budget, steps, en)`` (reduced plane space)."""
@@ -641,6 +641,10 @@ def _batched_minimize_fused(pts: core.ProblemTensors, result, model,
     installed = (jax.vmap(lambda w: core.unpack_mask(w, NV))(m2_t)
                  & pv_mask & min_found[:, None] & en[:, None])[:, :NV]
     return installed, min_found, steps_out
+
+
+_batched_minimize_fused = jax.jit(compileguard.observe(
+    "pallas_search.batched_minimize_fused", _minimize_fused_impl))
 
 
 def batched_minimize_fused(pts, result, model, guessed, budget, steps,
@@ -753,9 +757,8 @@ def _core_kernel(en_ref, ncons_ref, nvars_ref, budget_ref, steps_ref,
     steps_out_ref[b, 0] = steps
 
 
-@functools.partial(jax.jit, static_argnames=("V", "NCON", "NV"))
-def _batched_core_fused(pts: core.ProblemTensors, budget, steps, en,
-                        *, V: int, NCON: int, NV: int):
+def _core_fused_impl(pts: core.ProblemTensors, budget, steps, en,
+                     *, V: int, NCON: int, NV: int):
     """Phase-3 core extraction via the fused kernel — the drop-in twin of
     ``core.batched_core(V, NCON, NV)(pts, budget, steps, en)``.  Reads
     the FULL-space planes (activation literals live)."""
@@ -806,6 +809,12 @@ def _batched_core_fused(pts: core.ProblemTensors, budget, steps, en,
     return core_out[:, 0, :] != 0, steps_out[:, 0]
 
 
+_batched_core_fused = jax.jit(
+    compileguard.observe("pallas_search.batched_core_fused",
+                         _core_fused_impl),
+    static_argnames=("V", "NCON", "NV"))
+
+
 def batched_core_fused(pts, budget, steps, en, *, V, NCON, NV):
     """Public entry for the fused phase-3 program (shape caps shared with
     the phase-1/2 kernels via :func:`fused_supported`; callers fall back
@@ -816,8 +825,7 @@ def batched_core_fused(pts, budget, steps, en, *, V, NCON, NV):
                                V=V, NCON=NCON, NV=NV)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _batched_search_fused(pts: core.ProblemTensors, budget, en):
+def _search_fused_impl(pts: core.ProblemTensors, budget, en):
     """Phase-1 search for a padded batch via the fused kernel — the drop-in
     twin of ``core.batched_search(...)(pts, budget, en)`` with T=0.
     Reduced plane space only (the search never disables activations;
@@ -899,6 +907,10 @@ def _batched_search_fused(pts: core.ProblemTensors, budget, en):
     result = jnp.where(en, result, jnp.int32(core.RUNNING))
     tr_stack = jnp.full((B, 0, NC + 1), -1, jnp.int32)
     return result, guessed, model, steps, tr_stack, tr_n
+
+
+_batched_search_fused = jax.jit(compileguard.observe(
+    "pallas_search.batched_search_fused", _search_fused_impl))
 
 
 def batched_search_fused(pts: core.ProblemTensors, budget, en):

@@ -32,6 +32,7 @@ from ..sat.constraints import Variable
 from ..sat.encode import Problem, encode
 from ..sat.errors import (BackendCapabilityError, Incomplete,
                           InternalSolverError, NotSatisfiable)
+from ..analysis import compileguard
 from ..engine import core, driver
 from ._compat import shard_map
 
@@ -82,13 +83,19 @@ def _sharded_fn(mesh: Mesh, V: int, NCON: int, NV: int,
     :class:`core.clause_axis` around invocations so those retraces pick
     up the collectives.  ``with_core=False`` compiles the deletion arm
     out (host-routed core extraction, driver.HOST_CORE_NCONS)."""
-    return jax.jit(shard_map(
-        functools.partial(core.solve_full, V=V, NCON=NCON, NV=NV,
-                          with_core=with_core),
-        mesh=mesh,
-        in_specs=(_specs(CLAUSE_AXIS), P()),
-        out_specs=core.SolveResult(*[P()] * len(core.SolveResult._fields)),
-        check_vma=False,
+    devices = tuple(d.id for d in mesh.devices.flat)
+    return jax.jit(compileguard.observe(
+        "clause_shard.sharded_fn",
+        shard_map(
+            functools.partial(core.solve_full, V=V, NCON=NCON, NV=NV,
+                              with_core=with_core),
+            mesh=mesh,
+            in_specs=(_specs(CLAUSE_AXIS), P()),
+            out_specs=core.SolveResult(
+                *[P()] * len(core.SolveResult._fields)),
+            check_vma=False,
+        ),
+        static=(devices, V, NCON, NV, with_core),
     ))
 
 

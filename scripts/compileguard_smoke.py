@@ -1,0 +1,161 @@
+"""Compile-guard observability smoke (ISSUE 8).
+
+Arms ``DEPPY_TPU_COMPILE_GUARD=1`` with a per-signature budget of 1,
+provokes a scripted compile storm — the ``jit-in-loop`` anti-pattern
+the static ``compile-surface`` checker flags as ``jit-no-memo``: a
+fresh ``jax.jit`` per call, so the same abstract signature retraces
+every iteration — under a live request trace, and asserts the storm is
+observable everywhere an operator would look:
+
+  * the raised :class:`CompileGuardError` (the assertion itself);
+  * ``compileguard`` events on the JSONL sink — one per healthy trace
+    with entry/signature/site/wall time, plus the ``retrace-budget``
+    violation event — stamped with the request trace's ids;
+  * ``deppy compiles`` (per-entry trace/retrace summary + the
+    violation line);
+  * ``deppy stats`` (the ``events:`` kind tally);
+  * the STATIC side of the same contract: ``compile-surface`` flags
+    the fixture's jit-in-loop as ``jit-no-memo`` — the storm is caught
+    before merge AND at runtime.
+
+Run: ``make compileguard-smoke`` (CPU JAX: the storm fixture jits a
+trivial add, no engine needed).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tempfile
+from contextlib import redirect_stdout
+
+os.environ["DEPPY_TPU_COMPILE_GUARD"] = "1"
+os.environ["DEPPY_TPU_COMPILE_BUDGET"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+FIXTURE = '''
+import jax
+import jax.numpy as jnp
+
+
+def kernel(x):
+    return x + 1
+
+
+def storm(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(kernel)(x))  # fresh jit per call: jit-no-memo
+    return out
+'''
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="deppy_compileguard_")
+    sink = os.path.join(tmp, "telemetry.jsonl")
+
+    import jax
+    import jax.numpy as jnp
+
+    from deppy_tpu import telemetry
+    from deppy_tpu.analysis import CompileGuardError, compileguard
+    from deppy_tpu.telemetry import trace as ttrace
+
+    telemetry.configure_sink(sink)
+
+    # The runtime half: one observed entry, a fresh jit per loop
+    # iteration (the cache the factory SHOULD hold is rebuilt every
+    # call), same abstract signature each time.
+    observed = compileguard.observe("smoke.storm_kernel",
+                                    lambda x: x + 1)
+    x = jnp.arange(8)
+    ctx = ttrace.TraceContext(request_id="compileguard-smoke-req")
+    raised = False
+    with ttrace.activate(ctx):
+        with telemetry.default_registry().span("smoke.request"):
+            try:
+                for _ in range(3):
+                    # A fresh closure per call — the real shape of the
+                    # anti-pattern (jax dedupes jit caches on function
+                    # identity, so an unmemoized factory always hands
+                    # jit a new callable).
+                    jax.jit(lambda v: observed(v))(x)
+            except CompileGuardError as e:
+                raised = True
+                print(f"[smoke] assertion fired as expected: {e}")
+    if not raised:
+        fail("seeded jit-in-loop retrace did not raise CompileGuardError")
+
+    events = [json.loads(line) for line in
+              open(sink, encoding="utf-8") if line.strip()]
+    cg = [e for e in events if e.get("kind") == "compileguard"]
+    if len(cg) < 2:
+        fail(f"expected >= 2 compileguard sink events, got {len(cg)}")
+    violations = [e for e in cg if e.get("violation") == "retrace-budget"]
+    if len(violations) != 1:
+        fail(f"expected exactly one retrace-budget violation, got "
+             f"{violations}")
+    if violations[0].get("trace_id") != ctx.trace_id:
+        fail(f"violation not stamped with the request trace: "
+             f"{violations[0]}")
+    if violations[0].get("entry") != "smoke.storm_kernel":
+        fail(f"violation names the wrong entry: {violations[0]}")
+    print("[smoke] sink carries the trace events and the stamped "
+          "violation")
+
+    from deppy_tpu.cli import main as cli_main
+
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = cli_main(["compiles", sink])
+    if rc != 0:
+        fail(f"deppy compiles rc={rc}")
+    text = out.getvalue()
+    if "smoke.storm_kernel" not in text or "VIOLATION" not in text:
+        fail(f"deppy compiles does not summarize the storm:\n{text}")
+    print("[smoke] deppy compiles summarizes the storm")
+
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = cli_main(["stats", sink])
+    if rc != 0:
+        fail(f"deppy stats rc={rc}")
+    if "compileguard=" not in out.getvalue():
+        fail(f"deppy stats does not tally compileguard events:\n"
+             f"{out.getvalue()}")
+    print("[smoke] deppy stats tallies the events")
+
+    # The static half: the same anti-pattern is caught before merge.
+    fix_root = os.path.join(tmp, "repo")
+    os.makedirs(os.path.join(fix_root, "deppy_tpu"), exist_ok=True)
+    fix_path = os.path.join(fix_root, "deppy_tpu", "storm.py")
+    with open(fix_path, "w", encoding="utf-8") as fh:
+        fh.write(FIXTURE)
+    from pathlib import Path
+
+    from deppy_tpu.analysis.compile_surface import CompileSurfaceChecker
+    from deppy_tpu.analysis.core import SourceFile
+
+    sf = SourceFile.load(Path(fix_path), Path(fix_root))
+    findings = CompileSurfaceChecker().check([sf], Path(fix_root))
+    if not any(f.code == "jit-no-memo" for f in findings):
+        fail(f"compile-surface did not flag the jit-in-loop fixture: "
+             f"{[f.code for f in findings]}")
+    print("[smoke] compile-surface flags the same storm statically "
+          "(jit-no-memo)")
+
+    print("COMPILEGUARD SMOKE PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -331,6 +331,217 @@ class TestRegistrySync:
         assert [f.symbol for f in findings] == ["unregistered"]
 
 
+# ------------------------------------------------------ suppression spans
+
+
+class TestSuppressionSpans:
+    """ISSUE 8 satellite: a `lint-ok` on a statement's first line must
+    cover findings attributed to its continuation lines, and one on a
+    `def` line must cover findings attributed to its decorator lines."""
+
+    def test_multiline_statement_covered_from_first_line(self, tmp_path):
+        sf = _fixture(tmp_path, "deppy_tpu/fix_span.py", '''
+x = call(  # deppy: lint-ok[some-checker] reasoned
+    arg_one,
+    arg_two,
+)
+''')
+        assert sf.suppressed(3, "some-checker")
+        assert sf.suppressed(4, "some-checker")
+        assert not sf.suppressed(3, "other-checker")
+
+    def test_compound_statements_not_blanketed(self, tmp_path):
+        """A suppression on an `if` line must NOT cover its body (the
+        line directly below is covered by the long-standing
+        line-above rule; deeper body lines must not be)."""
+        sf = _fixture(tmp_path, "deppy_tpu/fix_span2.py", '''
+if cond:  # deppy: lint-ok[some-checker] narrow
+    first_line()
+    second_line()
+''')
+        assert sf.suppressed(2, "some-checker")
+        assert not sf.suppressed(4, "some-checker")
+
+    def test_decorated_def_covered_from_def_line(self, tmp_path):
+        sf = _fixture(tmp_path, "deppy_tpu/fix_span3.py", '''
+@decorator_one
+@decorator_two(
+    option=1,
+)
+# deppy: lint-ok[some-checker] decorator hazard is deliberate
+def fn():
+    pass
+''')
+        # Findings attributed to any decorator line resolve to the def
+        # line (7), whose preceding line carries the suppression.
+        for dec_line in (2, 3, 4, 5):
+            assert sf.suppressed(dec_line, "some-checker")
+        assert not sf.suppressed(8, "some-checker")
+
+    def test_decorator_suppression_end_to_end(self, tmp_path):
+        """trace-purity attributes @jax.jit hazards to the decorated
+        function; a def-line suppression must cover a finding flagged
+        on the decorator's own line."""
+        from deppy_tpu.analysis.purity import TracePurityChecker
+
+        sf = _fixture(tmp_path, "deppy_tpu/fix_span4.py", '''
+import time
+import jax
+
+
+@jax.jit
+# deppy: lint-ok[trace-purity] trace-time clock is the point here
+def stamped(x):
+    time.time()
+    return x
+''')
+        # A finding attributed to the decorator line (6) resolves to
+        # the def line (8), whose preceding comment carries the
+        # suppression; the body hazard keeps its own line semantics.
+        assert sf.suppressed(6, "trace-purity")
+        findings = TracePurityChecker().check([sf], tmp_path)
+        assert [f.code for f in findings] == ["wall-clock"]
+
+
+# --------------------------------------------------------- changed mode
+
+
+class TestChangedMode:
+    def test_partial_scan_skips_absence_rules(self, tmp_path):
+        """A subset scan must not claim every declared knob unused or
+        every fault point stale."""
+        from deppy_tpu.analysis.core import run_checkers
+
+        (tmp_path / "deppy_tpu").mkdir(parents=True, exist_ok=True)
+        (tmp_path / "deppy_tpu" / "only.py").write_text(
+            'import os\nX = os.environ.get("DEPPY_TPU_MAX_LANES")\n',
+            encoding="utf-8")
+        findings = run_checkers(tmp_path, names=["registry-sync"],
+                                paths=["deppy_tpu/only.py"])
+        assert [f for f in findings
+                if f.code in ("unused-env", "stale-fault-point")] == []
+
+    def test_partial_scan_still_catches_presence_violations(self,
+                                                            tmp_path):
+        from deppy_tpu.analysis.core import run_checkers
+
+        (tmp_path / "deppy_tpu").mkdir(parents=True, exist_ok=True)
+        # deppy: lint-ok[registry-sync] this fixture's seeded violation
+        bad = 'X = "DEPPY_TPU_NOT_A_KNOB"\n'
+        (tmp_path / "deppy_tpu" / "only.py").write_text(
+            bad, encoding="utf-8")
+        findings = run_checkers(tmp_path, names=["registry-sync"],
+                                paths=["deppy_tpu/only.py"])
+        assert [f.code for f in findings] == ["undeclared-env"]
+
+    def test_changed_files_lists_worktree_diff(self):
+        """changed_files runs against the real checkout (smoke: no
+        crash, returns relative paths)."""
+        from deppy_tpu.analysis.core import changed_files, repo_root
+
+        names = changed_files(repo_root(), "HEAD")
+        assert all(not n.startswith("/") for n in names)
+
+    def test_changed_files_bad_ref_raises(self):
+        from deppy_tpu.analysis.core import changed_files, repo_root
+
+        with pytest.raises(RuntimeError):
+            changed_files(repo_root(), "no-such-ref-xyzzy")
+
+
+# --------------------------------------------------------- flag mirrors
+
+
+class TestMirrorSync:
+    def _check(self, tmp_path, cli_text, registry):
+        from deppy_tpu.analysis.registry_sync import RegistrySyncChecker
+
+        sf = _fixture(tmp_path, "deppy_tpu/cli.py", cli_text)
+        checker = RegistrySyncChecker(mirror_registry=registry)
+        out = []
+        checker._check_mirrors(out, [sf])
+        return out
+
+    def _var(self, name, flag=None, config_key=None):
+        from deppy_tpu.config import EnvVar
+
+        return EnvVar(name=name, type="int", default=1, consumer="t",
+                      help="h", flag=flag, config_key=config_key)
+
+    def test_declared_mirrors_present_clean(self, tmp_path):
+        reg = {"DEPPY_TPU_MESH_DEVICES": self._var(
+            "DEPPY_TPU_MESH_DEVICES", flag="--mesh-devices",
+            config_key="meshDevices")}
+        findings = self._check(tmp_path, '''
+def build(p):
+    p.add_argument("--mesh-devices",
+                   help="devices (also via DEPPY_TPU_MESH_DEVICES)")
+
+
+_CONFIG_KEYS = {"meshDevices": ("mesh_devices", int)}
+''', reg)
+        assert findings == []
+
+    def test_missing_flag_and_key_caught(self, tmp_path):
+        reg = {"DEPPY_TPU_MESH_DEVICES": self._var(
+            "DEPPY_TPU_MESH_DEVICES", flag="--mesh-devices",
+            config_key="meshDevices")}
+        findings = self._check(tmp_path, '''
+def build(p):
+    p.add_argument("--unrelated", help="nothing here")
+
+
+_CONFIG_KEYS = {}
+''', reg)
+        assert sorted(f.code for f in findings) == [
+            "missing-config-key", "missing-flag-mirror"]
+
+    def test_undeclared_flag_mirror_caught(self, tmp_path):
+        """A flag whose help says 'also via <knob>' while the knob
+        declares no (or another) flag: the convention must be declared
+        back."""
+        reg = {"DEPPY_TPU_MESH_DEVICES": self._var(
+            "DEPPY_TPU_MESH_DEVICES")}
+        findings = self._check(tmp_path, '''
+def build(p):
+    p.add_argument("--mesh-devices",
+                   help="devices (also via DEPPY_TPU_MESH_DEVICES)")
+''', reg)
+        assert [f.code for f in findings] == ["undeclared-flag-mirror"]
+        assert findings[0].symbol == "--mesh-devices:DEPPY_TPU_MESH_DEVICES"
+
+    def test_undeclared_config_key_caught(self, tmp_path):
+        reg = {"DEPPY_TPU_MESH_DEVICES": self._var(
+            "DEPPY_TPU_MESH_DEVICES", flag="--mesh-devices")}
+        findings = self._check(tmp_path, '''
+def build(p):
+    p.add_argument("--mesh-devices",
+                   help="devices (also via DEPPY_TPU_MESH_DEVICES)")
+
+
+_CONFIG_KEYS = {"meshDevices": ("mesh_devices", int)}
+''', reg)
+        assert [f.code for f in findings] == ["undeclared-config-key"]
+
+    def test_mention_without_also_via_is_not_a_mirror(self, tmp_path):
+        """trace --file's 'default: $DEPPY_TPU_TELEMETRY_FILE' help is
+        a default source, not a mirror — no finding."""
+        reg = {"DEPPY_TPU_TELEMETRY_FILE": self._var(
+            "DEPPY_TPU_TELEMETRY_FILE", flag="--telemetry-file")}
+        findings = self._check(tmp_path, '''
+def build(p):
+    p.add_argument("--telemetry-file",
+                   help="sink (also via DEPPY_TPU_TELEMETRY_FILE)")
+    p.add_argument("--file",
+                   help="file (default: $DEPPY_TPU_TELEMETRY_FILE)")
+''', reg)
+        assert findings == []
+
+    # The real registry's mirrors being clean is covered by the
+    # repo-wide empty-baseline golden (TestRepoLint) — no separate
+    # repo scan here, the tier-1 budget is tight.
+
+
 # ----------------------------------------------------- repo-level goldens
 
 

@@ -1,0 +1,461 @@
+"""Compile-contract tier tests (ISSUE 8).
+
+Four layers:
+
+  * seeded fixtures per ``compile-surface`` rule — jit-in-loop,
+    undeclared statics, mutable closures, Mosaic-hostile reduces — each
+    caught, with the adjacent clean/memoized/suppressed variants NOT
+    flagged;
+  * the ``block-contract`` numeric checks over seeded kernel fixtures
+    (SMEM column budget, the (1,1)-block Mosaic regression, pad-waste
+    bounds, contract drift);
+  * the jit-surface registry over the REAL tree: every engine jit entry
+    is memoized (or module-level) and compile-guard observed — the
+    registry is the checker's product, this pins it against drift;
+  * the runtime guard: trace counting (disarmed), budget assertion +
+    stamped sink events (armed), signature separation of static
+    configs, ledger reset on deliberate cache drops, and the `deppy
+    compiles` summary.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.compileguard
+
+from deppy_tpu.analysis.core import SourceFile  # noqa: E402
+
+
+def _fixture(tmp_path: Path, rel: str, text: str) -> SourceFile:
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(text, encoding="utf-8")
+    return SourceFile.load(path, tmp_path)
+
+
+def _codes(findings):
+    return sorted({f.code for f in findings})
+
+
+# -------------------------------------------------------- compile-surface
+
+
+class TestCompileSurface:
+    def _check(self, tmp_path, text, rel="deppy_tpu/engine/fix_cs.py"):
+        from deppy_tpu.analysis.compile_surface import \
+            CompileSurfaceChecker
+
+        sf = _fixture(tmp_path, rel, text)
+        return CompileSurfaceChecker().check([sf], tmp_path)
+
+    def test_seeded_violations_caught(self, tmp_path):
+        findings = self._check(tmp_path, '''
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_MODE = "auto"
+
+
+def set_mode(m):
+    global _MODE
+    _MODE = m
+
+
+def per_call(x):
+    return jax.jit(body)(x)            # jit-no-memo
+
+
+def body(x, *, width):
+    return x + width
+
+
+fn = jax.jit(body)                     # undeclared-static-arg
+
+
+def traced(x):
+    if _MODE == "auto":                # mutable-closure
+        return x
+    return x
+
+
+g = jax.jit(traced)
+
+
+def kernel(x_ref, o_ref):
+    o_ref[0, 0] = jnp.sum(x_ref[:])    # mosaic-int-reduce
+
+
+def run(x):
+    return pl.pallas_call(kernel, out_shape=None)(x)
+''')
+        assert _codes(findings) == ["jit-no-memo", "mosaic-int-reduce",
+                                    "mutable-closure",
+                                    "undeclared-static-arg"]
+        by_code = {f.code: f for f in findings if f.code != "undeclared-static-arg"}
+        assert by_code["jit-no-memo"].symbol == "per_call:jit"
+        assert by_code["mutable-closure"].symbol == "traced:_MODE"
+        assert by_code["mosaic-int-reduce"].symbol == "kernel:jnp.sum"
+
+    def test_memoized_factory_and_partial_statics_clean(self, tmp_path):
+        """The repo's factory idiom — lru_cache memo, statics bound by
+        functools.partial resolved THROUGH a local variable and the
+        compileguard.observe wrapper — is clean."""
+        findings = self._check(tmp_path, '''
+import functools
+import jax
+from deppy_tpu.analysis import compileguard
+
+
+def solve(x, budget, *, V, NCON):
+    return x + V + NCON
+
+
+@functools.lru_cache(maxsize=8)
+def factory(V, NCON):
+    fn = functools.partial(solve, V=V, NCON=NCON)
+    return jax.jit(compileguard.observe(
+        "fix.factory", jax.vmap(fn, in_axes=(0, None)),
+        static=(V, NCON)))
+''')
+        assert findings == []
+
+    def test_declared_statics_and_decorator_form(self, tmp_path):
+        findings = self._check(tmp_path, '''
+import functools
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("V",))
+def good(x, *, V):
+    return x + V
+
+
+@functools.partial(jax.jit, static_argnames=())
+def bad(x, *, V):
+    return x + V
+''')
+        assert _codes(findings) == ["undeclared-static-arg"]
+        assert findings[0].symbol == "bad:V"
+
+    def test_kernel_tree_fold_and_suppression_clean(self, tmp_path):
+        findings = self._check(tmp_path, '''
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from deppy_tpu.engine import core
+
+
+def kernel(x_ref, o_ref):
+    o_ref[0, 0] = core.tree_sum(x_ref[:])   # the sanctioned spelling
+    # deppy: lint-ok[compile-surface] interpret-only debug tap
+    o_ref[0, 1] = jnp.max(x_ref[:])
+
+
+def run(x):
+    return pl.pallas_call(kernel, out_shape=None)(x)
+''')
+        assert findings == []
+
+    def test_host_reduces_outside_kernels_clean(self, tmp_path):
+        """.sum() in the jit wrapper AROUND a pallas_call (XLA lowers
+        it fine) must not be confused with kernel-body reduces."""
+        findings = self._check(tmp_path, '''
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def kernel(x_ref, o_ref):
+    o_ref[0, 0] = x_ref[0, 0]
+
+
+def entry(x):
+    n = (x > 0).sum(axis=1)              # outside the kernel: fine
+    return pl.pallas_call(kernel, out_shape=None)(n)
+
+
+fn = jax.jit(entry)
+''')
+        assert findings == []
+
+
+# --------------------------------------------------------- jit registry
+
+
+@pytest.fixture(scope="module")
+def surface():
+    """One repo-wide jit-surface scan shared by the registry pins (the
+    scan re-parses the whole tree; three scans would triple the tier-1
+    cost for identical results)."""
+    from deppy_tpu.analysis.compile_surface import jit_surface
+
+    return jit_surface()
+
+
+class TestJitSurface:
+    def test_engine_entries_registered_memoized_and_observed(
+            self, surface):
+        entries = {e.name: e for e in surface
+                   if e.kind in ("jit", "pjit")}
+        for name in ("batched_solve", "batched_search", "batched_core",
+                     "batched_probe", "batched_minimize_gated",
+                     "batched_core_gated", "_planes_fn",
+                     "batched_solve_sharded", "_sharded_fn"):
+            assert name in entries, f"jit surface lost entry {name}"
+            assert entries[name].memoized, f"{name} lost its memo"
+            assert entries[name].observed, \
+                f"{name} is not compile-guard observed"
+
+    def test_every_cached_entry_is_memoized_or_module_level(
+            self, surface):
+        """THE construction contract: no jit/pjit in the tree is built
+        per-call without a memo (the compile-surface golden, pinned
+        directly on the registry)."""
+        for e in surface:
+            if e.kind in ("jit", "pjit") and e.in_function:
+                assert e.memoized, (
+                    f"{e.path}:{e.line} builds {e.kind} per call")
+
+    def test_pallas_kernels_registered(self, surface):
+        kernels = {e.path for e in surface
+                   if e.kind == "pallas_call"}
+        assert "deppy_tpu/engine/pallas_bcp.py" in kernels
+        assert "deppy_tpu/engine/pallas_blockwise.py" in kernels
+        assert "deppy_tpu/engine/pallas_search.py" in kernels
+
+
+# -------------------------------------------------------- block-contract
+
+
+class TestBlockContract:
+    def _checker(self, **kw):
+        from deppy_tpu.analysis.block_contract import \
+            BlockContractChecker
+
+        return BlockContractChecker(**kw)
+
+    def test_smem_budget_exceeded_caught(self, tmp_path):
+        cols = ", ".join(["s"] * 9)
+        sf = _fixture(tmp_path, "deppy_tpu/engine/pallas_search.py", f'''
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _smem_scalars(B):
+    return pl.BlockSpec((B, 1), lambda b: (0, 0),
+                        memory_space=pltpu.SMEM)
+
+
+def _entry(x):
+    s = _smem_scalars(4096)
+    return pl.pallas_call(None, in_specs=[{cols}])(x)
+''')
+        findings = self._checker().check([sf], tmp_path)
+        assert _codes(findings) == ["smem-budget"]
+        assert findings[0].symbol == "_entry:9"
+
+    def test_per_row_smem_block_caught(self, tmp_path):
+        """The 2026-08-01 Mosaic rejection as a permanent rule: a
+        (1, 1) SMEM block whose index map moves with the grid."""
+        sf = _fixture(tmp_path, "deppy_tpu/engine/pallas_bcp.py", '''
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+bad = pl.BlockSpec((1, 1), lambda b: (b, 0), memory_space=pltpu.SMEM)
+ok = pl.BlockSpec((1, 1), lambda b: (0, 0), memory_space=pltpu.SMEM)
+''')
+        findings = self._checker().check([sf], tmp_path)
+        assert _codes(findings) == ["smem-per-row-block"]
+        assert len(findings) == 1
+
+    def test_contract_drift_caught(self, tmp_path):
+        driver = _fixture(tmp_path, "deppy_tpu/engine/driver.py",
+                          "MAX_BUCKETS = 4\n")
+        findings = self._checker().check([driver], tmp_path)
+        assert _codes(findings) == ["contract-drift"]
+        assert findings[0].symbol == "SPLIT_RATIO"
+
+    def test_unsplittable_classes_caught(self, tmp_path):
+        """Two declared classes closer than SPLIT_RATIO: the 64-clause
+        problem pays the big class's pad — a finding (ROADMAP 3)."""
+        driver = _fixture(tmp_path, "deppy_tpu/engine/driver.py",
+                          "SPLIT_RATIO = 2.0\n")
+        close = {
+            "a": {"C": 64, "NV": 64, "NCON": 32},
+            "b": {"C": 128, "NV": 64, "NCON": 32},
+        }
+        findings = self._checker(size_classes=close).check(
+            [driver], tmp_path)
+        assert _codes(findings) == ["padding-waste"]
+        assert findings[0].symbol == "a->b"
+
+    def test_block_pad_waste_caught(self, tmp_path):
+        """A non-power-of-two clause class under the default BLOCK_ROWS
+        pays > 25% row padding in the blockwise sweep."""
+        sf = _fixture(tmp_path, "deppy_tpu/engine/pallas_blockwise.py",
+                      "br = max(8 * ((br + 7) // 8), 8)\n")
+        waste = {"odd": {"C": 2304, "NV": 64, "NCON": 32}}
+        findings = self._checker(size_classes=waste).check(
+            [sf], tmp_path)
+        assert _codes(findings) == ["block-pad-waste"]
+
+    def test_real_kernels_clean(self):
+        """The shipped kernels + driver satisfy every declared block
+        contract (the repo-clean half of the acceptance bullet)."""
+        from deppy_tpu.analysis.core import repo_root, run_checkers
+
+        assert run_checkers(repo_root(), names=["block-contract"]) == []
+
+
+# -------------------------------------------------------- runtime guard
+
+
+class TestRuntimeGuard:
+    @pytest.fixture(autouse=True)
+    def _fresh(self):
+        from deppy_tpu.analysis import compileguard
+
+        compileguard.reset_counts()
+        yield
+        compileguard.reset_counts()
+
+    def test_disarmed_counts_without_events_or_raises(self, tmp_path):
+        from deppy_tpu import telemetry
+        from deppy_tpu.analysis import compileguard
+
+        sink = tmp_path / "t.jsonl"
+        reg = telemetry.Registry(sink_path=str(sink))
+        prev = telemetry.set_default_registry(reg)
+        try:
+            fn = compileguard.observe("t.entry", lambda x: x + 1)
+            for _ in range(5):
+                fn(1)  # same "signature" five times, guard disarmed
+        finally:
+            telemetry.set_default_registry(prev)
+        assert compileguard.trace_count() == 5
+        snap = compileguard.snapshot()["t.entry"]
+        assert snap == {"traces": 5, "signatures": 1, "retraces": 4}
+        assert not sink.exists()
+
+    def test_armed_budget_violation_raises_and_emits(self, tmp_path,
+                                                     monkeypatch):
+        from deppy_tpu import telemetry
+        from deppy_tpu.analysis import CompileGuardError, compileguard
+
+        monkeypatch.setenv("DEPPY_TPU_COMPILE_GUARD", "1")
+        monkeypatch.setenv("DEPPY_TPU_COMPILE_BUDGET", "2")
+        sink = tmp_path / "t.jsonl"
+        reg = telemetry.Registry(sink_path=str(sink))
+        prev = telemetry.set_default_registry(reg)
+        try:
+            import numpy as np
+
+            fn = compileguard.observe("t.storm", lambda x: x + 1)
+            x = np.zeros((4,), np.int32)
+            fn(x)
+            fn(x)  # same abstract signature, within budget
+            with pytest.raises(CompileGuardError):
+                fn(x)
+        finally:
+            telemetry.set_default_registry(prev)
+        events = [json.loads(line) for line in
+                  sink.read_text().splitlines()]
+        cg = [e for e in events if e["kind"] == "compileguard"]
+        assert [e.get("violation") for e in cg] == \
+            [None, None, "retrace-budget"]
+        assert cg[-1]["entry"] == "t.storm"
+        assert cg[-1]["n_trace"] == 3 and cg[-1]["budget"] == 2
+        assert all("site" in e for e in cg)
+
+    def test_static_config_separates_signatures(self, monkeypatch):
+        """Two factory instances over the SAME avals must not charge
+        each other's budget: the static tuple joins the signature."""
+        from deppy_tpu.analysis import compileguard
+
+        monkeypatch.setenv("DEPPY_TPU_COMPILE_GUARD", "1")
+        monkeypatch.setenv("DEPPY_TPU_COMPILE_BUDGET", "1")
+        a = compileguard.observe("t.fac", lambda x: x, static=(64, True))
+        b = compileguard.observe("t.fac", lambda x: x, static=(128, True))
+        a(1)
+        b(1)  # same aval, different static: NOT a retrace
+        snap = compileguard.snapshot()["t.fac"]
+        assert snap == {"traces": 2, "signatures": 2, "retraces": 0}
+
+    def test_shape_and_dtype_in_signature(self):
+        import numpy as np
+
+        from deppy_tpu.analysis import compileguard
+
+        fn = compileguard.observe("t.shapes", lambda x: x)
+        fn(np.zeros((4, 8), np.int32))
+        fn(np.zeros((4, 8), np.float32))
+        fn(np.zeros((8, 8), np.int32))
+        snap = compileguard.snapshot()["t.shapes"]
+        assert snap["signatures"] == 3 and snap["retraces"] == 0
+
+    def test_deliberate_cache_drop_resets_ledger(self):
+        pytest.importorskip("jax")
+        from deppy_tpu.analysis import compileguard
+        from deppy_tpu.engine import core
+
+        compileguard.observe("t.x", lambda x: x)(1)
+        assert compileguard.trace_count() == 1
+        core.clear_batched_caches()
+        assert compileguard.trace_count() == 0
+
+    def test_seeded_jit_in_loop_storm_raises(self, monkeypatch):
+        """THE acceptance bullet's runtime half: the jit-in-loop
+        fixture (a fresh closure per call over one observed entry)
+        trips the guard on its first same-signature retrace."""
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from deppy_tpu.analysis import CompileGuardError, compileguard
+
+        monkeypatch.setenv("DEPPY_TPU_COMPILE_GUARD", "1")
+        monkeypatch.setenv("DEPPY_TPU_COMPILE_BUDGET", "1")
+        observed = compileguard.observe("t.loop", lambda v: v + 1)
+        x = jnp.arange(4)
+        jax.jit(lambda v: observed(v))(x)
+        with pytest.raises(CompileGuardError):
+            for _ in range(3):
+                jax.jit(lambda v: observed(v))(x)
+
+    def test_compiles_cli_summarizes_sink(self, tmp_path, capsys):
+        from deppy_tpu.cli import main
+
+        sink = tmp_path / "t.jsonl"
+        lines = [
+            {"ts": 1.0, "kind": "compileguard", "entry": "core.x",
+             "signature": "i32[4]", "site": "a.py:1", "n_trace": 1,
+             "dur_s": 0.25},
+            {"ts": 2.0, "kind": "compileguard", "entry": "core.x",
+             "signature": "i32[4]", "site": "a.py:1", "n_trace": 2,
+             "dur_s": 0.5},
+            {"ts": 3.0, "kind": "compileguard", "entry": "core.x",
+             "violation": "retrace-budget", "signature": "i32[4]",
+             "site": "a.py:1", "n_trace": 3, "budget": 2},
+            {"ts": 4.0, "kind": "span", "name": "ignored"},
+        ]
+        sink.write_text("\n".join(json.dumps(e) for e in lines) + "\n")
+        rc = main(["compiles", str(sink), "--output", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["entries"]["core.x"] == {
+            "traces": 2, "signatures": 1, "retraces": 1,
+            "trace_s": 0.75}
+        assert len(doc["violations"]) == 1
+
+    def test_compiles_cli_surface_lists_entries(self, capsys):
+        from deppy_tpu.cli import main
+
+        rc = main(["compiles", "--surface", "--output", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        names = {e["name"] for e in doc["entries"]}
+        assert "batched_solve" in names and "_sharded_fn" in names

@@ -1,0 +1,110 @@
+"""Typed env-registry accessor tests (ISSUE 8 satellite).
+
+The registry's accessors are the single road every ``DEPPY_TPU_*`` read
+takes; their error paths — malformed values under strict/lenient modes,
+undeclared names, foreign prefixes — previously had no direct coverage,
+and the generated docs/configuration.md round-trip is pinned here for
+the new compile-guard knobs specifically (test_doc_sync pins the whole
+file)."""
+
+from __future__ import annotations
+
+import pytest
+
+from deppy_tpu import config
+
+
+class TestAccessorErrorPaths:
+    def test_undeclared_name_raises_on_every_accessor(self, monkeypatch):
+        # deppy: lint-ok[registry-sync] seeded undeclared knob
+        monkeypatch.setenv("DEPPY_TPU_NO_SUCH_KNOB", "1")
+        for fn in (config.env_raw, config.env_str, config.env_int,
+                   config.env_float):
+            with pytest.raises(config.UndeclaredEnvVar):
+                # deppy: lint-ok[registry-sync] seeded undeclared knob
+                fn("DEPPY_TPU_NO_SUCH_KNOB")
+        with pytest.raises(config.UndeclaredEnvVar):
+            # deppy: lint-ok[registry-sync] seeded undeclared knob
+            config.env_bool("DEPPY_TPU_NO_SUCH_KNOB")
+
+    def test_undeclared_raises_even_when_unset(self):
+        with pytest.raises(config.UndeclaredEnvVar):
+            # deppy: lint-ok[registry-sync] seeded undeclared knob
+            config.env_raw("DEPPY_TPU_ALSO_NOT_DECLARED")
+
+    def test_foreign_prefix_is_not_enforced(self, monkeypatch):
+        """require() only owns the DEPPY_TPU_ namespace: the defensive
+        parse helpers are shared with DEPPY_BENCH_*/test knobs."""
+        assert config.require("DEPPY_BENCH_PROBE_CACHE") is None
+        assert config.require("JAX_PLATFORMS") is None
+
+    def test_malformed_int_strict_raises_lenient_degrades(
+            self, monkeypatch):
+        monkeypatch.setenv("DEPPY_TPU_MAX_LANES", "not-a-number")
+        with pytest.raises(ValueError):
+            config.env_int("DEPPY_TPU_MAX_LANES")
+        assert config.env_int("DEPPY_TPU_MAX_LANES", 512,
+                              strict=False) == 512
+
+    def test_malformed_float_strict_raises_lenient_degrades(
+            self, monkeypatch):
+        monkeypatch.setenv("DEPPY_TPU_REPROBE", "soon")
+        with pytest.raises(ValueError):
+            config.env_float("DEPPY_TPU_REPROBE")
+        assert config.env_float("DEPPY_TPU_REPROBE", 600.0,
+                                strict=False) == 600.0
+
+    def test_blank_value_is_unset(self, monkeypatch):
+        monkeypatch.setenv("DEPPY_TPU_MAX_LANES", "   ")
+        assert config.env_int("DEPPY_TPU_MAX_LANES", 7) == 7
+        monkeypatch.setenv("DEPPY_TPU_SPEC_CORE", "  ")
+        assert config.env_str("DEPPY_TPU_SPEC_CORE", "auto") == "auto"
+
+    def test_bool_tokens_and_garbage(self, monkeypatch):
+        for raw, want in (("1", True), ("true", True), ("YES", True),
+                          ("on", True), ("0", False), ("off", False),
+                          ("", False), ("no", False)):
+            monkeypatch.setenv("DEPPY_TPU_LOCKDEP", raw)
+            assert config.env_bool("DEPPY_TPU_LOCKDEP") is want
+        monkeypatch.setenv("DEPPY_TPU_LOCKDEP", "maybe")
+        assert config.env_bool("DEPPY_TPU_LOCKDEP") is False
+        assert config.env_bool("DEPPY_TPU_LOCKDEP", True) is True
+
+
+class TestCompileGuardKnobs:
+    def test_declared_with_consumer_and_types(self):
+        guard = config.REGISTRY["DEPPY_TPU_COMPILE_GUARD"]
+        assert guard.type == "bool" and guard.default is False
+        assert guard.consumer == "deppy_tpu.analysis.compileguard"
+        budget = config.REGISTRY["DEPPY_TPU_COMPILE_BUDGET"]
+        assert budget.type == "int" and budget.default is None
+
+    def test_generated_doc_roundtrip_includes_guard_knobs(self):
+        """The compile-guard rows survive the docs/configuration.md
+        generation round-trip (the whole-file pin lives in
+        test_doc_sync; this anchors the NEW knobs by name)."""
+        from deppy_tpu.analysis.core import repo_root
+
+        rendered = config.render_markdown()
+        assert "DEPPY_TPU_COMPILE_GUARD" in rendered
+        assert "DEPPY_TPU_COMPILE_BUDGET" in rendered
+        on_disk = (repo_root() / "docs" /
+                   "configuration.md").read_text(encoding="utf-8")
+        assert on_disk == rendered
+
+    def test_mirror_declarations_match_cli(self):
+        """Every declared flag/config_key mirror exists in cli.py (the
+        registry-sync mirror rules, pinned as a direct unit test)."""
+        from pathlib import Path
+
+        cli_text = (Path(config.__file__).parent /
+                    "cli.py").read_text(encoding="utf-8")
+        for var in config.REGISTRY.values():
+            if var.flag:
+                assert f'"{var.flag}"' in cli_text, (
+                    f"{var.name} declares flag {var.flag} missing from "
+                    f"cli.py")
+            if var.config_key:
+                assert f'"{var.config_key}"' in cli_text, (
+                    f"{var.name} declares config key {var.config_key} "
+                    f"missing from cli.py")

@@ -128,6 +128,10 @@ def test_logging_tracer_produces_transcript_on_tensor_backend():
     assert "Conflicts:\n" in text
 
 
+# `slow`: the single largest tier-1 rock (~49s of fuzz solves) — the
+# 870s tier-1 wall was within noise of the whole-suite runtime; this
+# pin still runs in unit-full / nightly (the PR 6 budget pattern).
+@pytest.mark.slow
 def test_trace_counts_match_on_fuzz_instances():
     """Backtrack-count parity over the benchmark distribution: the two
     engines implement the same search, so the trace stream has the same
