@@ -84,6 +84,14 @@ shard-smoke: ## Mesh serving on a forced 8-device CPU platform: sharded-vs-unsha
 test-shard: ## Mesh-serving shard subsystem tests only (the `shard` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m shard
 
+.PHONY: incremental-smoke
+incremental-smoke: ## Churn replay against two live services: warm hits, chaos fallback, byte-identity vs the tier-off service (ISSUE 10 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/incremental_smoke.py
+
+.PHONY: test-incremental
+test-incremental: ## Incremental-resolution subsystem tests only (the `incremental` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m incremental
+
 .PHONY: lint
 lint: ## Static analysis: the six deppy-lint checkers vs analysis/baseline.json (ISSUE 7/8 acceptance; docs/analysis.md).
 	$(PYTHON) -m deppy_tpu lint

@@ -200,6 +200,26 @@ def _build_parser() -> argparse.ArgumentParser:
         "serial engine; also via DEPPY_TPU_HOST_WORKERS)",
     )
     p_serve.add_argument(
+        "--incremental", choices=["on", "off"], default=None,
+        help="delta-aware incremental resolution tier (default on; "
+        "also via DEPPY_TPU_INCREMENTAL).  'off' removes the clause-set "
+        "index and warm-start lane class, restoring pre-tier dispatch "
+        "byte for byte",
+    )
+    p_serve.add_argument(
+        "--incremental-max-delta", type=float, default=None,
+        metavar="RATIO",
+        help="touched-cone cutoff for warm starts: a delta whose cone "
+        "covers more than this fraction of the problem's variables "
+        "cold-solves instead (default 0.25; also via "
+        "DEPPY_TPU_INCREMENTAL_MAX_DELTA)",
+    )
+    p_serve.add_argument(
+        "--incremental-index-size", type=int, default=None, metavar="N",
+        help="clause-set index capacity in entries (default 512, 0 "
+        "disables the tier; also via DEPPY_TPU_INCREMENTAL_INDEX_SIZE)",
+    )
+    p_serve.add_argument(
         "--mesh-devices", type=_mesh_devices_arg, default=None,
         metavar="N|all",
         help="shard each coalesced micro-batch across N accelerator "
@@ -345,6 +365,9 @@ _CONFIG_KEYS = {
     "cacheSize": ("cache_size", int),
     "hostWorkers": ("host_workers", int),
     "meshDevices": ("mesh_devices", int),
+    "incremental": ("incremental", str),
+    "incrementalMaxDelta": ("incremental_max_delta", float),
+    "incrementalIndexSize": ("incremental_index_size", int),
 }
 
 
@@ -901,6 +924,9 @@ def _cmd_serve(args) -> int:
         "cache_size": None,
         "host_workers": None,
         "mesh_devices": None,
+        "incremental": None,
+        "incremental_max_delta": None,
+        "incremental_index_size": None,
     }
     try:
         if args.config:
@@ -917,6 +943,9 @@ def _cmd_serve(args) -> int:
             ("cache_size", args.cache_size),
             ("host_workers", args.host_workers),
             ("mesh_devices", args.mesh_devices),
+            ("incremental", args.incremental),
+            ("incremental_max_delta", args.incremental_max_delta),
+            ("incremental_index_size", args.incremental_index_size),
         ):
             if val is not None:
                 kwargs[key] = val

@@ -130,6 +130,25 @@ _DECLARATIONS: List[EnvVar] = [
        "Canonical-form result-cache capacity in entries (0 disables; "
        "also --cache-size).",
        flag="--cache-size", config_key="cacheSize"),
+    # --- incremental tier ------------------------------------------------
+    _v("DEPPY_TPU_INCREMENTAL", "str", "on", "deppy_tpu.sched.scheduler",
+       "Delta-aware incremental resolution: clause-set index + "
+       "warm-start lane class in front of the exact result cache "
+       "('off' restores pre-tier dispatch byte for byte; also "
+       "--incremental).",
+       flag="--incremental", config_key="incremental"),
+    _v("DEPPY_TPU_INCREMENTAL_MAX_DELTA", "float", 0.25,
+       "deppy_tpu.sched.scheduler",
+       "Warm-start cutoff: deltas whose touched cone covers more than "
+       "this fraction of the problem's variables cold-solve instead "
+       "(also --incremental-max-delta).",
+       flag="--incremental-max-delta", config_key="incrementalMaxDelta"),
+    _v("DEPPY_TPU_INCREMENTAL_INDEX_SIZE", "int", 512,
+       "deppy_tpu.sched.scheduler",
+       "Clause-set index capacity in solved-problem entries (0 "
+       "disables the tier; also --incremental-index-size).",
+       flag="--incremental-index-size",
+       config_key="incrementalIndexSize"),
     # --- service ---------------------------------------------------------
     _v("DEPPY_TPU_REQUEST_DEADLINE_S", "float", None, "deppy_tpu.service",
        "Default wall-clock budget per /v1/resolve request (clients "

@@ -1732,6 +1732,54 @@ def batched_minimize_gated(V: int, NCON: int, NV: int):
     return xla_fn
 
 
+def warm_check_phase(pt: ProblemTensors, assign: jax.Array,
+                     *, V: int, NCON: int, NV: int) -> jax.Array:
+    """Warm-prefix screen for one lane (ISSUE 10): the assignment is
+    initialized from the lane's cached model (+1 true / -1 false over
+    the off-cone variables, 0 for the cone left open to the re-solve),
+    activation variables constant TRUE, and every clause and cardinality
+    row is evaluated in one pass.  Returns the per-lane OK flag: False
+    means the warm prefix already conflicts (a dead clause or a violated
+    bound with no open member) and the lane should cold-solve without
+    paying a host warm attempt.  One elementwise pass, no loop — the
+    lockstep DPLL equivalent of starting at a deep, model-seeded node
+    instead of the root."""
+    a = assign.astype(jnp.int32)
+    lit = pt.clauses
+    var = jnp.abs(lit) - 1
+    # Activation (and any padded) variable indices read as constant
+    # TRUE: the solve assumes every applied constraint active, exactly
+    # like the host engine's base assignment.
+    is_act = var >= pt.n_vars
+    pv = jnp.clip(jnp.where(is_act, 0, var), 0, NV - 1)
+    val = jnp.where(
+        lit == 0,
+        jnp.int32(-1),  # pad cell: falsified, like the host's _FALSE
+        jnp.where(is_act, jnp.sign(lit), jnp.sign(lit) * a[pv]),
+    )
+    valid_row = (lit != 0).any(axis=1)
+    sat_c = (val == 1).any(axis=1)
+    open_c = (val == 0).any(axis=1)
+    dead = valid_row & ~sat_c & ~open_c
+    members = pt.card_ids
+    mvals = a[jnp.clip(members, 0, NV - 1)]
+    mmask = members >= 0
+    trues = ((mvals == 1) & mmask).sum(axis=1)
+    over = (pt.card_valid > 0) & (trues > pt.card_n)
+    return ~(dead.any() | over.any())
+
+
+@functools.lru_cache(maxsize=128)
+def batched_warm_check(V: int, NCON: int, NV: int):
+    """Jitted, vmapped warm-prefix screen: assignment planes initialized
+    from the cached models, one lockstep pass per coalesced warm lane
+    class (driver.warm_screen is the padding/stacking entry)."""
+    fn = functools.partial(warm_check_phase, V=V, NCON=NCON, NV=NV)
+    return jax.jit(compileguard.observe(
+        "core.batched_warm_check", jax.vmap(fn, in_axes=(0, 0)),
+        static=(V, NCON, NV)))
+
+
 def _core_gated(pt, result, budget, steps, en_lanes, *, V, NCON, NV):
     return core_phase(
         pt, budget, steps, en_lanes & (result == UNSAT),

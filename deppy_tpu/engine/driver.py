@@ -1877,3 +1877,34 @@ def decode_results(
             else:
                 out.append(Incomplete())
     return out
+
+
+def warm_screen(problems: Sequence[Problem], models, cones) -> np.ndarray:
+    """Batched warm-prefix screen (ISSUE 10): the device lane variant of
+    the incremental tier.  Each lane's assignment is initialized from
+    its cached ``model`` (bool[n_vars]) with the ``cone`` variables left
+    open, and one lockstep :func:`core.batched_warm_check` pass per
+    ≤ MAX_LANES chunk (oversized single programs are the documented
+    tunneled-worker crash class, and a mesh-sized warm flush can carry
+    thousands of lanes) flags lanes whose warm prefix already conflicts
+    — those cold-solve without paying a host warm attempt.  Returns
+    bool[n].  Router only: results never depend on this screen, so it
+    shares no identity obligations with the solve paths."""
+    n = len(problems)
+    ch_cap = min(max(n, 1), MAX_LANES)
+    d = _Dims(problems, ch_cap)
+    CH = d.B
+    total = max(1, -(-n // CH)) * CH
+    pts = pad_stack(problems, d, total, pack=False)
+    assign = np.zeros((total, d.NV), np.int32)
+    for i, (m, c) in enumerate(zip(models, cones)):
+        a = np.where(np.asarray(m, dtype=bool), 1, -1).astype(np.int32)
+        a[np.asarray(c, dtype=bool)] = 0
+        assign[i, : a.shape[0]] = a
+    fn = core.batched_warm_check(d.V, d.NCON, d.NV)
+    with telemetry.default_registry().span("driver.warm_screen",
+                                           lanes=n):
+        outs = [fn(_rows(pts, sl), assign[sl])
+                for sl in _chunk_slices(total, CH)]
+        ok = np.concatenate([np.asarray(o) for o in jax.device_get(outs)])
+    return ok[:n]

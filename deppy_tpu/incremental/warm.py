@@ -1,0 +1,74 @@
+"""Warm-start execution (ISSUE 10, piece 2).
+
+One function, :func:`attempt`, runs a planned warm start on the host
+spec engine and reports either the served lane result or None — the
+caller (the scheduler's incremental lane class, or any library user)
+answers None with a cold solve through its normal backend path, so the
+fault domain, deadline triage, and breaker semantics of the cold path
+apply unchanged to every fallback.
+
+Results are shaped as :class:`deppy_tpu.hostpool.worker.HostLaneResult`
+— the same value object every other host-path consumer decodes — so the
+scheduler's decode code is shared, not parallel-maintained.
+
+:func:`screen` is the batched DEVICE variant: assignment planes are
+initialized from each lane's cached model (off-cone values pinned, cone
+left open, activations true) and one lockstep pass flags lanes whose
+warm prefix already conflicts — those lanes skip the host warm attempt
+entirely and cold-solve with their batchmates.  The screen is a router:
+the authoritative certification stays in ``HostEngine.solve_warm``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+from ..hostpool.worker import HostLaneResult
+from .clauseset import WarmPlan
+
+
+def attempt(plan: WarmPlan,
+            max_steps: Optional[int] = None) -> Optional[HostLaneResult]:
+    """Run one warm-started solve.  Returns the lane result on a served
+    warm start, or None when the attempt fell back (warm prefix
+    conflict, cone backtrack, budget exhaustion mid-warm) — the caller
+    cold-solves.  ``InternalSolverError`` propagates: a malformed
+    problem is an error either way."""
+    from ..sat.errors import Incomplete
+    from ..sat.host import HostEngine, WarmStartConflict
+
+    eng = HostEngine(plan.problem, max_steps=max_steps)
+    t0 = time.perf_counter()
+    try:
+        _, installed_idx = eng.solve_warm(plan.warm_assign, plan.cone)
+    except (WarmStartConflict, Incomplete):
+        # Fallback is control flow, not failure: the cold path answers.
+        return None
+    return HostLaneResult(
+        "sat", list(installed_idx), [], eng.steps, eng.decisions,
+        eng.propagation_rounds, eng.backtracks,
+        time.perf_counter() - t0,
+    )
+
+
+def screen(plans: Sequence[WarmPlan]) -> List[bool]:
+    """Batched device warm-prefix screen over one warm lane class.
+    ``True`` means the prefix survived the lockstep check and the host
+    warm attempt is worth paying; ``False`` routes the lane straight to
+    the cold path.  Any screen failure (device fault) degrades to
+    all-True — the host attempt re-checks authoritatively."""
+    from .. import telemetry
+    from ..engine import driver
+
+    try:
+        ok = driver.warm_screen(
+            [p.problem for p in plans],
+            [p.warm_assign > 0 for p in plans],
+            [p.cone for p in plans])
+        return [bool(v) for v in ok]
+    except Exception as e:  # noqa: BLE001 — router only; host re-checks
+        telemetry.default_registry().event(
+            "fault", fault="incremental_screen_failed",
+            error=type(e).__name__, lanes=len(plans))
+        return [True] * len(plans)

@@ -51,6 +51,22 @@ _TRUE = 1
 _FALSE = -1
 
 
+class WarmStartConflict(Exception):
+    """A warm-started solve could not certify byte-identity to a cold
+    solve and must fall back (ISSUE 10).
+
+    Raised by :meth:`HostEngine.solve_warm` whenever the cached
+    assignment prefix conflicts with the delta problem, the cone search
+    needs a backtrack (certification requires a conflict-free cone
+    walk), or any other precondition of the warm/cold equivalence
+    argument fails.  This is control flow, not an error: the caller
+    answers with a cold solve and the result stays exact."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
 @dataclass
 class _Guess:
     """One entry of the guess stack (reference search.go:16-21)."""
@@ -128,6 +144,16 @@ class HostEngine:
         if p.n_cons:
             self._base[self.n :] = _TRUE
         self.last_conflicts: List[AppliedConstraint] = []
+        # Incremental assumption scopes (ISSUE 10): the gini
+        # Assume/Test/Untest surface (reference solve.go:79,99,104 —
+        # inter.S).  ``_assumed_lits`` is the flat signed-literal
+        # assumption set; each Test scope OWNS the assumptions added
+        # since the previous Test, so ``_test_scopes`` records each
+        # scope's START offset (``_scope_base`` = the offset the next
+        # scope will start at) and Untest deletes from there.
+        self._assumed_lits: List[int] = []
+        self._test_scopes: List[int] = []
+        self._scope_base = 0
 
     @property
     def steps(self) -> int:
@@ -433,6 +459,202 @@ class HostEngine:
                 model = assign
 
         return result, assumed_vars(), model
+
+    # ------------------------------------------- incremental (ISSUE 10)
+    #
+    # Two entries sit on top of the cold pipeline above:
+    #
+    #   * assume/test/untest — the gini incremental-scope surface
+    #     (reference solve.go:79,99,104): push assumption literals, run
+    #     a propagation-only Test under them, pop the scope.
+    #   * solve_warm — the delta warm-start entry: seed the assignment
+    #     from a cached model restricted to the untouched cone
+    #     complement, re-run search/completion/minimization over the
+    #     cone only, and raise WarmStartConflict the moment the run
+    #     leaves the regime where warm output provably equals cold
+    #     output (any UNSAT test — i.e. any would-be backtrack — or a
+    #     conflicting warm prefix).
+    #
+    # The equivalence argument solve_warm certifies at runtime: the cone
+    # is closed under clause/cardinality adjacency, so the problem
+    # decomposes into an untouched component (where the cached final
+    # model is reproduced verbatim) and the cone component (re-solved
+    # cold-style).  Chronological DPLL with the lowest-index/false-first
+    # policy returns the lexicographically least model of each
+    # independent component, and extras-minimization distributes over
+    # components (the global minimum is the sum of component minima, and
+    # the lex-least global optimum is the product of component optima) —
+    # so as long as no search backtrack occurs in either the cached
+    # solve or the cone walk, splicing cached-off-cone with cold-on-cone
+    # IS the cold answer.  Any backtrack voids the argument → fallback.
+
+    def assume(self, lits: Sequence[int]) -> None:
+        """Add signed 1-based literals to the current assumption set
+        (``v+1`` assumes variable ``v`` true, ``-(v+1)`` false) — the
+        analog of gini ``Assume``.  Consumed by the next :meth:`test`."""
+        for lit in lits:
+            if lit == 0 or abs(int(lit)) > self.v:
+                raise InternalSolverError(
+                    [f"assumption literal {lit} out of range"])
+            self._assumed_lits.append(int(lit))
+
+    def test(self) -> int:
+        """Propagation-only check of the accumulated assumptions — the
+        analog of gini ``Test``: pushes a scope owning every assumption
+        added since the previous Test, and returns ``SAT`` / ``UNSAT``
+        / ``UNKNOWN`` (SAT only when propagation alone yields a total
+        assignment)."""
+        # The scope STARTS where the previous one ended — recording the
+        # current length instead would make untest() a no-op for the
+        # very assumptions this Test evaluated (review-caught).
+        self._test_scopes.append(self._scope_base)
+        self._scope_base = len(self._assumed_lits)
+        outcome, _ = self._test(
+            guessed=(),
+            extra_true=[lit - 1 for lit in self._assumed_lits if lit > 0],
+            extra_false=[-lit - 1 for lit in self._assumed_lits if lit < 0],
+        )
+        return outcome
+
+    def untest(self) -> int:
+        """Pop the most recent :meth:`test` scope, dropping the
+        assumptions it owned — the analog of gini ``Untest``.  Returns
+        the remaining scope depth."""
+        if not self._test_scopes:
+            raise InternalSolverError(["untest without a matching test"])
+        self._scope_base = self._test_scopes.pop()
+        del self._assumed_lits[self._scope_base:]
+        return len(self._test_scopes)
+
+    def solve_warm(
+        self, warm_assign: np.ndarray, cone_mask: np.ndarray
+    ) -> Tuple[List[Variable], List[int]]:
+        """Warm-started solve: ``warm_assign`` (int8[n_vars], the cached
+        final model as _TRUE/_FALSE) seeds every variable OUTSIDE
+        ``cone_mask``; search, completion, and extras-minimization run
+        over the cone only.  Returns exactly what :meth:`solve` returns
+        on success; raises :class:`WarmStartConflict` whenever identity
+        to a cold solve cannot be certified (the caller falls back)."""
+        p = self.p
+        if p.errors:
+            raise InternalSolverError(p.errors)
+        cone = np.asarray(cone_mask, dtype=bool)
+        off = ~cone
+        warm = np.asarray(warm_assign, dtype=np.int8)
+        off_true = [int(i) for i in np.nonzero(off & (warm == _TRUE))[0]]
+        off_false = [int(i) for i in np.nonzero(off & (warm != _TRUE))[0]]
+
+        # Cold's own first step: a baseline that decides by propagation
+        # alone takes a different (cheap) cold pipeline — fall back.
+        outcome, _ = self._test(guessed=())
+        if outcome != UNKNOWN:
+            raise WarmStartConflict("baseline-decided")
+        # The warm prefix: cached off-cone values must propagate without
+        # conflict.  A conflict here is the chaos case — a stale or
+        # poisoned cached model — and engages the cold fallback.
+        outcome, _ = self._test(guessed=(), extra_true=off_true,
+                                extra_false=off_false)
+        if outcome == UNSAT:
+            raise WarmStartConflict("warm-prefix-conflict")
+
+        result, guessed_order, model = self._search_warm(
+            off_true, off_false, cone)
+        if result != SAT or model is None:
+            raise WarmStartConflict("cone-search-conflict")
+        return self._minimize_warm(model, set(guessed_order),
+                                   off_true, off_false, cone)
+
+    def _search_warm(
+        self, off_true: List[int], off_false: List[int],
+        cone: np.ndarray,
+    ) -> Tuple[int, List[int], Optional[np.ndarray]]:
+        """The preference-ordered guess search of :meth:`_search`,
+        restricted to the cone component: only cone anchors seed the
+        deque (their spawned choices are cone-closed), every Test runs
+        under the warm off-cone prefix, and ANY UNSAT result aborts —
+        zero backtracks is the certification condition, so the cold
+        backtracking machinery is deliberately absent."""
+        p = self.p
+        dq: _deque = _deque()
+        for r in range(len(p.anchors)):
+            if cone[int(p.anchors[r])]:
+                dq.append((r, 0))
+        guesses: List[_Guess] = []
+        result = UNKNOWN
+        model: Optional[np.ndarray] = None
+
+        def assumed_vars() -> List[int]:
+            return [g.var for g in guesses if g.var >= 0]
+
+        while True:
+            if not dq and result == UNKNOWN:
+                ok, m = self._dpll(fixed_true=assumed_vars() + off_true,
+                                   fixed_false=off_false)
+                result = SAT if ok else UNSAT
+                if ok:
+                    model = m
+            if result == UNSAT:
+                return UNSAT, assumed_vars(), None
+            if not dq:
+                break
+            cid, idx = dq.popleft()
+            cands = [int(c) for c in p.choice_cand[cid] if c >= 0]
+            var = cands[idx] if idx < len(cands) else -1
+            assumed = set(assumed_vars())
+            if any(c in assumed for c in cands):
+                var = -1
+            g = _Guess(choice=cid, index=idx, var=var, children=0)
+            guesses.append(g)
+            if var < 0:
+                continue
+            self._count_decision()
+            for ch in p.var_choices[var] if var < len(p.var_choices) else []:
+                if ch >= 0:
+                    g.children += 1
+                    dq.append((int(ch), 0))
+            result, assign = self._test(guessed=assumed_vars(),
+                                        extra_true=off_true,
+                                        extra_false=off_false)
+            if result == SAT:
+                model = assign
+        return result, assumed_vars(), model
+
+    def _minimize_warm(
+        self, model: np.ndarray, guessed: Set[int],
+        off_true: List[int], off_false: List[int], cone: np.ndarray,
+    ) -> Tuple[List[Variable], List[int]]:
+        """Extras-minimization over the cone component only: off-cone
+        variables stay pinned at their cached (already-minimal) values,
+        so the sweep's ``w`` range is the cone's extra count, not the
+        problem's."""
+        p = self.p
+        extras = [
+            i for i in range(self.n)
+            if cone[i] and model[i] == _TRUE and i not in guessed
+        ]
+        excluded = [
+            i for i in range(self.n)
+            if cone[i] and model[i] != _TRUE and i not in guessed
+        ]
+        min_mask = np.zeros(self.n, dtype=bool)
+        min_mask[extras] = True
+        fixed_true = sorted(set(guessed) | set(off_true))
+        fixed_false = excluded + off_false
+        for w in range(len(extras) + 1):
+            ok, m2 = self._dpll(
+                fixed_true=fixed_true,
+                fixed_false=fixed_false,
+                min_mask=min_mask,
+                min_w=w,
+            )
+            if ok:
+                assert m2 is not None
+                installed_idx = [i for i in range(self.n) if m2[i] == _TRUE]
+                return [p.variables[i] for i in installed_idx], installed_idx
+        # Cold minimization failing is an InternalSolverError; a WARM
+        # sweep failing just means the certification regime broke —
+        # answer cold instead of guessing.
+        raise WarmStartConflict("cone-minimization-failed")
 
     # ----------------------------------------------------------- minimize
 

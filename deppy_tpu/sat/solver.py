@@ -67,6 +67,45 @@ class Solver:
         # on the tensor backend — the driver's padding/escalation data.
         self.report: Optional[telemetry.SolveReport] = None
 
+    # ------------------------------------------- incremental (ISSUE 10)
+    #
+    # The gini Assume/Test/Untest surface (reference solve.go:79,99,104)
+    # the paper's L0 table names and the original build never
+    # reproduced.  Scopes run on the host spec engine regardless of the
+    # configured backend: a propagation-only Test is host-cheap, and the
+    # tensor engine's batched entry points have no notion of a pinned
+    # per-solver assumption stack.
+
+    def _scope_engine(self) -> HostEngine:
+        if getattr(self, "_inc_engine", None) is None:
+            self._inc_engine = HostEngine(
+                self.problem, tracer=self.tracer, max_steps=self.max_steps)
+        return self._inc_engine
+
+    def assume(self, *identifiers, installed: bool = True) -> None:
+        """Assume each identifier's variable installed (or not, with
+        ``installed=False``) for subsequent :meth:`test` scopes — the
+        analog of gini ``Assume``."""
+        lits = []
+        for ident in identifiers:
+            idx = self.problem.id_to_index.get(ident)
+            if idx is None:
+                raise InternalSolverError(
+                    [f'variable "{ident}" referenced but not provided'])
+            lits.append((idx + 1) if installed else -(idx + 1))
+        self._scope_engine().assume(lits)
+
+    def test(self) -> int:
+        """Propagation-only check of the assumed scope — gini ``Test``.
+        Returns 1 (sat by propagation), -1 (conflict), 0 (undetermined);
+        pushes a scope that :meth:`untest` pops."""
+        return self._scope_engine().test()
+
+    def untest(self) -> int:
+        """Pop the most recent :meth:`test` scope (gini ``Untest``);
+        returns the remaining scope depth."""
+        return self._scope_engine().untest()
+
     def solve(self) -> List[Variable]:
         backend = resolve_backend(self.backend, batch=False)
         if backend == "host":

@@ -54,7 +54,15 @@ def fingerprint(problem: Problem) -> str:
     permuted alongside) so the hash is invariant to clause emission
     order; everything the decode path reads — identifiers, applied
     constraint strings, every dense tensor with its shape — is folded
-    in, so key equality implies byte-identical rendered responses."""
+    in, so key equality implies byte-identical rendered responses.
+
+    Memoized on the problem object (ISSUE 10 satellite): a Problem's
+    tensors never change after ``encode()``, and the delta tier's
+    lookup/store pairs would otherwise re-row-sort the clause tensor on
+    every consultation."""
+    memo = problem.__dict__.get("_fp_digest")
+    if memo is not None:
+        return memo
     h = hashlib.sha256()
 
     def feed(tag: str, arr: np.ndarray) -> None:
@@ -79,26 +87,48 @@ def fingerprint(problem: Problem) -> str:
     h.update(("\x1f".join(str(v.identifier) for v in problem.variables)
               ).encode())
     h.update(("\x1f".join(str(c) for c in problem.applied)).encode())
-    return h.hexdigest()
+    digest = h.hexdigest()
+    problem.__dict__["_fp_digest"] = digest
+    return digest
+
+
+def _result_nbytes(result) -> int:
+    """Rough per-entry footprint estimate for the ``deppy_cache_bytes``
+    gauge: identifier strings dominate a Solution dict, constraint
+    strings an unsat core.  Documented as an estimate — it sizes
+    capacity planning, not an allocator."""
+    if isinstance(result, dict):
+        return 96 + sum(len(str(k)) + 28 for k in result)
+    cons = getattr(result, "constraints", None)
+    if cons is not None:
+        return 96 + sum(len(str(c)) + 28 for c in cons)
+    return 96
 
 
 class _Entry:
-    __slots__ = ("budget", "result", "definitive")
+    __slots__ = ("budget", "result", "definitive", "nbytes")
 
     def __init__(self, budget: int, result, definitive: bool):
         self.budget = budget
         self.result = result  # Solution dict | NotSatisfiable | None
         self.definitive = definitive
+        self.nbytes = _result_nbytes(result)
 
 
 class ResultCache:
     """Thread-safe LRU keyed by :func:`fingerprint` digests."""
 
     def __init__(self, capacity: int = 1024,
-                 registry: Optional[telemetry.Registry] = None):
+                 registry: Optional[telemetry.Registry] = None,
+                 incremental=None):
         from ..analysis import lockdep
 
         self.capacity = max(int(capacity), 0)
+        # Delta-aware tier (ISSUE 10): a ClauseSetIndex consulted on
+        # exact misses so near-identical problems warm-start instead of
+        # cold-solving.  None = tier off (DEPPY_TPU_INCREMENTAL=off) —
+        # lookup/store behave exactly as before.
+        self.incremental = incremental
         self._lock = lockdep.make_lock("sched.cache")
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         reg = registry if registry is not None \
@@ -119,12 +149,26 @@ class ResultCache:
             "deppy_cache_hit_ratio",
             "Lifetime result-cache hit ratio (hits / lookups).")
         self._ratio.set(0.0)
+        self._g_entries = reg.gauge(
+            "deppy_cache_entries",
+            "Result-cache entries resident right now.")
+        self._g_entries.set(0)
+        self._g_bytes = reg.gauge(
+            "deppy_cache_bytes",
+            "Estimated resident result-cache footprint in bytes "
+            "(identifier/constraint string heuristic).")
+        self._g_bytes.set(0)
+        self._bytes = 0
         self._n_hits = 0
         self._n_lookups = 0
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    def _size_changed_locked(self) -> None:
+        self._g_entries.set(len(self._entries))
+        self._g_bytes.set(self._bytes)
 
     def _account(self, hit: bool) -> None:
         """Caller holds the lock."""
@@ -166,10 +210,29 @@ class ResultCache:
                 self._entries.move_to_end(key)
                 self._account(hit=True)
                 return Incomplete()
+            self._bytes -= e.nbytes
             del self._entries[key]
             self._invalidations.inc()
+            self._size_changed_locked()
             self._account(hit=False)
             return MISS
+
+    def lookup_or_plan(self, problem: Problem, key: str, budget: int):
+        """Exact lookup, then the delta tier: returns ``(hit, None)`` on
+        an exact hit, ``(MISS, WarmPlan)`` when the incremental index
+        can plan a certified warm start for this problem, and
+        ``(MISS, None)`` otherwise (cold path)."""
+        hit = self.lookup(key, budget)
+        if hit is not MISS:
+            if self.incremental is not None:
+                # Exact hits never reach the solve/store path, so the
+                # index's scan-window recency must be refreshed here or
+                # a cycling catalog drifts it off the revisited states.
+                self.incremental.touch(key)
+            return hit, None
+        if self.incremental is None:
+            return MISS, None
+        return MISS, self.incremental.plan(problem, key, budget)
 
     def store(self, key: str, budget: int, result) -> None:
         """Record one solved problem.  ``result`` is a Solution dict, a
@@ -193,15 +256,25 @@ class ResultCache:
                     # A definitive answer supersedes an incomplete one,
                     # and a smaller sufficient budget widens the entry's
                     # hit range (definitive-at-B serves every B' >= B).
-                    self._entries[key] = _Entry(budget, result, True)
+                    self._bytes -= e.nbytes
+                    e = _Entry(budget, result, True)
+                    self._entries[key] = e
+                    self._bytes += e.nbytes
                 elif (not definitive and not e.definitive
                         and budget > e.budget):
                     # A deeper incomplete widens the incomplete range.
-                    self._entries[key] = _Entry(budget, None, False)
+                    self._bytes -= e.nbytes
+                    e = _Entry(budget, None, False)
+                    self._entries[key] = e
+                    self._bytes += e.nbytes
                 self._entries.move_to_end(key)
+                self._size_changed_locked()
                 return
-            self._entries[key] = _Entry(
-                budget, result if definitive else None, definitive)
+            e = _Entry(budget, result if definitive else None, definitive)
+            self._entries[key] = e
+            self._bytes += e.nbytes
             while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= old.nbytes
                 self._evictions.inc()
+            self._size_changed_locked()

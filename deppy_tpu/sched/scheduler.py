@@ -50,6 +50,8 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
+import numpy as np
+
 from .. import faults, telemetry
 from ..sat.constraints import Variable
 from ..sat.encode import Problem, encode
@@ -63,11 +65,30 @@ DEFAULT_MAX_WAIT_MS = 5.0
 DEFAULT_MAX_FILL = 256
 DEFAULT_CACHE_SIZE = 1024
 DEFAULT_MAX_DEPTH = 4096
+DEFAULT_INCREMENTAL_INDEX = 512
+DEFAULT_INCREMENTAL_MAX_DELTA = 0.25
+
+# The "incremental" size class (ISSUE 10): warm-started lanes coalesce
+# with each other — their cost is a handful of host propagation passes,
+# not a device dispatch, so padding them into a cold batch's lanes would
+# waste device width AND serialize near-lookups behind a solve.  Cold
+# classes are power-of-two cost buckets (>= 1), so -1 can never collide.
+INCREMENTAL_CLASS = -1
 
 
 def _env_int(name: str, default: int) -> int:
     v = faults.env_float(name, float(default), warn=True)
     return int(v if v is not None else default)
+
+
+def _solution_dict(problem: Problem, installed_idx) -> dict:
+    """The host-lane decode convention, shared by the host drain and the
+    warm path: every entity id mapped to False, installed set True —
+    exactly what ``driver.decode_results`` renders for a SAT lane."""
+    solution = {v.identifier: False for v in problem.variables}
+    for i in installed_idx:
+        solution[problem.variables[i].identifier] = True
+    return solution
 
 
 class _Lane:
@@ -79,10 +100,12 @@ class _Lane:
     incident worth the flight recorder's error ring)."""
 
     __slots__ = ("problem", "key", "max_steps", "budget", "deadline",
-                 "result", "steps", "degraded")
+                 "result", "steps", "degraded", "warm", "backtracks",
+                 "index_steps")
 
     def __init__(self, problem: Problem, key: str,
-                 max_steps: Optional[int], budget: int, deadline):
+                 max_steps: Optional[int], budget: int, deadline,
+                 warm=None):
         self.problem = problem
         self.key = key
         self.max_steps = max_steps
@@ -91,6 +114,19 @@ class _Lane:
         self.result = None
         self.steps = 0
         self.degraded = False
+        # ISSUE 10: the lane's WarmPlan (incremental size class), and
+        # the solve's observed search-backtrack count — None until a
+        # path that measures it reports in (the clause-set index seeds
+        # warm starts only from zero-backtrack solves, so an unmeasured
+        # lane must never be indexed as zero).  ``index_steps`` is the
+        # COLD-equivalent step cost to index under when it differs from
+        # ``steps``: a warm-served lane's own step count is a fraction
+        # of what a cold solve would spend, and indexing it verbatim
+        # would erode the budget gate that keeps a warm SAT from
+        # shadowing a cold Incomplete at tight budgets.
+        self.warm = warm
+        self.backtracks = None
+        self.index_steps = None
 
 
 class _Group:
@@ -131,6 +167,9 @@ class Scheduler:
         mesh=None,
         mesh_devices: Optional[int] = None,
         lanes_per_device: Optional[int] = None,
+        incremental: Optional[str] = None,
+        incremental_max_delta: Optional[float] = None,
+        incremental_index_size: Optional[int] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -167,7 +206,34 @@ class Scheduler:
                                   DEFAULT_CACHE_SIZE)
         self._registry = registry if registry is not None \
             else telemetry.default_registry()
-        self.cache = ResultCache(cache_size, registry=self._registry)
+        # Incremental tier (ISSUE 10): a delta-aware clause-set index in
+        # front of the exact-fingerprint LRU.  Default on;
+        # DEPPY_TPU_INCREMENTAL=off removes the tier entirely, restoring
+        # the pre-change dispatch byte for byte.
+        from .. import config
+
+        if incremental is None:
+            incremental = config.env_raw("DEPPY_TPU_INCREMENTAL", "on")
+        index = None
+        if str(incremental).strip().lower() not in ("off", "0", "false",
+                                                    "no"):
+            if incremental_max_delta is None:
+                incremental_max_delta = faults.env_float(
+                    "DEPPY_TPU_INCREMENTAL_MAX_DELTA",
+                    DEFAULT_INCREMENTAL_MAX_DELTA, warn=True)
+            if incremental_index_size is None:
+                incremental_index_size = _env_int(
+                    "DEPPY_TPU_INCREMENTAL_INDEX_SIZE",
+                    DEFAULT_INCREMENTAL_INDEX)
+            from ..incremental import ClauseSetIndex
+
+            index = ClauseSetIndex(
+                capacity=incremental_index_size,
+                max_delta_ratio=incremental_max_delta,
+                registry=self._registry)
+        self.incremental = index
+        self.cache = ResultCache(cache_size, registry=self._registry,
+                                 incremental=index)
         reg = self._registry
         self._g_depth = reg.gauge(
             "deppy_sched_queue_depth",
@@ -355,25 +421,66 @@ class Scheduler:
             dl = faults.current_deadline()
         results: List[object] = [None] * len(problems)
         pending: List[tuple] = []
+        warm_pending: List[tuple] = []
         for i, p in enumerate(problems):
             key = fingerprint(p)
-            hit = self.cache.lookup(key, budget)
+            hit, plan = self.cache.lookup_or_plan(p, key, budget)
             if hit is not MISS:
                 results[i] = hit  # bypasses the queue entirely
+            elif plan is not None:
+                # ISSUE 10: a certified warm plan queues in the
+                # incremental size class — warm lanes coalesce with each
+                # other instead of padding out a cold batch.
+                warm_pending.append(
+                    (i, _Lane(p, key, max_steps, budget, dl, warm=plan)))
             else:
                 pending.append((i, _Lane(p, key, max_steps, budget, dl)))
         steps = 0
         report = None
         timing: dict = {}
+        groups: List[tuple] = []
         if pending:
-            group = self._make_group([lane for _, lane in pending], budget)
+            groups.append(
+                (pending, self._make_group([lane for _, lane in pending],
+                                           budget)))
+        if warm_pending:
+            groups.append(
+                (warm_pending,
+                 _Group([lane for _, lane in warm_pending],
+                        INCREMENTAL_CLASS, budget)))
+        for _, group in groups:
             self._enqueue(group)
+        for grp_pending, group in groups:
             group.event.wait()
             if group.error is not None:
                 raise group.error
-            report = group.report
-            timing = group.timing
-            for i, lane in pending:
+            if group.report is not None:
+                if report is None:
+                    report = group.report
+                else:
+                    # Never merge IN PLACE: a group's report object is
+                    # shared with every request coalesced into the same
+                    # dispatch — fold both into a fresh one instead.
+                    merged = telemetry.SolveReport(
+                        backend=report.backend)
+                    merged.n_problems = 0
+                    merged.merge(report)
+                    merged.merge(group.report)
+                    report = merged
+            for k, v in group.timing.items():
+                # A mixed submit spans two dispatches (cold + warm
+                # groups): sequential stage durations ADD — letting the
+                # second group's few-ms warm flush overwrite the first's
+                # device dispatch would misreport the breakdown — but
+                # the groups QUEUE concurrently, so overlapped waits
+                # take the max, not the sum.
+                if isinstance(v, (int, float)) and k in timing:
+                    timing[k] = (max(timing[k], v)
+                                 if k == "queue_wait_s"
+                                 else timing[k] + v)
+                else:
+                    timing[k] = v
+            for i, lane in grp_pending:
                 results[i] = lane.result
                 steps += lane.steps
                 if lane.degraded:
@@ -384,7 +491,7 @@ class Scheduler:
                     # budget-exhaustion Incomplete whose deadline
                     # happened to lapse by readback time.
                     telemetry.trace.mark_error()
-            qw = timing.get("queue_wait_s")
+            qw = group.timing.get("queue_wait_s")
             if qw is not None:
                 # Recorded on the submitting thread so the span joins
                 # THIS request's trace (the wait was measured on the
@@ -539,6 +646,22 @@ class Scheduler:
             # Budget exhaustion is reproducible; deadline degradation
             # is not — only the former may be cached.
             self.cache.store(lane.key, lane.budget, r)
+        # ISSUE 10: SAT models feed the clause-set index so the NEXT
+        # delta against this problem warm-starts.  Only lanes whose path
+        # measured the search-backtrack count are eligible (the index
+        # keeps zero-backtrack seeds only — the warm certification
+        # precondition); degraded lanes never are.
+        if (self.incremental is not None and isinstance(r, dict)
+                and not lane.degraded and lane.backtracks is not None):
+            model = np.fromiter(
+                (bool(r[v.identifier])
+                 for v in lane.problem.variables),
+                dtype=bool, count=lane.problem.n_vars)
+            self.incremental.store(
+                lane.key, lane.problem, model,
+                lane.index_steps if lane.index_steps is not None
+                else lane.steps,
+                lane.backtracks)
 
     # -------------------------------------------------------------- solving
 
@@ -577,7 +700,14 @@ class Scheduler:
                                            n_problems=len(live))
         try:
             with faults.deadline_scope(scope):
-                if backend == "host":
+                if all(lane.warm is not None for lane in live):
+                    # ISSUE 10: an incremental-class flush — warm
+                    # attempts first, cold fallbacks drain through the
+                    # normal backend path below.
+                    t1 = time.perf_counter()
+                    self._solve_incremental(live, rep, timing, backend)
+                    timing["solve_s"] = time.perf_counter() - t1
+                elif backend == "host":
                     t1 = time.perf_counter()
                     self._solve_host(live, rep)
                     timing["solve_s"] = time.perf_counter() - t1
@@ -612,7 +742,67 @@ class Scheduler:
         timing["decode_s"] = time.perf_counter() - t1
         for lane, res, dec in zip(live, results, decoded):
             lane.steps = int(res.steps)
+            lane.backtracks = int(res.trace_n)
             lane.result = dec
+
+    def _solve_incremental(self, live: List[_Lane], rep,
+                           timing: dict, backend: str) -> None:
+        """Drain one incremental-class flush: device-screen the warm
+        prefixes (lockstep, device backend only), run the surviving
+        warm attempts on the host spec engine, and cold-solve every
+        fallback through the NORMAL backend path — fault domain and
+        breaker semantics unchanged.  Per-lane deadlines are admission
+        checks before each warm attempt (the hostpool convention: a
+        lane never preempts mid-solve), so a lapse during the flush
+        degrades only the lanes not yet started."""
+        from .. import incremental as inc
+
+        plans = [lane.warm for lane in live]
+        screened = [True] * len(live)
+        if (backend != "host" and len(live) > 1
+                and not faults.default_breaker().blocks_device()):
+            # The batched device lane variant: one lockstep pass over
+            # the whole warm class instead of per-lane host prefix
+            # tests.  Router only — failures degrade to all-pass — and
+            # an OPEN breaker skips it outright: its contract is zero
+            # device attempts, and a wedged accelerator would hang the
+            # dispatch loop here, not raise (explicit-tpu with an open
+            # breaker is already 503'd at admission; this covers the
+            # race and library callers).
+            screened = inc.screen(plans)
+        cold: List[_Lane] = []
+        for lane, plan, ok in zip(live, plans, screened):
+            if lane.deadline is not None and lane.deadline.expired():
+                faults.note_deadline_exceeded("sched.dispatch")
+                rep.count_outcome("incomplete")
+                lane.result = Incomplete()
+                lane.degraded = True
+                continue
+            res = inc.attempt(plan, lane.max_steps) if ok else None
+            if res is None:
+                if self.incremental is not None:
+                    self.incremental.note_fallback()
+                cold.append(lane)
+                continue
+            lane.result = _solution_dict(lane.problem, res.installed_idx)
+            lane.steps = res.steps
+            lane.backtracks = res.backtracks
+            # Index under a cold-equivalent cost: the seeding entry's
+            # cold steps plus this cone's work bounds what a cold solve
+            # of THIS problem would spend far better than the warm
+            # attempt's own count does.
+            lane.index_steps = plan.entry_steps + res.steps
+            rep.count_outcome("sat")
+            rep.steps += res.steps
+            rep.decisions += res.decisions
+            rep.propagation_rounds += res.propagation_rounds
+            if self.incremental is not None:
+                self.incremental.note_served()
+        if cold:
+            if backend == "host":
+                self._solve_host(cold, rep)
+            else:
+                self._solve_device(cold, timing)
 
     def _solve_host(self, live: List[_Lane], rep) -> None:
         """Host-engine drain — the breaker's host-only mode and the
@@ -639,17 +829,15 @@ class Scheduler:
                     lane.degraded = True
                     continue
                 if r.outcome == "sat":
-                    solution = {v.identifier: False
-                                for v in lane.problem.variables}
-                    for i in r.installed_idx:
-                        solution[lane.problem.variables[i].identifier] = True
-                    lane.result = solution
+                    lane.result = _solution_dict(lane.problem,
+                                                 r.installed_idx)
                 elif r.outcome == "unsat":
                     lane.result = NotSatisfiable(
                         [lane.problem.applied[j] for j in r.core_idx])
                 else:
                     lane.result = Incomplete()
                 lane.steps = r.steps
+                lane.backtracks = r.backtracks
                 rep.count_outcome(r.outcome)
                 rep.steps += r.steps
                 rep.decisions += r.decisions

@@ -227,6 +227,9 @@ class Server:
         sched_max_fill: Optional[int] = None,
         cache_size: Optional[int] = None,
         mesh_devices: Optional[int] = None,
+        incremental: Optional[str] = None,
+        incremental_max_delta: Optional[float] = None,
+        incremental_index_size: Optional[int] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -253,7 +256,10 @@ class Server:
                 max_wait_ms=sched_max_wait_ms, max_fill=sched_max_fill,
                 cache_size=cache_size,
                 registry=self.metrics.registry,
-                mesh_devices=mesh_devices)
+                mesh_devices=mesh_devices,
+                incremental=incremental,
+                incremental_max_delta=incremental_max_delta,
+                incremental_index_size=incremental_index_size)
         # Fault-domain knobs (ISSUE 2).  request_deadline_s: default
         # wall-clock budget per /v1/resolve (clients override per request
         # via the X-Deppy-Deadline-S header; None = unbounded).  drain_s
@@ -790,6 +796,9 @@ def serve(
     sched_max_fill: Optional[int] = None,
     cache_size: Optional[int] = None,
     mesh_devices: Optional[int] = None,
+    incremental: Optional[str] = None,
+    incremental_max_delta: Optional[float] = None,
+    incremental_index_size: Optional[int] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -803,7 +812,9 @@ def serve(
                  request_deadline_s=request_deadline_s, sched=sched,
                  sched_max_wait_ms=sched_max_wait_ms,
                  sched_max_fill=sched_max_fill, cache_size=cache_size,
-                 mesh_devices=mesh_devices)
+                 mesh_devices=mesh_devices, incremental=incremental,
+                 incremental_max_delta=incremental_max_delta,
+                 incremental_index_size=incremental_index_size)
     srv.start()
     stop = threading.Event()
 

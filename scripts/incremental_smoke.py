@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Incremental-resolution smoke test (`make incremental-smoke`, ISSUE 10).
+
+Boots TWO batch-resolution services on ephemeral ports — one with the
+delta-aware incremental tier (the default), one with
+``incremental="off"`` — and replays a churn sequence against both: a
+base catalog, then requests that each change exactly one constraint.
+Asserts the acceptance surface end to end:
+
+  * **byte-identity** — every response body from the incremental
+    service equals the tier-off service's byte for byte;
+  * **warm serving** — the churn deltas are actually served warm
+    (``deppy_incremental_hits_total`` on the ``/metrics`` scrape), with
+    the delta classifier counting them
+    (``deppy_incremental_delta_total``);
+  * **chaos fallback** — a delta that contradicts the cached model
+    still answers correctly, counted as a warm fallback
+    (``deppy_incremental_warm_fallbacks_total``).
+
+Fast on purpose: host backend, no device compile — the full subsystem
+suite is ``make test-incremental`` (tests/test_incremental.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from http.client import HTTPConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_BUNDLES = 6
+BSIZE = 6
+
+
+def request(port: int, method: str, path: str, body=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    headers = {"Content-Type": "application/json"} if body is not None else {}
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def metric(text: str, name: str):
+    total = None
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            total = (total or 0.0) + float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def catalog_doc(tweak=None, poison=False):
+    """One bundle catalog as a /v1/resolve document; ``tweak=(kind, b)``
+    changes one constraint of bundle ``b``; ``poison`` adds a conflict
+    against an installed anchor so the delta contradicts the cached
+    model (the chaos fallback case)."""
+    variables = []
+    for b in range(N_BUNDLES):
+        for j in range(BSIZE):
+            cons = []
+            if j == 0:
+                cons.append({"type": "mandatory"})
+            if j < BSIZE - 2:
+                cons.append({"type": "dependency",
+                             "ids": [f"b{b}v{j + 1}", f"b{b}v{j + 2}"]})
+            if tweak is not None and tweak[1] == b:
+                if tweak[0] == "add-dep" and j == 2:
+                    cons.append({"type": "dependency",
+                                 "ids": [f"b{b}v{BSIZE - 1}",
+                                         f"b{b}v{BSIZE - 2}"]})
+                elif tweak[0] == "add-atmost" and j == 0:
+                    cons.append({"type": "atMost", "n": 1,
+                                 "ids": [f"b{b}v{BSIZE - 2}",
+                                         f"b{b}v{BSIZE - 1}"]})
+            if poison and b == 0 and j == 1:
+                # Conflict with bundle 0's anchor: the cached model has
+                # both installed, so the warm prefix cannot hold.
+                cons.append({"type": "conflict", "id": "b0v0"})
+            variables.append({"id": f"b{b}v{j}", "constraints": cons})
+    return {"variables": variables}
+
+
+def main() -> int:
+    from deppy_tpu.service import Server
+
+    on = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                backend="host")
+    on.start()
+    off = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host", incremental="off")
+    off.start()
+    try:
+        docs = [catalog_doc(),
+                catalog_doc(tweak=("add-dep", 3)),
+                catalog_doc(tweak=("add-atmost", 1)),
+                catalog_doc(tweak=("add-dep", 5)),
+                catalog_doc(poison=True)]
+        for i, doc in enumerate(docs):
+            s_on, b_on = request(on.api_port, "POST", "/v1/resolve", doc)
+            s_off, b_off = request(off.api_port, "POST", "/v1/resolve", doc)
+            assert s_on == s_off == 200, (i, s_on, s_off, b_on, b_off)
+            assert b_on == b_off, (
+                f"doc {i}: incremental response diverges from tier-off\n"
+                f"on:  {b_on!r}\noff: {b_off!r}")
+
+        _, data = request(on.api_port, "GET", "/metrics")
+        text = data.decode()
+        hits = metric(text, "deppy_incremental_hits_total")
+        deltas = metric(text, "deppy_incremental_delta_total")
+        fallbacks = metric(text, "deppy_incremental_warm_fallbacks_total")
+        entries = metric(text, "deppy_cache_entries")
+        assert hits and hits >= 2, \
+            f"churn deltas were not served warm (hits={hits})\n{text}"
+        assert deltas and deltas >= 4, text
+        assert fallbacks and fallbacks >= 1, (
+            f"the poisoned delta did not engage the fallback "
+            f"(fallbacks={fallbacks})\n{text}")
+        assert entries and entries >= 1, text
+        print(f"incremental-smoke: PASS ({len(docs)} churn requests "
+              f"byte-identical to tier-off; {int(hits)} warm hit(s), "
+              f"{int(fallbacks)} chaos fallback(s), "
+              f"{int(deltas)} delta classification(s))")
+        return 0
+    finally:
+        on.shutdown()
+        off.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -205,7 +205,8 @@ class TestJitSurface:
         for name in ("batched_solve", "batched_search", "batched_core",
                      "batched_probe", "batched_minimize_gated",
                      "batched_core_gated", "_planes_fn",
-                     "batched_solve_sharded", "_sharded_fn"):
+                     "batched_solve_sharded", "_sharded_fn",
+                     "batched_warm_check"):
             assert name in entries, f"jit surface lost entry {name}"
             assert entries[name].memoized, f"{name} lost its memo"
             assert entries[name].observed, \

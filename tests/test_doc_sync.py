@@ -32,6 +32,7 @@ CODE_SCOPE = [
     REPO / "deppy_tpu" / "sched",
     REPO / "deppy_tpu" / "hostpool",
     REPO / "deppy_tpu" / "parallel",
+    REPO / "deppy_tpu" / "incremental",
     REPO / "deppy_tpu" / "service.py",
     REPO / "deppy_tpu" / "engine" / "driver.py",
 ]

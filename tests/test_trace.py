@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.client import HTTPConnection
 
 import pytest
@@ -292,7 +293,16 @@ class TestCoalescedPropagation:
                 srv.api_port, "POST", "/v1/resolve", _doc(0),
                 {"traceparent": f"00-{tid}-{'cd' * 8}-01"})
             assert status == 200
-            rec = trace.default_recorder().get(tid)
+            # The handler records the flight trace in its finally —
+            # AFTER the response bytes reach the client (deliberate:
+            # disconnects must still record) — so an immediate read
+            # races it.  Poll briefly instead of asserting instantly.
+            rec = None
+            for _ in range(100):
+                rec = trace.default_recorder().get(tid)
+                if rec is not None:
+                    break
+                time.sleep(0.01)
             assert rec is not None
             assert all(sp["trace_id"] == tid for sp in rec["spans"])
             assert {sp["name"] for sp in rec["spans"]} \
