@@ -92,6 +92,14 @@ incremental-smoke: ## Churn replay against two live services: warm hits, chaos f
 test-incremental: ## Incremental-resolution subsystem tests only (the `incremental` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m incremental
 
+.PHONY: profile-smoke
+profile-smoke: ## Profiled churn+mixed load end to end: armed trip-ledger events, the `deppy profile` cost model, two-tenant SLO burn rate on /metrics + /debug/slo, disarmed byte-identity (ISSUE 11 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/profile_smoke.py
+
+.PHONY: test-profile
+test-profile: ## Profiler + SLO subsystem tests only (the `profile` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m profile
+
 .PHONY: lint
 lint: ## Static analysis: the six deppy-lint checkers vs analysis/baseline.json (ISSUE 7/8 acceptance; docs/analysis.md).
 	$(PYTHON) -m deppy_tpu lint

@@ -175,4 +175,28 @@ def bench_problems(problems: Sequence, host_sample: int = 16,
             "n_buckets": rep.n_buckets,
         }
         log(rep.format_table())
+    # Engine-economics columns (ISSUE 11): one extra, UNTIMED dispatch
+    # with the trip ledger armed at full sampling sources the
+    # useful-work / straggler / pad-waste ratios from the profiler's
+    # own machinery without perturbing the timed rate above — BENCH_r*
+    # trajectories then pin engine economics, not just throughput.
+    from .. import profile
+
+    with profile.override("on", 1.0):
+        dispatch()
+    lrep = telemetry.last_report()
+    if lrep is not None and lrep.profiled_dispatches:
+        out["useful_work_ratio"] = round(lrep.useful_work_ratio, 4)
+        out["straggler_p99_ratio"] = round(lrep.straggler_p99_ratio, 4)
+        out["pad_waste_ratio"] = round(lrep.pad_waste_ratio, 4)
+        log(f"trip ledger: useful {out['useful_work_ratio']:.3f}  "
+            f"straggler-p99 {out['straggler_p99_ratio']:.3f}  "
+            f"pad-waste {out['pad_waste_ratio']:.3f}")
+    else:
+        # The ledger dispatch routed somewhere unprofiled (pure host
+        # path): the columns still exist so record schemas stay fixed.
+        out["useful_work_ratio"] = 0.0
+        out["straggler_p99_ratio"] = 0.0
+        out["pad_waste_ratio"] = round(
+            rep.pad_waste_ratio, 4) if rep is not None else 0.0
     return out

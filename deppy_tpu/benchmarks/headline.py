@@ -88,6 +88,12 @@ def run(n_problems: int = 4096, length: int = 48, host_sample: int = 24,
         # Compile-guard ledger delta over warm-up + timed dispatches
         # (ISSUE 8): how many jit-entry traces the record paid.
         "n_compiles": m["n_compiles"],
+        # Engine-economics columns (ISSUE 11), sourced from the trip
+        # ledger's untimed profiled dispatch: BENCH trajectories pin
+        # what the lockstep trips bought, not just throughput.
+        "useful_work_ratio": m["useful_work_ratio"],
+        "straggler_p99_ratio": m["straggler_p99_ratio"],
+        "pad_waste_ratio": m["pad_waste_ratio"],
     }
     if "telemetry" in m:
         # Occupancy and fallback columns ride in every BENCH row (ISSUE

@@ -129,6 +129,10 @@ def _bench_config(cfg: Dict, host_sample: int = 16) -> Dict:
         # Compile-guard ledger delta (ISSUE 8): jit-entry traces paid
         # by this config's warm-up + timed dispatches.
         "n_compiles": m["n_compiles"],
+        # Engine-economics columns (ISSUE 11) from the trip ledger.
+        "useful_work_ratio": m["useful_work_ratio"],
+        "straggler_p99_ratio": m["straggler_p99_ratio"],
+        "pad_waste_ratio": m["pad_waste_ratio"],
         "sat": m["sat"],
         "unsat": m["unsat"],
     }

@@ -220,6 +220,26 @@ def _build_parser() -> argparse.ArgumentParser:
         "disables the tier; also via DEPPY_TPU_INCREMENTAL_INDEX_SIZE)",
     )
     p_serve.add_argument(
+        "--slo", default=None, metavar="SPEC",
+        help="declarative per-tenant SLO config: inline JSON, @FILE, "
+        "or a path mapping tenant -> {target_p99_s, error_budget} "
+        "(also via DEPPY_TPU_SLO); burn rates ride /metrics and "
+        "/debug/slo",
+    )
+    p_serve.add_argument(
+        "--profile", choices=["on", "off"], default=None,
+        help="engine cost profiler: per-dispatch trip ledger + "
+        "per-backend cost attribution as `profile` sink events and "
+        "deppy_profile_* metric families (default off; also via "
+        "DEPPY_TPU_PROFILE; summarize with `deppy profile`)",
+    )
+    p_serve.add_argument(
+        "--profile-sample", type=float, default=None, metavar="RATE",
+        help="fraction of dispatches the armed profiler samples, in "
+        "(0, 1] (default 1.0; also via DEPPY_TPU_PROFILE_SAMPLE) — "
+        "bounds the armed overhead",
+    )
+    p_serve.add_argument(
         "--mesh-devices", type=_mesh_devices_arg, default=None,
         metavar="N|all",
         help="shard each coalesced micro-batch across N accelerator "
@@ -246,6 +266,31 @@ def _build_parser() -> argparse.ArgumentParser:
     p_stats.add_argument(
         "--span", default=None, metavar="NAME",
         help="summarize only the named span (e.g. driver.solve)",
+    )
+    p_stats.add_argument(
+        "--tenant", default=None, metavar="TENANT",
+        help="summarize only events attributable to TENANT "
+        "(X-Deppy-Tenant): spans whose attrs carry the tenant, "
+        "deadline fault events, and single-tenant profile flushes "
+        "(device dispatches and mixed-tenant flushes carry no tenant "
+        "and are excluded)",
+    )
+
+    p_profile = sub.add_parser(
+        "profile",
+        help="render the engine cost model from a telemetry JSONL "
+        "sink's `profile` events (armed via DEPPY_TPU_PROFILE=on): "
+        "trip-overhead regression, useful-work ratio per size class, "
+        "straggler/pad waste, per-backend us/solve (see "
+        "docs/observability.md, Profiling)",
+    )
+    p_profile.add_argument(
+        "file", nargs="?", default=None,
+        help="telemetry JSONL file (default: $DEPPY_TPU_TELEMETRY_FILE)",
+    )
+    p_profile.add_argument(
+        "--output", choices=["text", "json"], default="text",
+        help="output format (default: text)",
     )
 
     p_trace = sub.add_parser(
@@ -368,6 +413,9 @@ _CONFIG_KEYS = {
     "incremental": ("incremental", str),
     "incrementalMaxDelta": ("incremental_max_delta", float),
     "incrementalIndexSize": ("incremental_index_size", int),
+    "slo": ("slo", str),
+    "profile": ("profile", str),
+    "profileSample": ("profile_sample", float),
 }
 
 
@@ -496,32 +544,22 @@ def _cmd_bench(args) -> int:
 
 
 def _percentile(sorted_vals, q: float) -> float:
-    """Nearest-rank percentile over pre-sorted values (0 on empty)."""
-    if not sorted_vals:
-        return 0.0
-    import math
+    """Nearest-rank percentile over pre-sorted values (0 on empty) —
+    the shared telemetry statistic (one implementation for stats, the
+    trip ledger, and the SLO window)."""
+    from .telemetry import percentile
 
-    idx = max(int(math.ceil(q / 100.0 * len(sorted_vals))) - 1, 0)
-    return sorted_vals[min(idx, len(sorted_vals) - 1)]
+    return percentile(sorted_vals, q)
 
 
 def _iter_sink_events(path: str):
     """Yield one item per non-empty sink line: the parsed event dict, or
-    None for a malformed line (callers count those)."""
-    # errors="replace": a torn write can leave invalid UTF-8 on the
-    # final line of a live sink file — it must count as one malformed
-    # line, not raise UnicodeDecodeError mid-summary.
-    with open(path, "r", encoding="utf-8", errors="replace") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                ev = json.loads(line)
-            except json.JSONDecodeError:
-                yield None
-                continue
-            yield ev if isinstance(ev, dict) else None
+    None for a malformed line (callers count those).  Lives in the
+    telemetry package (the sink's read side); this is the CLI-local
+    alias."""
+    from .telemetry import iter_sink_events
+
+    return iter_sink_events(path)
 
 
 def _cmd_stats(args) -> int:
@@ -543,12 +581,34 @@ def _cmd_stats(args) -> int:
     n_events = 0
     n_bad = 0
     kinds: dict = {}
+    # Trip-ledger tally (ISSUE 11): `profile` events summarize inline
+    # alongside the kind=n line; the full cost model is `deppy profile`.
+    prof = {"events": 0, "trips": 0, "lane_steps": 0,
+            "_useful": 0.0, "_useful_n": 0}
     try:
         for ev in _iter_sink_events(path):
             if ev is None:
                 n_bad += 1
                 continue
+            if args.tenant is not None:
+                # --tenant: keep only events attributable to the tenant
+                # — spans carrying it in attrs (the service.request
+                # root), and fault/profile events stamped with it.
+                if (ev.get("tenant") != args.tenant
+                        and (ev.get("attrs") or {}).get("tenant")
+                        != args.tenant):
+                    continue
             n_events += 1
+            if ev.get("kind") == "profile":
+                prof["events"] += 1
+                prof["trips"] += int(ev.get("trips", 0) or 0)
+                prof["lane_steps"] += int(ev.get("lane_steps", 0) or 0)
+                if ev.get("useful_work_ratio") is not None:
+                    try:
+                        prof["_useful"] += float(ev["useful_work_ratio"])
+                        prof["_useful_n"] += 1
+                    except (TypeError, ValueError):
+                        pass
             kind = ev.get("kind", "?")
             kinds[kind] = kinds.get(kind, 0) + 1
             if ev.get("kind") == "span":
@@ -585,9 +645,16 @@ def _cmd_stats(args) -> int:
         for q in (50, 95, 99):
             agg[f"p{q}_s"] = _percentile(durs, q)
 
+    useful_n = prof.pop("_useful_n")
+    useful_sum = prof.pop("_useful")
+    prof["mean_useful_work_ratio"] = (
+        round(useful_sum / useful_n, 4) if useful_n else None)
+
     if args.output == "json":
         json.dump({"events": n_events, "malformed_lines": n_bad,
                    "event_kinds": kinds,
+                   "tenant": args.tenant,
+                   "profile": (prof if prof["events"] else None),
                    "spans": spans,
                    # --span narrows to one span family in BOTH formats.
                    "last_report": (last_report if args.span is None
@@ -597,6 +664,7 @@ def _cmd_stats(args) -> int:
         return 0
 
     print(f"telemetry: {n_events} events from {path}"
+          + (f" (tenant {args.tenant})" if args.tenant else "")
           + (f" ({n_bad} malformed lines skipped)" if n_bad else ""))
     # Non-span kinds get a one-line tally so fault/breaker/lockdep
     # events are visible from `deppy stats` without a trace id in hand.
@@ -605,6 +673,12 @@ def _cmd_stats(args) -> int:
     if other and args.span is None:
         print("events: " + "  ".join(f"{k}={n}"
                                      for k, n in other.items()))
+    if prof["events"] and args.span is None:
+        useful = prof["mean_useful_work_ratio"]
+        print(f"profile: {prof['events']} events  "
+              f"trips={prof['trips']}  lane_steps={prof['lane_steps']}"
+              + (f"  useful={useful:.3f}" if useful is not None else "")
+              + "  (full cost model: `deppy profile`)")
     if spans:
         width = max(len(n) for n in spans)
         print(f"{'span'.ljust(width)}  {'count':>7}  {'total_s':>9}  "
@@ -697,7 +771,11 @@ def _cmd_trace(args) -> int:
                     _take_span(sp)
                 for fe in trace.get("events", []):
                     _take_event(fe)
-            elif kind in ("fault", "breaker", "lockdep"):
+            elif kind in ("fault", "breaker", "lockdep", "profile"):
+                # `profile` events (ISSUE 11) are stamped like fault
+                # events when a dispatch trace was active — the span
+                # tree then shows the trip ledger of the dispatch that
+                # served the request.
                 _take_event(ev)
     except FileNotFoundError:
         print(f"error: no such file: {path}", file=sys.stderr)
@@ -805,6 +883,40 @@ def _cmd_trace(args) -> int:
         for i, e in enumerate(orphans):
             print(("└─ " if i == len(orphans) - 1 else "├─ ")
                   + _fmt_event(e))
+    return 0
+
+
+def _cmd_profile(args) -> int:
+    """Render the engine cost model from a sink's ``profile`` events
+    (ISSUE 11): trip-overhead regression, useful-work ratio per size
+    class, straggler/pad waste breakdowns, per-backend µs/solve — the
+    continuously-collected version of the hand-run A/B trip-overhead
+    model (see docs/observability.md, Profiling)."""
+    from . import config
+    from .profile import report as profile_report
+
+    path = args.file or config.env_raw("DEPPY_TPU_TELEMETRY_FILE")
+    if not path:
+        print("error: no telemetry file (pass FILE or set "
+              "DEPPY_TPU_TELEMETRY_FILE)", file=sys.stderr)
+        return 2
+    try:
+        summary = profile_report.summarize(path)
+    except FileNotFoundError:
+        print(f"error: no such file: {path}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    if args.output == "json":
+        json.dump(summary, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+    if not summary["profile_events"]:
+        print(f"no profile events in {path} (arm with "
+              f"DEPPY_TPU_PROFILE=on and a telemetry sink)")
+        return 0
+    print(profile_report.render_text(summary, path))
     return 0
 
 
@@ -927,6 +1039,9 @@ def _cmd_serve(args) -> int:
         "incremental": None,
         "incremental_max_delta": None,
         "incremental_index_size": None,
+        "slo": None,
+        "profile": None,
+        "profile_sample": None,
     }
     try:
         if args.config:
@@ -946,6 +1061,9 @@ def _cmd_serve(args) -> int:
             ("incremental", args.incremental),
             ("incremental_max_delta", args.incremental_max_delta),
             ("incremental_index_size", args.incremental_index_size),
+            ("slo", args.slo),
+            ("profile", args.profile),
+            ("profile_sample", args.profile_sample),
         ):
             if val is not None:
                 kwargs[key] = val
@@ -956,6 +1074,15 @@ def _cmd_serve(args) -> int:
             from . import hostpool
 
             hostpool.configure_pool(host_workers)
+        # Profiler arming is process-global too (ISSUE 11): installed
+        # here, at the process entry point, never inside Server — an
+        # embedded server must not leak arming into its process.
+        prof_mode = kwargs.pop("profile", None)
+        prof_sample = kwargs.pop("profile_sample", None)
+        if prof_mode is not None or prof_sample is not None:
+            from . import profile as profiling
+
+            profiling.configure(mode=prof_mode, sample=prof_sample)
         serve(**kwargs)
     except FileNotFoundError:
         print(f"error: no such file: {args.config}", file=sys.stderr)
@@ -985,6 +1112,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_stats(args)
     if args.command == "trace":
         return _cmd_trace(args)
+    if args.command == "profile":
+        return _cmd_profile(args)
     if args.command == "compiles":
         return _cmd_compiles(args)
     if args.command == "lint":

@@ -80,6 +80,24 @@ _DECLARATIONS: List[EnvVar] = [
     _v("DEPPY_TPU_TRACE_ERROR_RING", "int", 256, "deppy_tpu.telemetry.trace",
        "Flight-recorder error ring: errored traces retained separately "
        "so healthy bursts cannot evict incident context."),
+    # --- profiler / SLO --------------------------------------------------
+    _v("DEPPY_TPU_PROFILE", "str", "off", "deppy_tpu.profile.ledger",
+       "Engine cost profiler: 'on' records the per-dispatch trip "
+       "ledger (`profile` sink events, deppy_profile_* families, "
+       "SolveReport ledger columns; also --profile).  Disarmed is "
+       "byte-identical to the pre-profiler pipeline.",
+       flag="--profile", config_key="profile"),
+    _v("DEPPY_TPU_PROFILE_SAMPLE", "float", 1.0,
+       "deppy_tpu.profile.ledger",
+       "Fraction of dispatches the armed profiler samples, in (0, 1] "
+       "(deterministic 1-in-N; also --profile-sample) — bounds the "
+       "armed overhead.",
+       flag="--profile-sample", config_key="profileSample"),
+    _v("DEPPY_TPU_SLO", "str", None, "deppy_tpu.profile.slo",
+       "Declarative per-tenant SLO config: inline JSON, @FILE, or a "
+       "path mapping tenant -> {target_p99_s, error_budget} (also "
+       "--slo); burn rates render on /metrics and /debug/slo.",
+       flag="--slo", config_key="slo"),
     # --- faults ----------------------------------------------------------
     _v("DEPPY_TPU_FAULT_PLAN", "str", None, "deppy_tpu.faults.inject",
        "Fault-injection plan: inline JSON, @FILE, or a file path (also "

@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from .. import config, faults, telemetry
+from .. import profile as _profile
 from ..analysis import compileguard
 from ..sat.constraints import Variable
 from ..sat.encode import Problem, encode
@@ -694,6 +695,20 @@ def _host_core_rows(problems, idx, d: _Dims, budget, spent,
     return cores, steps
 
 
+def _profile_dispatch(t0, problems, d: _Dims, steps: np.ndarray,
+                      live: int, total: int, chunk: int) -> None:
+    """Trip-ledger hook shared by the dispatch impls (ISSUE 11): runs
+    only for dispatches :func:`profile.dispatch_t0` sampled, strictly
+    AFTER the result fetch (host numpy in hand — never inside traced
+    code).  ``steps`` are the dispatch's final per-lane counts, live
+    lanes first; ``chunk`` is the lockstep program width."""
+    _profile.record_device_dispatch(
+        t0, steps=steps, live=live, chunk=chunk,
+        size_class=_bucket(max(_cost_proxy(p) for p in problems)),
+        pad_cells=int(total) * d.C * d.K,
+        live_cells=int(sum(p.clauses.size for p in problems)))
+
+
 def _solve_monolith(problems, budget, mesh, trace_cap,
                     _spmd_entry: bool = False) -> List[core.SolveResult]:
     """Single-dispatch path (one jitted program, all phases lane-gated):
@@ -702,6 +717,7 @@ def _solve_monolith(problems, budget, mesh, trace_cap,
     jitted program for :func:`batched_solve_sharded` — same vmapped
     solve, explicit PartitionSpec shardings over ``mesh`` — the SPMD
     spelling of the mesh entry (:func:`_solve_spmd`)."""
+    prof_t0 = _profile.dispatch_t0()
     n = len(problems)
     d = _Dims(problems, max(n, 1), batch_multiple=mesh.size if mesh is not None else 1)
     host_core = any(p.n_cons > HOST_CORE_NCONS for p in problems)
@@ -739,10 +755,20 @@ def _solve_monolith(problems, budget, mesh, trace_cap,
     steps = np.asarray(res.steps).astype(np.int64)
     trace_stack = np.asarray(res.trace_stack)
     trace_n = np.asarray(res.trace_n)
+    # Ledger steps snapshot BEFORE host-core patching: the trip model
+    # is about lockstep device while-trips, and folding the host spec
+    # engine's core-sweep iterations into a lane's count would inflate
+    # trips with work the device loop never executed (biasing the
+    # us/trip regression the profiler exists to produce).
+    prof_steps = steps.copy() if (prof_t0 is not None and host_core) \
+        else steps
     if host_core:
         outcome, cores, steps = _host_core_patch(
             problems, d, budget, outcome, cores, steps,
             allow_device=mesh is None)
+    if prof_t0 is not None:
+        _profile_dispatch(prof_t0, problems, d, prof_steps, live=n,
+                          total=int(d.B), chunk=int(d.B))
     return [
         core.SolveResult(outcome[i], installed[i], cores[i], steps[i],
                          trace_stack[i], trace_n[i])
@@ -800,6 +826,8 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
     to the lanes that need it (SURVEY.md §7.3 item 4's divergence
     mitigation).  All chunks of a phase dispatch asynchronously (device
     work pipelines) and their results come back in one batched fetch."""
+    prof_t0 = _profile.dispatch_t0()
+    prof_steps = None  # device-only ledger snapshot (set on host route)
     n = len(problems)
     # MAX_LANES caps every dispatch, mesh or not: sharding divides lanes
     # across devices but each worker still executes its shard of one
@@ -960,6 +988,11 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
                 steps[dev_idx] = st_c[keep]
             if host_idx.size:
                 cores[host_idx] = host_cores
+                if prof_t0 is not None:
+                    # Device-only snapshot for the trip ledger (see
+                    # _solve_monolith): host spec-engine core steps are
+                    # not lockstep trips.
+                    prof_steps = steps.copy()
                 steps[host_idx] = steps[host_idx].astype(np.int64) + host_steps
     if trace_cap > 0:
         trace_stack = np.concatenate(fetched["tr"])
@@ -972,6 +1005,10 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
         | ((result == core.SAT) & ~min_found)
     )
     outcome = np.where(incomplete, core.RUNNING, result).astype(np.int32)
+    if prof_t0 is not None:
+        _profile_dispatch(prof_t0, problems, d,
+                          prof_steps if prof_steps is not None else steps,
+                          live=n, total=total, chunk=CH)
     return [
         core.SolveResult(outcome[i], installed[i], cores[i], steps[i],
                          trace_stack[i], trace_n[i])
@@ -1100,11 +1137,19 @@ def _fault_results_host(problems, budget, reason: str) -> List[core.SolveResult]
     d = _Dims(problems, max(len(problems), 1))
     out: List[core.SolveResult] = []
     dl = faults.current_deadline()
+    prof_t0 = _profile.dispatch_t0("hostpool")
     with reg.span("driver.fault_host_fallback", problems=len(problems),
                   reason=reason):
         lanes = hostpool.solve_host_problems(
             problems, max_steps=int(budget),
             deadlines=[dl] * len(problems))
+        if prof_t0 is not None:
+            # Per-backend cost attribution (ISSUE 11): breaker-open /
+            # fault-routed groups account under "hostpool".
+            _profile.record_backend_flush(
+                "hostpool", len(problems),
+                int(sum(r.steps for r in lanes)),
+                _time.perf_counter() - prof_t0)
         n_degraded = sum(1 for r in lanes if r.degraded)
         if n_degraded:
             faults.note_deadline_exceeded("driver.host_fallback",

@@ -189,16 +189,22 @@ def ambient_deadline() -> Iterator[Optional[Deadline]]:
         yield dl
 
 
-def note_deadline_exceeded(where: str, n_problems: int = 0) -> None:
+def note_deadline_exceeded(where: str, n_problems: int = 0,
+                           tenant: Optional[str] = None) -> None:
     """Count one deadline expiry (``deppy_deadline_exceeded``) and emit a
     ``fault`` event to the telemetry sink.  Under an active trace
     context (ISSUE 4) the event is also stamped onto the request's span
     tree and marks the trace errored, so the flight recorder retains
-    every deadline-degraded request in its error ring."""
+    every deadline-degraded request in its error ring.  ``tenant``
+    (ISSUE 11: the scheduler's triage knows whose lane expired) rides
+    the event so deadline misses are attributable per tenant offline;
+    callers without tenant context emit the historical event shape."""
     from .. import telemetry
     from .metrics import fault_counter
 
     fault_counter("deppy_deadline_exceeded").inc()
+    fields = {"where": where, "problems": n_problems}
+    if tenant is not None:
+        fields["tenant"] = tenant
     telemetry.default_registry().event(
-        "fault", fault="deadline_exceeded", where=where,
-        problems=n_problems)
+        "fault", fault="deadline_exceeded", **fields)

@@ -1,0 +1,64 @@
+"""deppy_tpu.profile — engine cost profiler + per-tenant SLO accounting
+(ISSUE 11 tentpole).
+
+Every remaining ROADMAP lever is gated on measurement this package
+collects continuously instead of by hand:
+
+  * **ledger** — the per-dispatch trip ledger: lockstep while-trip
+    counts vs per-lane useful work, straggler distribution (p50/p99
+    lane work vs batch trips), pad/fill waste per size class, and
+    per-backend cost attribution.  Sampled at a registry-declared rate
+    (``DEPPY_TPU_PROFILE`` / ``DEPPY_TPU_PROFILE_SAMPLE``) so the armed
+    overhead is bounded; disarmed, the whole subsystem is one cached
+    bool check per dispatch and emits nothing.  Sampled dispatches emit
+    ``profile`` events into the PR 1 JSONL sink (stamped onto the
+    active PR 4 trace), update the ``deppy_profile_*`` metric families,
+    and fill the :class:`~deppy_tpu.telemetry.SolveReport` ledger
+    fields the bench economics columns read.
+  * **slo** — per-tenant SLO accounting: tenant identity from the
+    ``X-Deppy-Tenant`` header threaded through scheduler groups, a
+    declarative SLO config (``DEPPY_TPU_SLO``: target p99 + error
+    budget per tenant), per-tenant request/latency/deadline-miss
+    counters, and burn-rate gauges on ``/metrics`` + ``/debug/slo``.
+  * **report** — the ``deppy profile`` CLI: reads the sink and renders
+    the cost model the A/B history computed by hand — trip-overhead
+    regression, useful-work ratio per size class, straggler/pad waste
+    breakdowns, per-backend µs/solve.  This report is the baseline
+    artifact the watched-literal kernel rewrite (ROADMAP item 1) must
+    beat.
+
+See docs/observability.md (Profiling / SLO accounting) for the event
+schema, metric tables, and sampling semantics.
+"""
+
+from .ledger import (
+    DEFAULT_TENANT,
+    PROFILE_FAMILIES,
+    armed,
+    configure,
+    dispatch_t0,
+    override,
+    record_backend_flush,
+    record_device_dispatch,
+    render_metric_lines,
+    sample_rate,
+)
+from .slo import (SLOAccountant, SLOConfig, sanitize_tenant,
+                  slo_config_from_env)
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "PROFILE_FAMILIES",
+    "SLOAccountant",
+    "SLOConfig",
+    "render_metric_lines",
+    "armed",
+    "configure",
+    "dispatch_t0",
+    "override",
+    "record_backend_flush",
+    "record_device_dispatch",
+    "sample_rate",
+    "sanitize_tenant",
+    "slo_config_from_env",
+]

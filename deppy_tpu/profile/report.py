@@ -1,0 +1,167 @@
+"""Offline cost-model rendering for ``deppy profile`` (ISSUE 11).
+
+Reads a telemetry JSONL sink and reproduces, from ``profile`` events
+alone, the cost model the A/B history computed by hand:
+
+  * **trip-overhead regression** — least-squares fit of dispatch wall
+    clock against lockstep trip count across sampled device
+    dispatches: the slope is µs per while-trip (the ~175µs/trip figure
+    of ROADMAP item 1), the intercept the per-dispatch fixed cost
+    (pad/pack + upload + launch), and slope × mean useful-work ratio
+    estimates the useful µs bought per trip;
+  * **useful-work ratio per size class** — how much of each class's
+    lockstep lane-step slots carried live work;
+  * **straggler and pad waste breakdowns** per size class;
+  * **per-backend µs/solve** — device / host / hostpool / warm cost
+    attribution.
+
+The rendered report is the baseline artifact the watched-literal
+kernel rewrite (PR 12) must beat.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def summarize(path: str) -> dict:
+    """Aggregate a sink's ``profile`` events into the cost model."""
+    from ..telemetry import iter_sink_events
+
+    device: List[dict] = []
+    backends: Dict[str, dict] = {}
+    n_events = 0
+    for ev in iter_sink_events(path):
+        if ev is None or ev.get("kind") != "profile":
+            continue
+        n_events += 1
+        backend = str(ev.get("backend", "?"))
+        agg = backends.setdefault(
+            backend, {"events": 0, "lanes": 0, "solve_s": 0.0})
+        agg["events"] += 1
+        agg["lanes"] += int(ev.get("live", ev.get("lanes", 0)) or 0)
+        try:
+            agg["solve_s"] += float(ev.get("solve_s", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            pass
+        if "trips" in ev:
+            device.append(ev)
+    for agg in backends.values():
+        agg["solve_s"] = round(agg["solve_s"], 6)
+        agg["us_per_solve"] = (
+            round(agg["solve_s"] * 1e6 / agg["lanes"], 2)
+            if agg["lanes"] else 0.0)
+    return {
+        "profile_events": n_events,
+        "device_dispatches": len(device),
+        "trip_overhead": _trip_regression(device),
+        "size_classes": _size_classes(device),
+        "backends": backends,
+    }
+
+
+def _trip_regression(device: List[dict]) -> Optional[dict]:
+    """solve_s ~ intercept + slope * trips over the sampled device
+    dispatches.  None when the sink lacks two dispatches with distinct
+    trip counts (a constant can't be regressed)."""
+    import numpy as np
+
+    pts = [(float(ev["trips"]), float(ev.get("solve_s", 0.0) or 0.0))
+           for ev in device
+           if ev.get("trips") is not None and ev.get("solve_s")]
+    if len(pts) < 2:
+        return None
+    x = np.array([p[0] for p in pts])
+    y = np.array([p[1] for p in pts])
+    if float(x.max() - x.min()) <= 0:
+        return None
+    slope, intercept = np.polyfit(x, y, 1)
+    pred = slope * x + intercept
+    ss_res = float(((y - pred) ** 2).sum())
+    ss_tot = float(((y - y.mean()) ** 2).sum())
+    ratios = [float(ev.get("useful_work_ratio", 0.0) or 0.0)
+              for ev in device]
+    mean_useful = sum(ratios) / len(ratios) if ratios else 0.0
+    return {
+        "points": len(pts),
+        "us_per_trip": round(float(slope) * 1e6, 3),
+        "intercept_ms": round(float(intercept) * 1e3, 3),
+        "r2": round(1.0 - ss_res / ss_tot, 4) if ss_tot > 0 else 1.0,
+        "mean_useful_work_ratio": round(mean_useful, 4),
+        "useful_us_per_trip": round(float(slope) * 1e6 * mean_useful, 3),
+    }
+
+
+def _size_classes(device: List[dict]) -> Dict[str, dict]:
+    classes: Dict[str, dict] = {}
+    for ev in device:
+        key = str(ev.get("size_class", "?"))
+        agg = classes.setdefault(key, {
+            "dispatches": 0, "lanes": 0, "live": 0, "trips": 0,
+            "lane_steps": 0, "solve_s": 0.0,
+            "_useful": 0.0, "_straggler": 0.0, "_pad": 0.0,
+        })
+        agg["dispatches"] += 1
+        agg["lanes"] += int(ev.get("lanes", 0) or 0)
+        agg["live"] += int(ev.get("live", 0) or 0)
+        agg["trips"] += int(ev.get("trips", 0) or 0)
+        agg["lane_steps"] += int(ev.get("lane_steps", 0) or 0)
+        agg["solve_s"] += float(ev.get("solve_s", 0.0) or 0.0)
+        agg["_useful"] += float(ev.get("useful_work_ratio", 0.0) or 0.0)
+        agg["_straggler"] += float(
+            ev.get("straggler_p99_ratio", 0.0) or 0.0)
+        agg["_pad"] += float(ev.get("pad_waste_ratio", 0.0) or 0.0)
+    for agg in classes.values():
+        n = agg["dispatches"]
+        agg["useful_work_ratio"] = round(agg.pop("_useful") / n, 4)
+        agg["straggler_p99_ratio"] = round(agg.pop("_straggler") / n, 4)
+        agg["pad_waste_ratio"] = round(agg.pop("_pad") / n, 4)
+        agg["us_per_solve"] = (round(agg["solve_s"] * 1e6 / agg["live"], 2)
+                               if agg["live"] else 0.0)
+        agg["solve_s"] = round(agg["solve_s"], 6)
+    return classes
+
+
+def render_text(summary: dict, path: str) -> str:
+    lines = [f"profile: {summary['profile_events']} profile events from "
+             f"{path} ({summary['device_dispatches']} device dispatches)"]
+    reg = summary.get("trip_overhead")
+    if reg is not None:
+        lines += [
+            "trip overhead (solve wall ~ trips, least squares):",
+            f"  {reg['us_per_trip']:.1f} us/trip  "
+            f"(+{reg['intercept_ms']:.2f} ms fixed/dispatch, "
+            f"r2={reg['r2']}, {reg['points']} dispatches)",
+            f"  useful work: {reg['mean_useful_work_ratio']:.3f} of "
+            f"trip-lane slots -> ~{reg['useful_us_per_trip']:.1f} "
+            f"useful us/trip",
+        ]
+    else:
+        lines.append(
+            "trip overhead: not enough device dispatches with distinct "
+            "trip counts (need >= 2; arm DEPPY_TPU_PROFILE=on and vary "
+            "the workload)")
+    classes = summary.get("size_classes") or {}
+    if classes:
+        lines.append("size classes:")
+        lines.append(f"  {'class':>10}  {'disp':>5}  {'live':>6}  "
+                     f"{'trips':>8}  {'useful':>7}  {'p99/trip':>8}  "
+                     f"{'padwaste':>8}  {'us/solve':>9}")
+        for key in sorted(classes, key=lambda k: (len(k), k)):
+            a = classes[key]
+            lines.append(
+                f"  {key:>10}  {a['dispatches']:>5}  {a['live']:>6}  "
+                f"{a['trips']:>8}  {a['useful_work_ratio']:>7.3f}  "
+                f"{a['straggler_p99_ratio']:>8.3f}  "
+                f"{a['pad_waste_ratio']:>8.3f}  {a['us_per_solve']:>9.1f}")
+    backends = summary.get("backends") or {}
+    if backends:
+        lines.append("backends:")
+        lines.append(f"  {'backend':>10}  {'events':>6}  {'lanes':>7}  "
+                     f"{'solve_s':>9}  {'us/solve':>9}")
+        for name in sorted(backends):
+            a = backends[name]
+            lines.append(f"  {name:>10}  {a['events']:>6}  "
+                         f"{a['lanes']:>7}  {a['solve_s']:>9.3f}  "
+                         f"{a['us_per_solve']:>9.1f}")
+    return "\n".join(lines)

@@ -53,6 +53,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .. import faults, telemetry
+from .. import profile as _profile
 from ..sat.constraints import Variable
 from ..sat.encode import Problem, encode
 from ..sat.errors import Incomplete, InternalSolverError, NotSatisfiable
@@ -81,6 +82,13 @@ def _env_int(name: str, default: int) -> int:
     return int(v if v is not None else default)
 
 
+def _single_tenant(lanes: List["_Lane"]) -> Optional[str]:
+    """The one tenant a flush serves, or None when mixed — profile
+    events are tenant-stamped only when attribution is unambiguous."""
+    tenants = {lane.tenant for lane in lanes}
+    return tenants.pop() if len(tenants) == 1 else None
+
+
 def _solution_dict(problem: Problem, installed_idx) -> dict:
     """The host-lane decode convention, shared by the host drain and the
     warm path: every entity id mapped to False, installed set True —
@@ -101,11 +109,11 @@ class _Lane:
 
     __slots__ = ("problem", "key", "max_steps", "budget", "deadline",
                  "result", "steps", "degraded", "warm", "backtracks",
-                 "index_steps")
+                 "index_steps", "tenant")
 
     def __init__(self, problem: Problem, key: str,
                  max_steps: Optional[int], budget: int, deadline,
-                 warm=None):
+                 warm=None, tenant: str = "default"):
         self.problem = problem
         self.key = key
         self.max_steps = max_steps
@@ -127,6 +135,10 @@ class _Lane:
         self.warm = warm
         self.backtracks = None
         self.index_steps = None
+        # ISSUE 11: the submitting request's tenant (X-Deppy-Tenant),
+        # carried per lane so a deadline expiry at triage attributes to
+        # the tenant whose lane expired, never a coalesced batchmate's.
+        self.tenant = tenant
 
 
 class _Group:
@@ -394,12 +406,17 @@ class Scheduler:
         deadline_s: Optional[float] = None,
         max_steps: Optional[int] = None,
         stats: Optional[dict] = None,
+        tenant: str = "default",
     ) -> List[object]:
         """Resolve ``problem_vars`` through the shared queue; blocks
         until every problem has an answer and returns them in input
         order (Solution dict / NotSatisfiable / Incomplete — the
         BatchResolver contract).  ``stats`` receives ``{"steps": N,
-        "report": SolveReport-or-None}`` like the driver's entry points.
+        "report": SolveReport-or-None}`` like the driver's entry
+        points, plus ``deadline_misses`` — the count of THIS submit's
+        lanes the deadline triage degraded (ISSUE 11: the service's
+        per-tenant SLO accountant attributes them to ``tenant``, which
+        also rides every lane for fault-event attribution).
 
         Raises what the unscheduled path raises: DuplicateIdentifier
         from encoding, InternalSolverError for unresolvable references
@@ -432,10 +449,13 @@ class Scheduler:
                 # incremental size class — warm lanes coalesce with each
                 # other instead of padding out a cold batch.
                 warm_pending.append(
-                    (i, _Lane(p, key, max_steps, budget, dl, warm=plan)))
+                    (i, _Lane(p, key, max_steps, budget, dl, warm=plan,
+                              tenant=tenant)))
             else:
-                pending.append((i, _Lane(p, key, max_steps, budget, dl)))
+                pending.append((i, _Lane(p, key, max_steps, budget, dl,
+                                         tenant=tenant)))
         steps = 0
+        deadline_misses = 0
         report = None
         timing: dict = {}
         groups: List[tuple] = []
@@ -484,6 +504,7 @@ class Scheduler:
                 results[i] = lane.result
                 steps += lane.steps
                 if lane.degraded:
+                    deadline_misses += 1
                     # Precise error attribution (ISSUE 4): the deadline
                     # fault event rode the shared dispatch trace, but
                     # only THIS request's lane was triaged expired —
@@ -502,6 +523,7 @@ class Scheduler:
             stats["steps"] = steps
             stats["report"] = report
             stats["timings"] = dict(timing)
+            stats["deadline_misses"] = deadline_misses
         return results
 
     def _make_group(self, lanes: List[_Lane], budget: int) -> _Group:
@@ -678,8 +700,11 @@ class Scheduler:
         for lane in lanes:
             if lane.deadline is not None and lane.deadline.expired():
                 # Expired at triage: degrade THIS lane only — its
-                # batchmates dispatch unharmed.
-                faults.note_deadline_exceeded("sched.dispatch")
+                # batchmates dispatch unharmed.  The fault event carries
+                # the lane's tenant (ISSUE 11) so deadline misses are
+                # attributable per tenant from the sink alone.
+                faults.note_deadline_exceeded("sched.dispatch",
+                                              tenant=lane.tenant)
                 lane.result = Incomplete()
                 lane.steps = 0
                 lane.degraded = True
@@ -757,6 +782,9 @@ class Scheduler:
         degrades only the lanes not yet started."""
         from .. import incremental as inc
 
+        prof_t0 = _profile.dispatch_t0("warm")
+        warm_served = 0
+        warm_steps = 0
         plans = [lane.warm for lane in live]
         screened = [True] * len(live)
         if (backend != "host" and len(live) > 1
@@ -773,7 +801,8 @@ class Scheduler:
         cold: List[_Lane] = []
         for lane, plan, ok in zip(live, plans, screened):
             if lane.deadline is not None and lane.deadline.expired():
-                faults.note_deadline_exceeded("sched.dispatch")
+                faults.note_deadline_exceeded("sched.dispatch",
+                                              tenant=lane.tenant)
                 rep.count_outcome("incomplete")
                 lane.result = Incomplete()
                 lane.degraded = True
@@ -792,12 +821,22 @@ class Scheduler:
             # of THIS problem would spend far better than the warm
             # attempt's own count does.
             lane.index_steps = plan.entry_steps + res.steps
+            warm_served += 1
+            warm_steps += res.steps
             rep.count_outcome("sat")
             rep.steps += res.steps
             rep.decisions += res.decisions
             rep.propagation_rounds += res.propagation_rounds
             if self.incremental is not None:
                 self.incremental.note_served()
+        if prof_t0 is not None and warm_served:
+            # ISSUE 11: warm-tier cost attribution — the screen + warm
+            # attempts up to here; cold fallbacks account under their
+            # own backend (device via the driver ledger, host below).
+            _profile.record_backend_flush(
+                "warm", warm_served, warm_steps,
+                time.perf_counter() - prof_t0,
+                tenant=_single_tenant(live))
         if cold:
             if backend == "host":
                 self._solve_host(cold, rep)
@@ -816,14 +855,22 @@ class Scheduler:
         from .. import hostpool
 
         reg = telemetry.default_registry()
+        prof_t0 = _profile.dispatch_t0("host")
         with reg.span("sched.host_solve", problems=len(live)):
             results = hostpool.solve_host_problems(
                 [lane.problem for lane in live],
                 max_steps=[lane.max_steps for lane in live],
                 deadlines=[lane.deadline for lane in live])
+            if prof_t0 is not None:
+                _profile.record_backend_flush(
+                    "host", len(live),
+                    int(sum(r.steps for r in results)),
+                    time.perf_counter() - prof_t0,
+                    tenant=_single_tenant(live))
             for lane, r in zip(live, results):
                 if r.degraded:
-                    faults.note_deadline_exceeded("sched.host_solve")
+                    faults.note_deadline_exceeded("sched.host_solve",
+                                                  tenant=lane.tenant)
                     rep.count_outcome("incomplete")
                     lane.result = Incomplete()
                     lane.degraded = True

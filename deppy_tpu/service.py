@@ -34,6 +34,7 @@ from typing import Dict, Optional, Tuple
 
 from . import config, faults
 from . import io as problem_io
+from . import profile as profiling
 from . import telemetry
 from .sat.errors import (BackendCapabilityError, DuplicateIdentifier,
                          InternalSolverError)
@@ -104,6 +105,9 @@ class Metrics:
         self.registry = registry if registry is not None else telemetry.Registry()
         self._engine_probe = engine_usable_probe
         self.leader: Optional[bool] = None  # None = election disabled
+        # Per-tenant SLO accountant (ISSUE 11): set by the owning
+        # Server; its deppy_tenant_* families append to every scrape.
+        self.slo: Optional[profiling.SLOAccountant] = None
         r = self.registry
         self._resolutions = r.counter(
             "deppy_resolutions_total", "Problems resolved by outcome.",
@@ -205,6 +209,18 @@ class Metrics:
         from . import hostpool
 
         lines += hostpool.render_metric_lines()
+        # Profiler families (ISSUE 11): the trip ledger records on the
+        # pipeline-global default registry (where the driver runs);
+        # mirror them into the scrape like the fault/hostpool families.
+        # Absent until a sampled dispatch, so disarmed scrapes are
+        # unchanged.
+        lines += profiling.render_metric_lines()
+        # Per-tenant SLO families (ISSUE 11): request / deadline-miss /
+        # violation counters plus p99 and burn-rate gauges, one line
+        # per observed tenant — absent until the first request lands,
+        # so a tenant-free deployment's scrape is unchanged.
+        if self.slo is not None:
+            lines += self.slo.render_metric_lines()
         return "\n".join(lines) + "\n"
 
 
@@ -230,11 +246,24 @@ class Server:
         incremental: Optional[str] = None,
         incremental_max_delta: Optional[float] = None,
         incremental_index_size: Optional[int] = None,
+        slo: Optional[str] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
         self.max_body_bytes = max_body_bytes
         self.metrics = Metrics()
+        # Per-tenant SLO accounting (ISSUE 11): tenant identity from
+        # X-Deppy-Tenant, targets from the declarative SLO spec
+        # (--slo / DEPPY_TPU_SLO: inline JSON, @FILE, or a path).
+        # Profiler arming is NOT a Server concern: like the host worker
+        # pool, the profiler is process-global state, owned by the
+        # process entry point (`deppy serve --profile`, cli._cmd_serve)
+        # — a Server installing it would leak arming across embedded
+        # servers that come and go.
+        self.slo = profiling.SLOAccountant(
+            profiling.slo_config_from_env() if slo is None
+            else profiling.SLOConfig.from_spec(slo))
+        self.metrics.slo = self.slo
         self.ready = threading.Event()
         self._stop = threading.Event()
         # Cross-request continuous batching + result cache (ISSUE 3):
@@ -354,7 +383,10 @@ class Server:
 
     def resolve_document(self, doc,
                          deadline_s: Optional[float] = None,
-                         timings: Optional[dict] = None) -> Tuple[int, dict]:
+                         timings: Optional[dict] = None,
+                         tenant: str = "default",
+                         request_stats: Optional[dict] = None,
+                         ) -> Tuple[int, dict]:
         """Resolve one request body; returns (http_status, response_doc).
         A 503 response carries ``retry_after_s`` (the handler mirrors it
         into a ``Retry-After`` header).  ``timings``, when given,
@@ -362,7 +394,12 @@ class Server:
         ``queue_wait_s`` / ``dispatch_s`` / ``solve_s`` / ``decode_s``
         from the scheduler (or ``solve_s`` alone on the unscheduled
         path) — the handler feeds it to the latency histograms and, on
-        ``X-Deppy-Timings: 1``, into the response body."""
+        ``X-Deppy-Timings: 1``, into the response body.  ``tenant``
+        (ISSUE 11) rides the scheduler's lanes for deadline-miss
+        attribution; ``request_stats``, when given, receives
+        ``{"deadline_misses": N}`` for the SLO accountant — kept apart
+        from ``timings`` so the opt-in response body stays exactly the
+        documented stage breakdown."""
         faults.inject("service.resolve")
         if deadline_s is None:
             deadline_s = self.request_deadline_s
@@ -388,11 +425,15 @@ class Server:
                 # or are served straight from the result cache.
                 stats: dict = {}
                 results = self.scheduler.submit(
-                    problems, deadline_s=deadline_s, stats=stats)
+                    problems, deadline_s=deadline_s, stats=stats,
+                    tenant=tenant)
                 steps = stats.get("steps", 0)
                 report = stats.get("report")
                 if timings is not None:
                     timings.update(stats.get("timings") or {})
+                if request_stats is not None:
+                    request_stats["deadline_misses"] = \
+                        stats.get("deadline_misses", 0)
             else:
                 from .resolution.facade import BatchResolver
 
@@ -421,6 +462,17 @@ class Server:
             r = problem_io.result_to_dict(res)
             outcomes[r["status"]] += 1
             rendered.append(r)
+        if (request_stats is not None
+                and "deadline_misses" not in request_stats
+                and deadline_s is not None):
+            # Unscheduled path (no per-lane triage verdicts): a request
+            # that ran past its configured deadline AND reports
+            # incomplete lanes was deadline-degraded — degradation
+            # implies wall >= deadline, and within-deadline budget
+            # exhaustion must not count as a miss.
+            elapsed = time.perf_counter() - t0
+            request_stats["deadline_misses"] = (
+                outcomes["incomplete"] if elapsed >= deadline_s else 0)
         self.metrics.observe_batch(outcomes, time.perf_counter() - t0,
                                    steps=steps, report=report)
         return 200, {"results": rendered}
@@ -613,6 +665,13 @@ def _api_handler(server: Server):
                            "text/plain; version=0.0.4")
             elif self.path.split("?", 1)[0] == "/debug/traces":
                 self._debug_traces()
+            elif self.path.split("?", 1)[0] == "/debug/slo":
+                # Per-tenant SLO accounting (ISSUE 11): every observed
+                # tenant's counters, window p99 vs target, and
+                # error-budget burn rate.
+                self._send(200, json.dumps(
+                    {"slo": server.slo.snapshot()}, sort_keys=True),
+                    "application/json")
             else:
                 self._send_json(404, {"error": "not found"})
 
@@ -666,7 +725,15 @@ def _api_handler(server: Server):
             self._echo_traceparent = inbound_tp is not None
             want_timings = (self.headers.get("X-Deppy-Timings") or "") \
                 .strip().lower() in ("1", "true", "yes")
+            # Tenant identity (ISSUE 11): X-Deppy-Tenant, sanitized to
+            # a metric-label-safe id; absent/empty = the default
+            # tenant.  Rides the root span's attrs (so `deppy stats
+            # --tenant` filters from sink lines alone), the scheduler's
+            # lanes, and the SLO accountant below.
+            tenant = profiling.sanitize_tenant(
+                self.headers.get("X-Deppy-Tenant"))
             timings: dict = {}
+            request_stats: dict = {}
             t0 = time.perf_counter()
             reg = telemetry.default_registry()
             status = None
@@ -676,9 +743,10 @@ def _api_handler(server: Server):
                 # (no flight-recorder dump required).
                 with telemetry.trace.activate(ctx), \
                         reg.span("service.request", path="/v1/resolve",
-                                 request_id=ctx.request_id) as sp:
-                    status = self._resolve_request_inner(t0, timings,
-                                                         want_timings)
+                                 request_id=ctx.request_id,
+                                 tenant=tenant) as sp:
+                    status = self._resolve_request_inner(
+                        t0, timings, want_timings, tenant, request_stats)
                     sp["status"] = status
             finally:
                 # Runs even when the handler dies mid-response (client
@@ -692,10 +760,20 @@ def _api_handler(server: Server):
                 timings["total_s"] = time.perf_counter() - t0
                 server.metrics.observe_request(timings["total_s"],
                                                timings.get("queue_wait_s"))
+                # SLO accounting (ISSUE 11): every request lands on its
+                # tenant's window — deadline misses from the
+                # scheduler's triage, errors from the final status.
+                server.slo.observe(
+                    tenant, timings["total_s"],
+                    deadline_miss=bool(
+                        request_stats.get("deadline_misses")),
+                    error=status is None or status >= 500)
                 telemetry.trace.default_recorder().record(
                     ctx, status=status, timings=timings)
 
-        def _resolve_request_inner(self, t0, timings, want_timings) -> int:
+        def _resolve_request_inner(self, t0, timings, want_timings,
+                                   tenant="default",
+                                   request_stats=None) -> int:
             # Per-request deadline override: seconds of wall-clock budget
             # the client grants this resolve (proxy chains decrement it).
             deadline_s = None
@@ -740,7 +818,8 @@ def _api_handler(server: Server):
                                        {"error": f"invalid JSON body: {e}"})
             try:
                 status, resp = server.resolve_document(
-                    doc, deadline_s=deadline_s, timings=timings)
+                    doc, deadline_s=deadline_s, timings=timings,
+                    tenant=tenant, request_stats=request_stats)
             except Exception as e:  # solver/runtime failure → a real 500,
                 # visible to the caller and the error counter, instead of a
                 # dropped connection from the handler's default traceback.
@@ -799,6 +878,7 @@ def serve(
     incremental: Optional[str] = None,
     incremental_max_delta: Optional[float] = None,
     incremental_index_size: Optional[int] = None,
+    slo: Optional[str] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -814,7 +894,8 @@ def serve(
                  sched_max_fill=sched_max_fill, cache_size=cache_size,
                  mesh_devices=mesh_devices, incremental=incremental,
                  incremental_max_delta=incremental_max_delta,
-                 incremental_index_size=incremental_index_size)
+                 incremental_index_size=incremental_index_size,
+                 slo=slo)
     srv.start()
     stop = threading.Event()
 

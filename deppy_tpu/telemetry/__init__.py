@@ -25,6 +25,8 @@ from .registry import (
     Span,
     configure_sink,
     default_registry,
+    iter_sink_events,
+    percentile,
     set_default_registry,
 )
 from .report import (
@@ -54,6 +56,8 @@ __all__ = [
     "default_registry",
     "detach_report",
     "end_report",
+    "iter_sink_events",
     "last_report",
+    "percentile",
     "set_default_registry",
 ]

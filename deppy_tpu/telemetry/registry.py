@@ -51,6 +51,42 @@ LANE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
                 512.0, 1024.0, 2048.0, 4096.0)
 
 
+def iter_sink_events(path: str):
+    """Yield one item per non-empty line of a JSONL sink file: the
+    parsed event dict, or None for a malformed line (callers count
+    those).  The read-side twin of :meth:`Registry.emit`, shared by
+    every sink consumer (`deppy stats`/`trace`/`compiles`/`profile`
+    and :mod:`deppy_tpu.profile.report`)."""
+    # errors="replace": a torn write can leave invalid UTF-8 on the
+    # final line of a live sink file — it must count as one malformed
+    # line, not raise UnicodeDecodeError mid-summary.
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                yield None
+                continue
+            yield ev if isinstance(ev, dict) else None
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile over pre-sorted values (0 on empty) —
+    THE percentile statistic, shared by `deppy stats`, the trip
+    ledger's lane-work distribution, and the SLO window's p99 so the
+    three can never silently diverge."""
+    import math
+
+    n = len(sorted_vals)
+    if n == 0:
+        return 0
+    idx = min(max(int(math.ceil(q / 100.0 * n)) - 1, 0), n - 1)
+    return sorted_vals[idx]
+
+
 def _fmt(v) -> str:
     """Sample-value formatting: ints stay ints, floats render via str()
     (matching the service's historical f-string rendering, so pinned

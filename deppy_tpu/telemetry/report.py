@@ -62,6 +62,22 @@ class SolveReport:
     # core-extraction routing above, mirroring the
     # deppy_fault_host_routed_total counter.
     fault_host_routed: int = 0
+    # Trip-ledger fields (ISSUE 11): filled only for dispatches the
+    # profiler sampled (deppy_tpu.profile; DEPPY_TPU_PROFILE=on), zero
+    # otherwise.  All four are sums, so they merge exactly like the
+    # other sequential-stage counters (mesh shards, checkpoint groups,
+    # mixed cold/warm submits): ledger_trips sums per-chunk lockstep
+    # while-trips (max lane steps per chunk), ledger_trip_slots sums
+    # trips x chunk lanes (the lockstep lane-step slots paid),
+    # ledger_lane_steps sums live lanes' useful iterations, and
+    # ledger_p99_trips sums per-chunk p99 lane work (the straggler
+    # numerator).  The derived ratios below are what the bench
+    # economics columns carry.
+    profiled_dispatches: int = 0
+    ledger_trips: int = 0
+    ledger_trip_slots: int = 0
+    ledger_lane_steps: int = 0
+    ledger_p99_trips: int = 0
     # Wall-clock per pipeline stage, seconds: pad_pack, device_put,
     # solve (whole driver call), plus anything a caller adds.
     wall: Dict[str, float] = field(default_factory=dict)
@@ -86,6 +102,16 @@ class SolveReport:
     def note_escalation(self, stage: int) -> None:
         self.escalation_stage = max(self.escalation_stage, stage)
 
+    def record_ledger(self, trips: int, trip_slots: int, lane_steps: int,
+                      p99_trips: int) -> None:
+        """One sampled dispatch's trip ledger (ISSUE 11; accumulates
+        across buckets, chunks, shards, and checkpoint groups)."""
+        self.profiled_dispatches += 1
+        self.ledger_trips += trips
+        self.ledger_trip_slots += trip_slots
+        self.ledger_lane_steps += lane_steps
+        self.ledger_p99_trips += p99_trips
+
     def merge(self, other: "SolveReport") -> None:
         """Fold a sub-report into this one — the mesh-serving path runs
         one pipeline per device on worker threads, each filling its own
@@ -103,7 +129,9 @@ class SolveReport:
                            "propagation_rounds", "batch_lanes",
                            "live_lanes", "pad_cells", "live_cells",
                            "n_chunks", "n_buckets", "host_fallback_rows",
-                           "fault_host_routed"):
+                           "fault_host_routed", "profiled_dispatches",
+                           "ledger_trips", "ledger_trip_slots",
+                           "ledger_lane_steps", "ledger_p99_trips"):
             setattr(self, field_name,
                     getattr(self, field_name) + getattr(other, field_name))
         self.escalation_stage = max(self.escalation_stage,
@@ -130,6 +158,27 @@ class SolveReport:
             return 0.0
         return 1.0 - self.live_cells / self.pad_cells
 
+    @property
+    def useful_work_ratio(self) -> float:
+        """Useful lane steps / lockstep trip-lane slots over the
+        profiled dispatches (ISSUE 11; 0.0 when nothing was sampled).
+        Low means while-trips were spent idling behind padding and
+        stragglers — the quantity the watched-literal rewrite must
+        raise."""
+        if self.ledger_trip_slots <= 0:
+            return 0.0
+        return self.ledger_lane_steps / self.ledger_trip_slots
+
+    @property
+    def straggler_p99_ratio(self) -> float:
+        """p99 lane work / batch trips over the profiled dispatches
+        (trips-weighted; 0.0 when nothing was sampled).  Low means the
+        slowest lane — past even the p99 lane — drove the batch's trip
+        count alone."""
+        if self.ledger_trips <= 0:
+            return 0.0
+        return self.ledger_p99_trips / self.ledger_trips
+
     @classmethod
     def from_dict(cls, d: dict) -> "SolveReport":
         """Rebuild a report from its :meth:`to_dict` JSON form (the
@@ -145,7 +194,10 @@ class SolveReport:
                            "propagation_rounds", "batch_lanes",
                            "live_lanes", "pad_cells", "live_cells",
                            "n_chunks", "n_buckets", "escalation_stage",
-                           "host_fallback_rows", "fault_host_routed"):
+                           "host_fallback_rows", "fault_host_routed",
+                           "profiled_dispatches", "ledger_trips",
+                           "ledger_trip_slots", "ledger_lane_steps",
+                           "ledger_p99_trips"):
             setattr(rep, field_name, int(d.get(field_name, 0) or 0))
         walls = d.get("wall_s")
         if isinstance(walls, dict):
@@ -172,6 +224,13 @@ class SolveReport:
             "escalation_stage": self.escalation_stage,
             "host_fallback_rows": self.host_fallback_rows,
             "fault_host_routed": self.fault_host_routed,
+            "profiled_dispatches": self.profiled_dispatches,
+            "ledger_trips": self.ledger_trips,
+            "ledger_trip_slots": self.ledger_trip_slots,
+            "ledger_lane_steps": self.ledger_lane_steps,
+            "ledger_p99_trips": self.ledger_p99_trips,
+            "useful_work_ratio": round(self.useful_work_ratio, 4),
+            "straggler_p99_ratio": round(self.straggler_p99_ratio, 4),
             "wall_s": {k: round(v, 6) for k, v in self.wall.items()},
         }
 
@@ -195,6 +254,12 @@ class SolveReport:
             f"  host fallback:     {d['host_fallback_rows']} rows"
             f"  (fault-routed problems: {d['fault_host_routed']})",
         ]
+        if d["profiled_dispatches"]:
+            lines.append(
+                f"  trip ledger:       useful {d['useful_work_ratio']:.3f}"
+                f"  straggler-p99 {d['straggler_p99_ratio']:.3f}"
+                f"  ({d['ledger_trips']} trips over "
+                f"{d['profiled_dispatches']} sampled dispatches)")
         if d["wall_s"]:
             walls = "  ".join(
                 f"{k}={v * 1e3:.1f}ms" for k, v in sorted(d["wall_s"].items())

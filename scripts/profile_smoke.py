@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Profiler + SLO smoke test (`make profile-smoke`, ISSUE 11 acceptance).
+
+Four checks, fast on purpose (forced-CPU platform, small batches):
+
+1. **Armed ledger.**  A churn+mixed-load run (varied batch sizes so
+   trip counts differ) with ``DEPPY_TPU_PROFILE=on`` and a telemetry
+   sink emits ``profile`` events carrying trips/lane work, and
+   ``deppy profile`` reproduces a trip-overhead estimate (a
+   least-squares µs/trip figure) from the sink alone — no hand
+   instrumentation.
+2. **Disarmed is inert.**  The same dispatches with the profiler off
+   add ZERO profile events to a fresh sink.
+3. **Two-tenant SLO.**  A live service under a two-tenant load — one
+   tenant driven past its deadline budget by an injected dispatch
+   latency — shows per-tenant burn rate on ``/metrics`` and
+   ``/debug/slo``, with the overdriven tenant burning and the healthy
+   one not.
+4. **Response byte-identity.**  The resolve response body is identical
+   armed vs disarmed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from http.client import HTTPConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+GOLDEN = os.path.join(REPO, "test", "e2e", "problem.json")
+
+
+def request(port: int, method: str, path: str, body=None, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=60)
+    hdrs = dict(headers or {})
+    if body is not None:
+        hdrs.setdefault("Content-Type", "application/json")
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def check_ledger(tmpdir: str) -> None:
+    """Armed churn+mixed-load dispatches → profile events → a
+    trip-overhead estimate from `deppy profile`."""
+    from deppy_tpu import cli, profile, telemetry
+    from deppy_tpu.engine import driver
+    from deppy_tpu.models import random_instance
+    from deppy_tpu.sat.encode import encode
+
+    sink = os.path.join(tmpdir, "ledger.jsonl")
+    telemetry.default_registry().configure_sink(sink)
+    # Mixed load: varied sizes and batch widths vary the trip counts,
+    # so the regression has distinct x points.
+    with profile.override("on", 1.0):
+        for n, length in ((4, 12), (12, 24), (24, 40)):
+            problems = [encode(random_instance(length=length, seed=s))
+                        for s in range(n)]
+            driver.solve_problems(problems)
+    telemetry.default_registry().configure_sink(None)
+    events = [json.loads(l) for l in open(sink, encoding="utf-8")]
+    profs = [e for e in events if e.get("kind") == "profile"]
+    assert len(profs) >= 3, f"expected >=3 profile events, got {len(profs)}"
+    assert all("trips" in e for e in profs), "device events must carry trips"
+
+    from deppy_tpu.profile import report as profile_report
+
+    summary = profile_report.summarize(sink)
+    reg = summary["trip_overhead"]
+    assert reg is not None, (
+        f"no trip-overhead regression from {len(profs)} events: {profs}")
+    assert reg["points"] >= 3 and reg["us_per_trip"] != 0.0, reg
+    rc = cli.main(["profile", sink])
+    assert rc == 0, f"deppy profile rc={rc}"
+
+    # Disarmed: the same dispatches add zero profile events.
+    sink2 = os.path.join(tmpdir, "disarmed.jsonl")
+    telemetry.default_registry().configure_sink(sink2)
+    with profile.override("off"):
+        problems = [encode(random_instance(length=24, seed=s))
+                    for s in range(8)]
+        driver.solve_problems(problems)
+    telemetry.default_registry().configure_sink(None)
+    disarmed = [json.loads(l) for l in open(sink2, encoding="utf-8")
+                if json.loads(l).get("kind") == "profile"]
+    assert not disarmed, f"disarmed profiler emitted: {disarmed}"
+    print(f"profile-smoke: ledger OK ({len(profs)} profile events, "
+          f"{reg['us_per_trip']:.1f} us/trip over {reg['points']} "
+          f"dispatches, disarmed inert)")
+
+
+def check_slo() -> None:
+    """Two-tenant load with one tenant driven past its deadline budget
+    (injected dispatch latency + tight X-Deppy-Deadline-S): burn rate
+    visible on /metrics and /debug/slo; responses byte-identical armed
+    vs disarmed."""
+    from deppy_tpu import faults, profile
+    from deppy_tpu.service import Server
+
+    with open(GOLDEN, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+
+    faults.configure_plan(faults.plan_from_spec(
+        '[{"point": "sched.dispatch", "kind": "latency",'
+        ' "latency_s": 0.05, "times": -1}]'))
+    slo = json.dumps({
+        "gold": {"target_p99_s": 5.0, "error_budget": 0.01},
+        "churny": {"target_p99_s": 5.0, "error_budget": 0.01},
+    })
+    # cache_size=0: every request must queue (a cache hit would bypass
+    # the dispatch whose injected latency drives churny past budget).
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host", slo=slo, cache_size=0)
+    srv.start()
+    try:
+        bodies = {}
+        for tenant, deadline in (("gold", None), ("churny", "0.01")):
+            headers = {"X-Deppy-Tenant": tenant}
+            if deadline:
+                headers["X-Deppy-Deadline-S"] = deadline
+            for _ in range(4):
+                status, data = request(srv.api_port, "POST",
+                                       "/v1/resolve", doc,
+                                       headers=headers)
+                assert status == 200, (tenant, status, data)
+                bodies[tenant] = data
+        # Armed-vs-disarmed byte identity on the response body.
+        with profile.override("on", 1.0):
+            status, armed_body = request(
+                srv.api_port, "POST", "/v1/resolve", doc,
+                headers={"X-Deppy-Tenant": "gold"})
+        assert status == 200 and armed_body == bodies["gold"], (
+            "armed profiler changed the response body")
+
+        status, data = request(srv.api_port, "GET", "/debug/slo")
+        assert status == 200
+        slo_doc = json.loads(data)["slo"]
+        assert "gold" in slo_doc and "churny" in slo_doc, slo_doc
+        assert slo_doc["churny"]["deadline_misses"] >= 1, slo_doc
+        assert slo_doc["churny"]["burn_rate"] > 1.0, slo_doc
+        assert slo_doc["gold"]["burn_rate"] == 0.0, slo_doc
+
+        status, data = request(srv.api_port, "GET", "/metrics")
+        assert status == 200
+        text = data.decode()
+        for needle in ('deppy_tenant_burn_rate{tenant="churny"}',
+                       'deppy_tenant_burn_rate{tenant="gold"}',
+                       'deppy_tenant_deadline_miss_total{tenant="churny"}',
+                       'deppy_tenant_p99_seconds{tenant="gold"}',
+                       # The armed request above sampled a flush: the
+                       # profiler families must ride the scrape too.
+                       'deppy_profile_backend_lanes_total{backend='):
+            assert needle in text, f"{needle} missing from /metrics"
+        print(f"profile-smoke: SLO OK (churny burn "
+              f"{slo_doc['churny']['burn_rate']}, gold burn "
+              f"{slo_doc['gold']['burn_rate']}; bodies byte-identical)")
+    finally:
+        srv.shutdown()
+        faults.configure_plan(None)
+
+
+def main() -> int:
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        check_ledger(tmpdir)
+    check_slo()
+    print("profile-smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,45 @@ def _pool_usable() -> bool:
 needs_pool = pytest.mark.skipif(
     not _pool_usable(), reason="process pool unavailable in this sandbox")
 
+_DEFAULT_POOL_SKIP = None
+
+
+def _default_pool_skip_reason():
+    """Skip reason for the pool-CONSUMER tests, or None when the
+    default pool serves them (ISSUE 11 satellite).
+
+    The consumer tests assert pool-side accounting
+    (``deppy_hostpool_lanes_total``) through the DEFAULT pool — the
+    entry every production consumer uses — so the direct
+    ``HostPool(workers=1)`` fork probe above is the wrong gate: a
+    sandbox can fork one explicit worker yet never engage the default
+    pool (single-core boxes disable it implicitly, and fork-restricted
+    containers mark it sticky-unavailable on first spawn).  Detect via
+    the pool's own sticky signals — a probe dispatch, then
+    ``available`` — so real pool breakage on a pool-capable box still
+    fails loudly while sandbox-environmental inline fallback skips
+    with its reason."""
+    global _DEFAULT_POOL_SKIP
+    if _DEFAULT_POOL_SKIP is None:
+        pool = hostpool.default_pool()
+        if pool is None:
+            _DEFAULT_POOL_SKIP = (
+                "default host pool disabled in this sandbox "
+                "(cpu_count < 2 or DEPPY_TPU_HOST_WORKERS=0): "
+                "consumers run the inline fallback")
+        else:
+            try:
+                pool.solve([encode(random_instance(length=16, seed=0))] * 2)
+            except hostpool.HostPoolError:
+                pass  # the sticky signal below carries the reason
+            if pool.available:
+                _DEFAULT_POOL_SKIP = ""
+            else:
+                _DEFAULT_POOL_SKIP = (
+                    "default host pool sticky-unavailable (sandbox "
+                    f"denies fork): {pool._unavailable}")
+    return _DEFAULT_POOL_SKIP or None
+
 
 @pytest.fixture(autouse=True)
 def fresh_fault_state():
@@ -261,8 +300,16 @@ class TestFaults:
 # --------------------------------------------- consumers ride the same path
 
 
-@needs_pool
 class TestConsumers:
+    @pytest.fixture(autouse=True)
+    def _require_default_pool(self):
+        # Lazy (per-test, cached) rather than a module-level skipif:
+        # the probe spawns the process-global default pool, and every
+        # pytest invocation that merely COLLECTS this module must not
+        # pay a fork + solve — only the three tests that need it.
+        reason = _default_pool_skip_reason()
+        if reason is not None:
+            pytest.skip(reason)
     def test_breaker_open_sched_drain_byte_identical(self, monkeypatch):
         """ISSUE 5 acceptance: with the breaker open the scheduler's
         queue drains through the pool, and the rendered responses are

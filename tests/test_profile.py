@@ -1,0 +1,718 @@
+"""Engine cost profiler + per-tenant SLO accounting (ISSUE 11).
+
+Coverage map:
+
+  * **Trip ledger** — armed driver dispatches fill the SolveReport
+    ledger fields, emit `profile` sink events, and update the
+    deppy_profile_* families; disarmed is inert (zero events, no
+    families); sampling is deterministic 1-in-N.
+  * **Merge rules** (ISSUE 11 satellite) — ledger fields obey the PR 9
+    conventions (sum sequential stages, max concurrent queue waits)
+    across mixed cold/warm scheduler submits and sharded mesh
+    dispatches with profiling armed.
+  * **SLO accounting** — tenant sanitation, declarative config,
+    sliding-window burn rate, /metrics + /debug/slo rendering, and the
+    chaos-style two-tenant acceptance pin (fault-plan latency driving
+    one tenant past its deadline budget).
+  * **CLI** — `deppy profile` trip-overhead regression from a sink,
+    `deppy stats --tenant` filtering + profile tally, `deppy trace`
+    rendering profile events.
+  * **Bench columns** — harness records carry useful_work_ratio /
+    straggler_p99_ratio / pad_waste_ratio from the ledger.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from deppy_tpu import faults, profile, sat, telemetry
+from deppy_tpu.models import random_instance
+from deppy_tpu.sat.encode import encode
+
+pytestmark = pytest.mark.profile
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    """Isolate the process-global breaker/plan/registry per test (the
+    chaos/sched suites' contract) and leave the profiler disarmed."""
+    prev_breaker = faults.set_default_breaker(faults.CircuitBreaker())
+    prev_plan = faults.configure_plan(None)
+    prev_reg = telemetry.set_default_registry(telemetry.Registry())
+    profile.configure(mode=None, sample=None)  # re-resolve from env
+    yield
+    telemetry.set_default_registry(prev_reg)
+    faults.configure_plan(prev_plan)
+    faults.set_default_breaker(prev_breaker)
+    profile.configure(mode=None, sample=None)
+
+
+def _fuzz(n, length=24):
+    return [encode(random_instance(length=length, seed=s))
+            for s in range(n)]
+
+
+def _sink_events(path):
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def bundle_catalog(n_bundles=4, bsize=6, tweak=None):
+    """The churn workload shape (tests/test_incremental.py): dependency
+    bundles where ``tweak=(kind, bundle)`` mutates exactly one."""
+    vs = []
+    for b in range(n_bundles):
+        for j in range(bsize):
+            cons = []
+            if j == 0:
+                cons.append(sat.mandatory())
+            if j < bsize - 2:
+                cons.append(sat.dependency(f"b{b}v{j + 1}",
+                                           f"b{b}v{j + 2}"))
+            if tweak is not None and tweak[1] == b and tweak[0] == "add-dep" \
+                    and j == 2:
+                cons.append(sat.dependency(f"b{b}v{bsize - 1}",
+                                           f"b{b}v{bsize - 2}"))
+            vs.append(sat.variable(f"b{b}v{j}", *cons))
+    return vs
+
+
+# --------------------------------------------------------------- trip ledger
+
+
+class TestLedger:
+    def test_armed_dispatch_fills_report_and_sink(self, tmp_path):
+        from deppy_tpu.engine import driver
+
+        sink = tmp_path / "t.jsonl"
+        telemetry.default_registry().configure_sink(str(sink))
+        with profile.override("on", 1.0):
+            driver.solve_problems(_fuzz(12))
+        telemetry.default_registry().configure_sink(None)
+        rep = telemetry.last_report()
+        assert rep.profiled_dispatches == 1
+        assert rep.ledger_trips > 0
+        assert rep.ledger_trip_slots >= rep.ledger_trips
+        assert rep.ledger_lane_steps > 0
+        assert 0.0 < rep.useful_work_ratio <= 1.0
+        assert 0.0 < rep.straggler_p99_ratio <= 1.0
+        profs = [e for e in _sink_events(sink) if e["kind"] == "profile"]
+        assert len(profs) == 1
+        ev = profs[0]
+        assert ev["backend"] == "device"
+        assert ev["trips"] == rep.ledger_trips
+        assert ev["lane_steps"] == rep.ledger_lane_steps
+        assert ev["live"] == 12
+        assert ev["lane_p50"] <= ev["lane_p99"] <= ev["trips"]
+        assert 0.0 <= ev["pad_waste_ratio"] <= 1.0
+        assert ev["solve_s"] > 0
+        snap = telemetry.default_registry().snapshot()
+        assert snap["deppy_profile_dispatches_total"] == 1
+        assert snap["deppy_profile_trips_total"] == rep.ledger_trips
+        assert snap["deppy_profile_backend_lanes_total"]["device"] == 12
+
+    def test_disarmed_is_inert(self, tmp_path):
+        from deppy_tpu.engine import driver
+
+        sink = tmp_path / "t.jsonl"
+        telemetry.default_registry().configure_sink(str(sink))
+        with profile.override("off"):
+            driver.solve_problems(_fuzz(8))
+        telemetry.default_registry().configure_sink(None)
+        assert not [e for e in _sink_events(sink)
+                    if e["kind"] == "profile"]
+        rep = telemetry.last_report()
+        assert rep.profiled_dispatches == 0
+        assert rep.useful_work_ratio == 0.0
+        snap = telemetry.default_registry().snapshot()
+        assert "deppy_profile_dispatches_total" not in snap
+
+    def test_sampling_is_one_in_n(self):
+        from deppy_tpu.engine import driver
+
+        with profile.override("on", 0.5):
+            for _ in range(4):
+                driver.solve_problems(_fuzz(4, length=12))
+        snap = telemetry.default_registry().snapshot()
+        assert snap["deppy_profile_dispatches_total"] == 2
+
+    def test_sampling_counters_are_per_site(self):
+        """Regression: one global modulo counter phase-locks under
+        periodic call patterns (warm flush then device dispatch would
+        alternate slots at interval 2, never sampling one site) —
+        each site keeps its own 1-in-N cadence."""
+        with profile.override("on", 0.5):
+            hits = {"device": 0, "warm": 0}
+            for _ in range(4):
+                # Interleave exactly like an incremental serving loop.
+                if profile.dispatch_t0("warm") is not None:
+                    hits["warm"] += 1
+                if profile.dispatch_t0("device") is not None:
+                    hits["device"] += 1
+        assert hits == {"device": 2, "warm": 2}
+
+    def test_host_core_steps_stay_out_of_the_ledger(self, monkeypatch):
+        """Regression: host spec-engine core-sweep iterations are not
+        lockstep trips — a host-routed UNSAT row's ledger steps are the
+        device-only snapshot, while the lane's reported steps include
+        the host sweep."""
+        from deppy_tpu.engine import driver
+
+        # Force the host-core route on a small UNSAT problem.
+        monkeypatch.setattr(driver, "HOST_CORE_NCONS", 0)
+        problem = encode([
+            sat.variable("a", sat.mandatory(), sat.prohibited()),
+            sat.variable("b"),
+        ])
+        with profile.override("on", 1.0):
+            (res,) = driver.solve_problems([problem])
+        rep = telemetry.last_report()
+        assert rep.profiled_dispatches == 1
+        # The decoded lane carries device + host steps; the ledger only
+        # the device share.
+        assert rep.ledger_lane_steps < int(res.steps)
+
+    def test_configure_mode_alone_arms_with_default_sample(self):
+        """Regression: the serve CLI's `--profile on` path calls
+        configure(mode='on', sample=None) — the env/default sample
+        interval must still resolve, or arming silently records
+        nothing."""
+        from deppy_tpu.engine import driver
+
+        profile.configure(mode="on")
+        try:
+            assert profile.armed()
+            assert profile.sample_rate() == 1.0
+            driver.solve_problems(_fuzz(4, length=12))
+            snap = telemetry.default_registry().snapshot()
+            assert snap["deppy_profile_dispatches_total"] == 1
+        finally:
+            profile.configure(mode=None, sample=None)
+
+    def test_profile_families_ride_service_scrape(self):
+        """Regression: the deppy_profile_* families live on the
+        pipeline-global default registry — the service scrape must
+        mirror them (faults/hostpool pattern), and a disarmed service's
+        scrape must stay unchanged."""
+        from deppy_tpu.engine import driver
+        from deppy_tpu.service import Metrics
+
+        assert "deppy_profile_" not in Metrics().render()
+        with profile.override("on", 1.0):
+            driver.solve_problems(_fuzz(4, length=12))
+        text = Metrics().render()
+        for fam in ("deppy_profile_dispatches_total",
+                    "deppy_profile_trips_total",
+                    "deppy_profile_useful_work_ratio_bucket",
+                    'deppy_profile_backend_lanes_total{backend="device"}'):
+            assert fam in text, f"{fam} missing from /metrics render"
+
+    def test_ledger_reads_are_post_fetch_host_numpy(self):
+        """Trace purity by construction: the ledger hook consumes the
+        impls' fetched numpy steps — assert the recorded trips equal a
+        pure-host recomputation from the returned results."""
+        from deppy_tpu.engine import driver
+
+        problems = _fuzz(10)
+        with profile.override("on", 1.0):
+            results = driver.solve_problems(problems)
+        rep = telemetry.last_report()
+        steps = np.array([int(r.steps) for r in results])
+        # One bucket, one chunk on this batch: trips = max lane steps
+        # (pad lanes solve trivially and can never exceed the max).
+        assert rep.ledger_trips == int(steps.max())
+        assert rep.ledger_lane_steps == int(steps.sum())
+
+
+# --------------------------------------------------------------- merge rules
+
+
+class TestMergeRules:
+    def test_ledger_fields_sum_on_merge(self):
+        a = telemetry.SolveReport()
+        a.record_ledger(trips=10, trip_slots=100, lane_steps=40,
+                        p99_trips=8)
+        a.add_wall("solve", 1.0)
+        b = telemetry.SolveReport()
+        b.record_ledger(trips=6, trip_slots=30, lane_steps=20,
+                        p99_trips=6)
+        b.record_ledger(trips=4, trip_slots=16, lane_steps=10,
+                        p99_trips=4)
+        b.add_wall("solve", 2.0)
+        a.merge(b)
+        assert a.profiled_dispatches == 3
+        assert a.ledger_trips == 20
+        assert a.ledger_trip_slots == 146
+        assert a.ledger_lane_steps == 70
+        assert a.ledger_p99_trips == 18
+        # Derived ratios recompute from the merged sums.
+        assert a.useful_work_ratio == pytest.approx(70 / 146)
+        assert a.straggler_p99_ratio == pytest.approx(18 / 20)
+        # Sequential stages sum (the PR 9 convention).
+        assert a.wall["solve"] == pytest.approx(3.0)
+
+    def test_to_from_dict_roundtrip(self):
+        a = telemetry.SolveReport()
+        a.record_ledger(trips=7, trip_slots=70, lane_steps=21,
+                        p99_trips=6)
+        d = a.to_dict()
+        assert d["useful_work_ratio"] == pytest.approx(0.3)
+        back = telemetry.SolveReport.from_dict(d)
+        assert back.ledger_trips == 7
+        assert back.straggler_p99_ratio == pytest.approx(6 / 7)
+
+    def test_sharded_dispatch_merges_shard_ledgers(self):
+        """Mesh serving: per-shard worker reports carry their own
+        sampled-dispatch ledgers; the parent batch report is their
+        sum."""
+        jax = pytest.importorskip("jax")
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device CPU platform")
+        from deppy_tpu.engine import driver
+        from deppy_tpu.parallel import default_mesh
+
+        mesh = default_mesh(jax.devices()[:2])
+        problems = _fuzz(16)
+        with profile.override("on", 1.0):
+            sharded = driver.solve_problems_sharded(problems, mesh=mesh)
+        rep = telemetry.last_report()
+        # Two shards, each a sampled dispatch: ledgers sum in the merge.
+        assert rep.profiled_dispatches == 2
+        steps = np.array([int(r.steps) for r in sharded])
+        assert rep.ledger_lane_steps == int(steps.sum())
+        assert rep.ledger_trips == int(steps[:8].max()) + int(steps[8:].max())
+        assert 0.0 < rep.useful_work_ratio <= 1.0
+
+    def test_mixed_cold_warm_submit_merges_groups(self):
+        """One submit spanning a cold group (device dispatch — ledger
+        trips) and a warm incremental group (backend attribution, no
+        trips): the merged report and timing obey the PR 9 rules with
+        profiling armed."""
+        from deppy_tpu.sched import Scheduler
+
+        reg = telemetry.default_registry()
+        s = Scheduler(backend="auto", registry=telemetry.Registry(),
+                      cache_size=0)
+        s.start()
+        try:
+            with profile.override("on", 1.0):
+                # Seed the incremental index (cold, indexed on SAT).
+                s.submit([bundle_catalog()])
+                # Mixed submit: a tweaked catalog (warm plan) + a fresh
+                # cold problem — two groups, two dispatches, one report.
+                stats: dict = {}
+                got = s.submit(
+                    [bundle_catalog(tweak=("add-dep", 1)),
+                     random_instance(length=24, seed=99)],
+                    stats=stats)
+        finally:
+            s.stop()
+        assert len(got) == 2 and all(r is not None for r in got)
+        rep = stats["report"]
+        assert rep is not None
+        # The cold group's device dispatch was sampled into the ledger;
+        # the warm group contributes no trips (no lockstep program) —
+        # the merged sums are exactly the cold group's.
+        assert rep.profiled_dispatches >= 1
+        assert rep.ledger_trips > 0
+        # Concurrent queue waits take the max, sequential stages sum:
+        # the merged timing keys exist and are sane.
+        t = stats["timings"]
+        assert t.get("queue_wait_s") is not None
+        assert t.get("solve_s", 0) >= 0
+        # Backend attribution saw both flavors.
+        snap = reg.snapshot()
+        backends = snap["deppy_profile_backend_lanes_total"]
+        assert "warm" in backends and backends["warm"] >= 1
+        assert "device" in backends
+
+
+# ----------------------------------------------------------------- SLO tier
+
+
+class TestSLO:
+    def test_sanitize_tenant(self):
+        assert profile.sanitize_tenant(None) == "default"
+        assert profile.sanitize_tenant("  ") == "default"
+        assert profile.sanitize_tenant("team-a.prod_1") == "team-a.prod_1"
+        assert profile.sanitize_tenant('evil"} 1\n') == "evil1"
+        assert len(profile.sanitize_tenant("x" * 200)) == 64
+        # Reserved names: a client must not be able to claim the
+        # accountant's own overflow bucket (or any _-prefixed label).
+        from deppy_tpu.profile.slo import OVERFLOW_TENANT
+
+        assert profile.sanitize_tenant(OVERFLOW_TENANT) == "overflow"
+        assert profile.sanitize_tenant("___") == "default"
+
+    def test_config_from_spec_and_defaults(self, tmp_path):
+        c = profile.SLOConfig.from_spec(
+            '{"gold": {"target_p99_s": 0.2, "error_budget": 0.05}}')
+        assert c.for_tenant("gold")["target_p99_s"] == 0.2
+        # Unlisted tenants: the "default" entry, else built-ins.
+        assert c.for_tenant("other")["target_p99_s"] == 1.0
+        f = tmp_path / "slo.json"
+        f.write_text('{"default": {"target_p99_s": 9.0}}')
+        c2 = profile.SLOConfig.from_spec(f"@{f}")
+        assert c2.for_tenant("anyone")["target_p99_s"] == 9.0
+        assert profile.SLOConfig.from_spec(str(f)) \
+            .for_tenant("x")["target_p99_s"] == 9.0
+        with pytest.raises(ValueError):
+            profile.SLOConfig.from_spec('["not", "a", "mapping"]')
+
+    def test_burn_rate_window(self):
+        acc = profile.SLOAccountant(profile.SLOConfig.from_spec(
+            '{"default": {"target_p99_s": 0.1, "error_budget": 0.5}}'))
+        for _ in range(3):
+            acc.observe("t", 0.01)
+        acc.observe("t", 0.5)  # violates the 0.1s target
+        view = acc.snapshot()["t"]
+        assert view["requests"] == 4
+        assert view["violations"] == 1
+        assert view["burn_rate"] == pytest.approx((1 / 4) / 0.5)
+        assert view["p99_s"] == pytest.approx(0.5)
+        lines = acc.render_metric_lines()
+        text = "\n".join(lines)
+        assert 'deppy_tenant_requests_total{tenant="t"} 4' in text
+        assert 'deppy_tenant_burn_rate{tenant="t"} 0.5' in text
+
+    def test_deadline_miss_counts(self):
+        acc = profile.SLOAccountant()
+        acc.observe("t", 0.001, deadline_miss=True)
+        view = acc.snapshot()["t"]
+        assert view["deadline_misses"] == 1
+        assert view["violations"] == 1
+
+    def test_tenant_cardinality_is_bounded(self):
+        """Regression: X-Deppy-Tenant is unauthenticated — a client
+        minting a fresh tenant per request must not grow memory or
+        scrape cardinality without bound."""
+        from deppy_tpu.profile.slo import MAX_TENANTS, OVERFLOW_TENANT
+
+        acc = profile.SLOAccountant()
+        for i in range(MAX_TENANTS + 50):
+            acc.observe(f"t{i}", 0.001)
+        snap = acc.snapshot()
+        assert len(snap) == MAX_TENANTS + 1  # cap + the overflow bucket
+        assert snap[OVERFLOW_TENANT]["requests"] == 50
+        # A tenant seen before the flood keeps its own stats.
+        acc.observe("t0", 0.002)
+        assert acc.snapshot()["t0"]["requests"] == 2
+
+    def test_single_tenant_flush_stamps_profile_event(self, tmp_path):
+        """Regression: `deppy stats --tenant` must be able to match
+        profile events — a flush serving exactly one tenant carries it."""
+        from deppy_tpu.sched import Scheduler
+
+        sink = tmp_path / "t.jsonl"
+        telemetry.default_registry().configure_sink(str(sink))
+        s = Scheduler(backend="host", registry=telemetry.Registry(),
+                      cache_size=0)
+        s.start()
+        try:
+            with profile.override("on", 1.0):
+                s.submit([random_instance(length=16, seed=1)],
+                         tenant="solo")
+        finally:
+            s.stop()
+            telemetry.default_registry().configure_sink(None)
+        profs = [e for e in _sink_events(sink)
+                 if e.get("kind") == "profile"]
+        assert profs and profs[0]["backend"] == "host"
+        assert profs[0]["tenant"] == "solo"
+
+    def test_two_tenant_chaos_burn_rate(self):
+        """ISSUE 11 acceptance: a two-tenant load with one tenant
+        driven past its deadline budget by the fault-plan harness —
+        burn rate visible on /metrics and /debug/slo, attributed to
+        the overdriven tenant only."""
+        from http.client import HTTPConnection
+
+        from deppy_tpu.service import Server
+
+        faults.configure_plan(faults.plan_from_spec(
+            '[{"point": "sched.dispatch", "kind": "latency",'
+            ' "latency_s": 0.05, "times": -1}]'))
+        slo = json.dumps({"default":
+                          {"target_p99_s": 5.0, "error_budget": 0.01}})
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host",
+                     slo=slo, cache_size=0)
+        srv.start()
+        try:
+            doc = {"variables": [
+                {"id": "a", "constraints": [
+                    {"type": "mandatory"},
+                    {"type": "dependency", "ids": ["b"]}]},
+                {"id": "b"},
+            ]}
+
+            def resolve(tenant, deadline=None):
+                conn = HTTPConnection("127.0.0.1", srv.api_port,
+                                      timeout=60)
+                headers = {"Content-Type": "application/json",
+                           "X-Deppy-Tenant": tenant}
+                if deadline is not None:
+                    headers["X-Deppy-Deadline-S"] = deadline
+                conn.request("POST", "/v1/resolve", json.dumps(doc),
+                             headers)
+                resp = conn.getresponse()
+                body = resp.read()
+                conn.close()
+                return resp.status, body
+
+            for _ in range(3):
+                assert resolve("gold")[0] == 200
+                # churny's 10ms deadline expires inside the injected
+                # 50ms dispatch latency: triage degrades its lane.
+                assert resolve("churny", "0.01")[0] == 200
+
+            slo_doc = json.loads(
+                _http_get(srv.api_port, "/debug/slo"))["slo"]
+            assert slo_doc["churny"]["deadline_misses"] >= 1
+            assert slo_doc["churny"]["burn_rate"] > 1.0
+            assert slo_doc["gold"]["burn_rate"] == 0.0
+            metrics = _http_get(srv.api_port, "/metrics").decode()
+            assert 'deppy_tenant_burn_rate{tenant="churny"}' in metrics
+            assert 'deppy_tenant_deadline_miss_total{tenant="churny"}' \
+                in metrics
+            gold_miss = [l for l in metrics.splitlines() if l.startswith(
+                'deppy_tenant_deadline_miss_total{tenant="gold"}')]
+            assert gold_miss and gold_miss[0].endswith(" 0")
+        finally:
+            srv.shutdown()
+
+    def test_unscheduled_path_counts_deadline_misses(self, monkeypatch):
+        """Regression: with the scheduler off there are no per-lane
+        triage verdicts — a request that ran past its deadline with
+        incomplete lanes still counts as a miss, while within-deadline
+        budget exhaustion does not."""
+        import time as _time
+
+        from deppy_tpu.resolution import facade
+        from deppy_tpu.sat.errors import Incomplete
+        from deppy_tpu.service import Server
+
+        doc = {"variables": [{"id": "a"}]}
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host",
+                     sched="off")
+
+        def slow_incomplete(self, problems):
+            _time.sleep(0.03)
+            self.last_steps = 0
+            self.last_report = None
+            return [Incomplete()]
+
+        monkeypatch.setattr(facade.BatchResolver, "solve",
+                            slow_incomplete)
+        try:
+            rs: dict = {}
+            status, _ = srv.resolve_document(doc, deadline_s=0.01,
+                                             request_stats=rs)
+            assert status == 200
+            assert rs["deadline_misses"] == 1
+            # Fast Incomplete within a generous deadline: no miss.
+            rs = {}
+            status, _ = srv.resolve_document(doc, deadline_s=30.0,
+                                             request_stats=rs)
+            assert status == 200
+            assert rs["deadline_misses"] == 0
+        finally:
+            srv.shutdown()
+
+    def test_tenant_rides_fault_events_and_root_span(self, tmp_path):
+        """Deadline-miss attribution (ISSUE 11): the triage's fault
+        event carries the expired lane's tenant, and the request's
+        root span carries it in attrs — both from sink lines alone."""
+        from deppy_tpu.sched import Scheduler
+
+        sink = tmp_path / "t.jsonl"
+        telemetry.default_registry().configure_sink(str(sink))
+        s = Scheduler(backend="host", registry=telemetry.Registry(),
+                      cache_size=0)
+        s.start()
+        try:
+            stats: dict = {}
+            got = s.submit([random_instance(length=16, seed=0)],
+                           deadline_s=1e-9, stats=stats,
+                           tenant="team-x")
+        finally:
+            s.stop()
+            telemetry.default_registry().configure_sink(None)
+        from deppy_tpu.sat.errors import Incomplete
+
+        assert isinstance(got[0], Incomplete)
+        assert stats["deadline_misses"] == 1
+        evs = _sink_events(sink)
+        misses = [e for e in evs if e.get("kind") == "fault"
+                  and e.get("fault") == "deadline_exceeded"]
+        assert misses and misses[0].get("tenant") == "team-x"
+
+
+def _http_get(port, path):
+    from http.client import HTTPConnection
+
+    conn = HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    assert resp.status == 200, (path, resp.status, body)
+    return body
+
+
+# --------------------------------------------------------------------- CLI
+
+
+class TestCLI:
+    def _synthetic_sink(self, tmp_path):
+        """Known-linear sink: solve_s = 1ms + 100µs * trips."""
+        sink = tmp_path / "t.jsonl"
+        events = []
+        for i, trips in enumerate((10, 20, 40, 80)):
+            events.append({
+                "ts": 1.0 + i, "kind": "profile", "backend": "device",
+                "size_class": 256, "lanes": 16, "live": 12,
+                "chunk": 16, "trips": trips, "lane_steps": trips * 4,
+                "lane_p50": 3, "lane_p99": trips - 1,
+                "useful_work_ratio": 0.25,
+                "straggler_p99_ratio": 0.9, "pad_waste_ratio": 0.5,
+                "pad_cells": 1000, "live_cells": 500,
+                "solve_s": 0.001 + 100e-6 * trips})
+        events.append({"ts": 9.0, "kind": "profile", "backend": "host",
+                       "lanes": 8, "live": 8, "lane_steps": 99,
+                       "solve_s": 0.004})
+        sink.write_text("".join(json.dumps(e) + "\n" for e in events))
+        return sink
+
+    def test_profile_cli_regression(self, tmp_path, capsys):
+        from deppy_tpu import cli
+
+        sink = self._synthetic_sink(tmp_path)
+        rc = cli.main(["profile", str(sink), "--output", "json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        reg = out["trip_overhead"]
+        assert reg["points"] == 4
+        assert reg["us_per_trip"] == pytest.approx(100.0, rel=1e-3)
+        assert reg["intercept_ms"] == pytest.approx(1.0, rel=1e-3)
+        assert reg["useful_us_per_trip"] == pytest.approx(25.0, rel=1e-3)
+        assert out["size_classes"]["256"]["dispatches"] == 4
+        assert out["backends"]["host"]["us_per_solve"] == \
+            pytest.approx(500.0)
+        rc = cli.main(["profile", str(sink)])
+        text = capsys.readouterr().out
+        assert rc == 0
+        assert "us/trip" in text and "backends:" in text
+
+    def test_profile_cli_live_trip_overhead(self, tmp_path, capsys):
+        """ISSUE 11 acceptance: `deppy profile` reproduces a
+        trip-overhead estimate from a live churn+mixed-load run —
+        within the sink, no hand instrumentation."""
+        from deppy_tpu import cli
+        from deppy_tpu.engine import driver
+
+        sink = tmp_path / "live.jsonl"
+        telemetry.default_registry().configure_sink(str(sink))
+        with profile.override("on", 1.0):
+            # Mixed load: varied sizes vary the trip counts.
+            for n, length in ((4, 12), (10, 24), (16, 36)):
+                driver.solve_problems(_fuzz(n, length=length))
+        telemetry.default_registry().configure_sink(None)
+        rc = cli.main(["profile", str(sink), "--output", "json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["device_dispatches"] == 3
+        reg = out["trip_overhead"]
+        assert reg is not None and reg["points"] == 3
+        assert reg["us_per_trip"] != 0.0
+
+    def test_stats_tenant_filter_and_profile_tally(self, tmp_path,
+                                                   capsys):
+        from deppy_tpu import cli
+
+        sink = tmp_path / "t.jsonl"
+        events = [
+            {"ts": 1.0, "kind": "span", "name": "service.request",
+             "dur_s": 0.5, "attrs": {"tenant": "a"}},
+            {"ts": 2.0, "kind": "span", "name": "service.request",
+             "dur_s": 0.1, "attrs": {"tenant": "b"}},
+            {"ts": 3.0, "kind": "fault", "fault": "deadline_exceeded",
+             "tenant": "a", "where": "sched.dispatch", "problems": 1},
+            {"ts": 4.0, "kind": "profile", "backend": "device",
+             "trips": 5, "lane_steps": 10, "useful_work_ratio": 0.5,
+             "solve_s": 0.01},
+        ]
+        sink.write_text("".join(json.dumps(e) + "\n" for e in events))
+        rc = cli.main(["stats", str(sink), "--output", "json",
+                       "--tenant", "a"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["events"] == 2  # a's span + a's fault event
+        assert out["event_kinds"] == {"span": 1, "fault": 1}
+        rc = cli.main(["stats", str(sink)])
+        text = capsys.readouterr().out
+        assert rc == 0
+        assert "profile: 1 events" in text
+        assert "trips=5" in text
+
+    def test_stats_json_profile_keys_are_stable(self, tmp_path, capsys):
+        """Regression: a sink with only backend-flush profile events
+        (no useful_work_ratio field) must not leak the private
+        accumulator key into the documented JSON output."""
+        from deppy_tpu import cli
+
+        sink = tmp_path / "t.jsonl"
+        sink.write_text(json.dumps(
+            {"ts": 1.0, "kind": "profile", "backend": "host",
+             "lanes": 4, "live": 4, "lane_steps": 9,
+             "solve_s": 0.002}) + "\n")
+        rc = cli.main(["stats", str(sink), "--output", "json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["profile"]["events"] == 1
+        assert out["profile"]["mean_useful_work_ratio"] is None
+        assert "_useful" not in out["profile"]
+        assert "_useful_n" not in out["profile"]
+
+    def test_trace_renders_profile_events(self, tmp_path, capsys):
+        """A profile event stamped under a dispatch trace shows up in
+        the reconstructed span tree."""
+        from deppy_tpu import cli
+        from deppy_tpu.engine import driver
+
+        sink = tmp_path / "t.jsonl"
+        telemetry.default_registry().configure_sink(str(sink))
+        ctx = telemetry.trace.TraceContext()
+        with telemetry.trace.activate(ctx), profile.override("on", 1.0):
+            with telemetry.default_registry().span("service.request",
+                                                   request_id="r1"):
+                driver.solve_problems(_fuzz(6, length=12))
+        telemetry.default_registry().configure_sink(None)
+        rc = cli.main(["trace", ctx.trace_id, "--file", str(sink)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "(profile)" in out and "trips=" in out
+
+
+# ------------------------------------------------------------ bench columns
+
+
+class TestBenchColumns:
+    def test_harness_records_ledger_columns(self):
+        from deppy_tpu.benchmarks.harness import bench_problems
+
+        m = bench_problems(_fuzz(4, length=12), host_sample=2)
+        for col in ("useful_work_ratio", "straggler_p99_ratio",
+                    "pad_waste_ratio"):
+            assert col in m, f"{col} missing from harness record"
+        assert 0.0 < m["useful_work_ratio"] <= 1.0
+        assert 0.0 < m["straggler_p99_ratio"] <= 1.0
+        assert 0.0 <= m["pad_waste_ratio"] < 1.0

@@ -270,7 +270,17 @@ class TestCoalescedPropagation:
             recorder = trace.default_recorder()
             dispatch_ids = set()
             for tid in tids:
-                rec = recorder.get(tid)
+                # The handler records the flight trace in its finally —
+                # AFTER the response bytes reach the client (deliberate:
+                # disconnects must still record) — so an immediate read
+                # races it.  Poll briefly, like the unscheduled-path
+                # test below.
+                rec = None
+                for _ in range(100):
+                    rec = recorder.get(tid)
+                    if rec is not None:
+                        break
+                    time.sleep(0.01)
                 assert rec is not None
                 names = {sp["name"] for sp in rec["spans"]}
                 assert {"service.request", "sched.queue_wait",
