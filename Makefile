@@ -100,6 +100,14 @@ profile-smoke: ## Profiled churn+mixed load end to end: armed trip-ledger events
 test-profile: ## Profiler + SLO subsystem tests only (the `profile` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m profile
 
+.PHONY: bcp-smoke
+bcp-smoke: ## Watched clause-bank engine end to end: impl byte-identity, device-vs-host bank fidelity, measured ladder pad-waste win, armed-guard zero-retrace (ISSUE 12 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/bcp_smoke.py
+
+.PHONY: test-bcp
+test-bcp: ## Watched clause-bank BCP subsystem tests only (the `bcp` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m bcp
+
 .PHONY: lint
 lint: ## Static analysis: the six deppy-lint checkers vs analysis/baseline.json (ISSUE 7/8 acceptance; docs/analysis.md).
 	$(PYTHON) -m deppy_tpu lint

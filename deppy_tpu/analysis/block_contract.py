@@ -29,23 +29,29 @@ declared size class, against the kernel/driver sources:
     exactly);
   * ``missing-sublane-round`` — the blockwise kernel must still carry
     the 8-sublane round-up (same 2026-08-01 hardware rejection class);
-  * ``padding-waste`` — the driver's size-class economics: adjacent
-    declared classes must differ by at least the driver's
-    ``SPLIT_RATIO`` in padded cost (else ``partition_buckets`` can
-    never separate them and the small class pays the large class's
-    pad), and the worst within-class cell waste under power-of-two
-    bucketing must stay under :data:`CLASS_WASTE_MAX`;
+  * ``padding-waste`` — the ladder's size-class economics: adjacent
+    declared classes must differ by at least ``SPLIT_RATIO`` in padded
+    cost (else the partitioner could never separate them and the small
+    class pays the large class's pad), and the worst within-class cell
+    waste under power-of-two bucketing must stay under
+    :data:`CLASS_WASTE_MAX`;
+  * ``bank-budget`` — the watched-impl clause bank
+    (:mod:`..engine.clause_bank`): each class's adjacency tables at its
+    declared ``OCC`` cap (``2·V·OCC + NV·OCC`` int32 cells) must fit
+    the same VMEM residency budget as the clause planes — a class
+    whose bank cannot be resident belongs on the dense rounds, not on
+    a silently-thrashing bank;
   * ``contract-drift`` — a source constant this checker evaluates
-    (``SPLIT_RATIO``, ``_smem_scalars``, the sublane round) is gone or
-    moved: the contract can no longer be checked, which is itself a
-    finding, not a silent pass.
+    (the shared size-class table, ``_smem_scalars``, the sublane
+    round) is gone or moved: the contract can no longer be checked,
+    which is itself a finding, not a silent pass.
 
-The size classes (:data:`SIZE_CLASSES`) mirror the driver's
-power-of-two buckets across the measured workload range — from the
-64-clause catalog floor to the ``C=8192`` / ``Wv=128`` caps of
-``pallas_bcp`` — with ``B=4096`` as the widest probed batch.  Pure
-stdlib ``ast`` arithmetic: no JAX import, evaluable in CI before a
-backend exists.
+The size classes come from the SHARED ladder
+(:mod:`deppy_tpu.size_classes` — import-light, stdlib only), which the
+driver's partitioner consumes too (ISSUE 12): the lint contracts and
+the runtime economics read one table and can never drift.  Beyond that
+import, pure stdlib ``ast`` arithmetic: no JAX, evaluable in CI before
+a backend exists.
 """
 
 from __future__ import annotations
@@ -57,18 +63,13 @@ from typing import Dict, List, Optional
 from .core import Checker, Finding, SourceFile
 from .core import dotted as _dotted
 
-# Declared size classes: padded dims per the driver's power-of-two
-# bucketing (_bucket).  C = clause rows, NV = problem vars, NCON =
-# applied constraints; V = NV + NCON, Wv = ceil(V / 32) bitplane words.
-SIZE_CLASSES: Dict[str, Dict[str, int]] = {
-    "xs": {"C": 64, "NV": 128, "NCON": 64},
-    "s": {"C": 256, "NV": 256, "NCON": 128},
-    "m": {"C": 1024, "NV": 1024, "NCON": 512},
-    "l": {"C": 4096, "NV": 2048, "NCON": 1024},
-    # The caps: pallas_bcp's documented VMEM budget (C <= 8192 rows,
-    # Wv <= 128 words = 4096 vars).
-    "xl": {"C": 8192, "NV": 3072, "NCON": 1024},
-}
+from .. import size_classes as _shared
+
+# Declared size classes: the SHARED ladder (deppy_tpu.size_classes) the
+# driver's partitioner consumes.  C = clause rows, NV = problem vars,
+# NCON = applied constraints; V = NV + NCON, Wv = ceil(V / 32) bitplane
+# words; OCC = the watched bank's occurrence cap.
+SIZE_CLASSES: Dict[str, Dict[str, int]] = _shared.SIZE_CLASSES
 # Widest per-problem batch the SMEM scalar columns are probed at
 # (scripts/lane_probe.py; tests/test_mosaic_lowering.py B=4096 anchor).
 SMEM_ANCHOR_B = 4096
@@ -87,15 +88,15 @@ _BCP = "deppy_tpu/engine/pallas_bcp.py"
 _BLOCKWISE = "deppy_tpu/engine/pallas_blockwise.py"
 _SEARCH = "deppy_tpu/engine/pallas_search.py"
 _DRIVER = "deppy_tpu/engine/driver.py"
+_LADDER = "deppy_tpu/size_classes.py"
+_BANK = "deppy_tpu/engine/clause_bank.py"
 
 
-def _wv(cls: Dict[str, int]) -> int:
-    return -(-(cls["NV"] + cls["NCON"]) // 32)
-
-
-def _cost(cls: Dict[str, int]) -> int:
-    """driver._cost_proxy over a declared class's padded dims."""
-    return (cls["C"] + 2 * cls["NV"]) * _wv(cls)
+# Cost arithmetic comes from the shared ladder module — the checker
+# must evaluate the SAME model the driver partitions by, or the
+# economics findings go stale against a retuned proxy.
+_wv = _shared.wv
+_cost = _shared.class_cost
 
 
 def _module_const(sf: SourceFile, name: str):
@@ -114,7 +115,8 @@ def _module_const(sf: SourceFile, name: str):
 
 class BlockContractChecker(Checker):
     name = "block-contract"
-    default_scope = ("deppy_tpu/engine", "deppy_tpu/parallel")
+    default_scope = ("deppy_tpu/engine", "deppy_tpu/parallel",
+                     "deppy_tpu/size_classes.py")
 
     def __init__(self, size_classes: Optional[Dict[str, Dict[str, int]]]
                  = None):
@@ -129,13 +131,16 @@ class BlockContractChecker(Checker):
             self._check_blockwise(out, by_rel[_BLOCKWISE])
         if _BCP in by_rel:
             self._check_vmem(out, by_rel[_BCP])
+        if _BANK in by_rel:
+            self._check_bank(out, by_rel[_BANK])
         for rel in (_BCP, _BLOCKWISE):
             if rel in by_rel:
                 self._check_per_row_smem(out, by_rel[rel])
         if _DRIVER in by_rel and not self.partial:
-            # Class economics need the driver's constants: skip on
+            # Class economics need the ladder's constants: skip on
             # --changed runs that did not touch the driver.
-            self._check_classes(out, by_rel[_DRIVER])
+            self._check_classes(out, by_rel.get(_LADDER),
+                                by_rel[_DRIVER])
         return out
 
     # ----------------------------------------------------- SMEM columns
@@ -285,16 +290,59 @@ class BlockContractChecker(Checker):
                     f"fused fixpoint kernel declares; route this class "
                     f"to the blockwise kernel")
 
+    # ------------------------------------------------------------ banks
+
+    def _check_bank(self, out: List[Finding], sf: SourceFile) -> None:
+        """Watched-impl bank residency (ISSUE 12): each class's
+        adjacency tables at its declared OCC cap — occ_pos + occ_neg
+        (2·V·OCC) plus card_occ (NV·OCC, Oc bounded by OCC) int32
+        cells — must fit the same VMEM budget the clause planes
+        declare, with 2x slack for the planes resident beside them."""
+        for cname, cls in sorted(self.size_classes.items()):
+            occ = cls.get("OCC")
+            if not isinstance(occ, int):
+                self.finding(
+                    out, sf, 1, "contract-drift", f"{cname}:OCC",
+                    f"size class `{cname}` declares no integer OCC cap "
+                    f"in deppy_tpu.size_classes — the watched-bank "
+                    f"residency contract cannot be evaluated")
+                continue
+            V = cls["NV"] + cls["NCON"]
+            resident = (2 * V * occ + cls["NV"] * occ) * 4 * 2
+            if resident > VMEM_BUDGET_BYTES:
+                self.finding(
+                    out, sf, 1, "bank-budget", f"{cname}:{occ}",
+                    f"size class `{cname}`'s clause bank needs "
+                    f"~{resident} bytes at its OCC={occ} cap (2x slack "
+                    f"over (2V+NV)·OCC·4) — past the "
+                    f"{VMEM_BUDGET_BYTES} residency budget; lower the "
+                    f"class's OCC cap (dispatches past it already fall "
+                    f"back to the dense rounds)")
+
     # ------------------------------------------------- class economics
 
-    def _check_classes(self, out: List[Finding], sf: SourceFile) -> None:
+    def _check_classes(self, out: List[Finding],
+                       ladder_sf: Optional[SourceFile],
+                       driver_sf: SourceFile) -> None:
+        # SPLIT_RATIO lives in the shared ladder module (ISSUE 12);
+        # scans without it (checker-test fixtures) fall back to a
+        # driver-source literal, the pre-ladder spelling.
+        sf = ladder_sf if ladder_sf is not None else driver_sf
         split_ratio = _module_const(sf, "SPLIT_RATIO")
         if not isinstance(split_ratio, (int, float)):
             self.finding(
                 out, sf, 1, "contract-drift", "SPLIT_RATIO",
-                "driver.SPLIT_RATIO is no longer a module literal — "
+                "SPLIT_RATIO is no longer a module literal in "
+                "deppy_tpu/size_classes.py (or the fixture driver) — "
                 "the size-class separability contract cannot be "
                 "evaluated")
+            return
+        if ladder_sf is not None and "size_classes" not in driver_sf.text:
+            self.finding(
+                out, driver_sf, 1, "contract-drift", "size_classes",
+                "the driver no longer references the shared "
+                "deppy_tpu.size_classes ladder — its partitioner and "
+                "these contracts can drift apart")
             return
         ordered = sorted(self.size_classes.items(),
                          key=lambda kv: _cost(kv[1]))

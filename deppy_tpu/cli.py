@@ -227,6 +227,16 @@ def _build_parser() -> argparse.ArgumentParser:
         "/debug/slo",
     )
     p_serve.add_argument(
+        "--bcp",
+        choices=["auto", "gather", "bits", "pallas", "blockwise",
+                 "watched"],
+        default=None,
+        help="BCP propagation implementation (default auto — the "
+        "measured-defaults registry, falling back to bits; also via "
+        "DEPPY_TPU_BCP).  'watched' selects the compressed-clause-bank "
+        "implication-driven engine (ISSUE 12)",
+    )
+    p_serve.add_argument(
         "--profile", choices=["on", "off"], default=None,
         help="engine cost profiler: per-dispatch trip ledger + "
         "per-backend cost attribution as `profile` sink events and "
@@ -416,6 +426,7 @@ _CONFIG_KEYS = {
     "slo": ("slo", str),
     "profile": ("profile", str),
     "profileSample": ("profile_sample", float),
+    "bcp": ("bcp", str),
 }
 
 
@@ -1042,6 +1053,7 @@ def _cmd_serve(args) -> int:
         "slo": None,
         "profile": None,
         "profile_sample": None,
+        "bcp": None,
     }
     try:
         if args.config:
@@ -1064,6 +1076,7 @@ def _cmd_serve(args) -> int:
             ("slo", args.slo),
             ("profile", args.profile),
             ("profile_sample", args.profile_sample),
+            ("bcp", args.bcp),
         ):
             if val is not None:
                 kwargs[key] = val
@@ -1083,6 +1096,14 @@ def _cmd_serve(args) -> int:
             from . import profile as profiling
 
             profiling.configure(mode=prof_mode, sample=prof_sample)
+        # BCP impl selection is engine-global (like the pool and the
+        # profiler): installed at the process entry point, before any
+        # program compiles.
+        bcp_impl = kwargs.pop("bcp", None)
+        if bcp_impl is not None:
+            from .engine import core as _engine_core
+
+            _engine_core.set_bcp_impl(bcp_impl)
         serve(**kwargs)
     except FileNotFoundError:
         print(f"error: no such file: {args.config}", file=sys.stderr)

@@ -218,7 +218,21 @@ _DECLARATIONS: List[EnvVar] = [
        "Stage-1 step budget of the escalation ladder (0 = measured "
        "default)."),
     _v("DEPPY_TPU_BCP", "str", "auto", "deppy_tpu.engine.core",
-       "BCP kernel implementation: auto/bits/dense/pallas/blockwise."),
+       "BCP propagation implementation: auto/gather/bits/pallas/"
+       "blockwise/watched ('watched' = the compressed-clause-bank "
+       "implication-driven path; 'auto' resolves through the "
+       "measured-defaults registry, falling back to 'bits'; also "
+       "--bcp).",
+       flag="--bcp", config_key="bcp"),
+    _v("DEPPY_TPU_BANK_OCC_CAP", "int", 0, "deppy_tpu.engine.driver",
+       "Watched-bank occurrence-width cap: a dispatch whose max "
+       "per-literal clause count exceeds the cap ships dummy banks and "
+       "runs the dense propagation program instead (0 = the dispatch's "
+       "size-class OCC cap from deppy_tpu.size_classes)."),
+    _v("DEPPY_TPU_SIZE_LADDER", "str", "on", "deppy_tpu.engine.driver",
+       "Size-class partitioner: 'on' = the shared ladder "
+       "(deppy_tpu.size_classes), 'off' = the legacy adjacent-jump "
+       "splitter (A/B only)."),
     _v("DEPPY_TPU_BCP_UNROLL", "int", 1, "deppy_tpu.engine.core",
        "Propagation-loop unroll factor (trip-overhead amortization)."),
     _v("DEPPY_TPU_DPLL_UNROLL", "int", 1, "deppy_tpu.engine.core",

@@ -34,6 +34,7 @@ def clear_compile_caches() -> None:
 
     core.clear_batched_caches()
     driver._planes_fn.cache_clear()
+    driver._bank_fn.cache_clear()
     jax.clear_caches()
 
 

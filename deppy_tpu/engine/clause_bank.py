@@ -1,0 +1,377 @@
+"""Compressed clause banks + implication-driven BCP (ISSUE 12 tentpole).
+
+Every propagation implementation before this one — the [C, K] gather
+round, the jnp bitplane algebra, and both Pallas kernels — is a *scan*:
+each round evaluates every clause row against the assignment, so a
+fixpoint costs ``rounds x C`` clause evaluations even when a round
+derives one literal from one clause.  The PR 10 trip ledger puts a
+number on the waste (~175µs per lockstep while-trip for ~10µs of useful
+lane work), and SatIn / the FPGA-BCP line (PAPERS.md) converge on the
+classic CDCL answer: watched-literal propagation — index clauses by
+literal and, when a literal becomes false, visit only the clauses
+watching it.
+
+This module is that scheme's lockstep-tensor adaptation:
+
+  * **The bank.**  Each problem lowers to a packed literal-occurrence
+    bank: ``occ_pos``/``occ_neg`` are ``i32[V, O]`` adjacency tables
+    (clause rows containing +v / -v, -1 padded) and ``card_occ`` is the
+    ``i32[NV, Oc]`` member→AtMost-row table.  ``O`` is the per-batch
+    max occurrence bucketed to a power of two and capped per size class
+    (:data:`deppy_tpu.size_classes.SIZE_CLASSES` ``OCC``): the bank is
+    the compressed column-sparse transpose of the clause matrix, padded
+    to the size class's block shape instead of a dense C×W grid.
+  * **The propagation loop.**  One dense entry round
+    (:func:`deppy_tpu.engine.core.round_planes`) evaluates the entry
+    state — the engine's fixpoints start from restored snapshots plus a
+    handful of new literals, so the entry round is the analog of 2WL's
+    watch initialization.  After that, propagation is implication
+    driven: a pending-literal bitplane plays the CDCL propagation
+    queue, each trip pops the lowest pending literal and visits ONLY
+    the adjacency rows of its falsified polarity (O clause rows, not
+    C), deriving units/conflicts from a masked recompute of those rows.
+  * **What happened to the watch pointers.**  Classic 2WL skips a
+    visited clause unless the falsified literal is one of its two
+    watches, and moves the watch on visit.  Pointer maintenance is a
+    scatter per visit and buys nothing in lockstep execution — a
+    masked-out lane costs exactly what an active lane costs under
+    ``vmap`` — so the bank keeps the *adjacency* half of the scheme
+    (the part that bounds memory traffic) and replaces the *watch-move*
+    half with the masked row recompute.  The visited set is a superset
+    of what watch pointers would visit; every skipped clause is skipped
+    by both schemes.
+
+Identity: BCP is monotone and confluent, so the fixpoint (and the
+conflict verdict) is independent of propagation order — the watched
+path returns byte-identical ``(conflict, t, f)`` to the dense rounds,
+which the fuzz differential pins (tests/test_bcp_watched.py) against
+both the dense kernels and the host reference engine.
+
+Cost model: a fixpoint that derives ``L`` literals costs one dense
+round plus ``L`` visits of ``O·(K + Wv)`` work, vs ``depth x C·Wv`` for
+the dense rounds.  The bet pays where clause sets are large and
+implication chains long (the giant-catalog / deep-chain classes); on
+small problems the dense rounds win because one round settles many
+lanes' literals at once.  Selection is ``DEPPY_TPU_BCP=watched`` /
+``set_bcp_impl``; ``auto`` stays on the measured-defaults registry —
+this impl becomes a default only behind a measured A/B row
+(scripts/tpu_ab.py carries the variant).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import core
+
+WORD = core.WORD
+
+
+# --------------------------------------------------------------------------
+# bank construction — host (numpy) side
+#
+# The driver's single-problem path (pad_problem(pack=True): tests, the
+# graft entry) builds banks on host; batch dispatches derive them on
+# device from the already-uploaded compact clause tensors
+# (:func:`derive_banks`) so no bank bytes cross host→device.
+
+
+def max_occurrence(clauses: np.ndarray) -> int:
+    """Max clause count any single literal occurs in (0 for an empty
+    clause set) — the live width the bank's ``O`` is bucketed from."""
+    lits = clauses[clauses != 0]
+    if lits.size == 0:
+        return 0
+    key = 2 * (np.abs(lits).astype(np.int64) - 1) + (lits < 0)
+    return int(np.bincount(key).max())
+
+
+def max_card_membership(card_ids: np.ndarray) -> int:
+    """Max AtMost-row count any single member variable occurs in."""
+    mem = card_ids[card_ids >= 0]
+    if mem.size == 0:
+        return 0
+    return int(np.bincount(mem.astype(np.int64)).max())
+
+
+def occ_from_clauses_np(clauses: np.ndarray, V: int, O: int,
+                        n_vars: "int | None" = None
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Signed clause matrix [C, K] → (occ_pos, occ_neg) ``i32[V, O]``
+    adjacency (-1 pad).  ``n_vars`` drops literals past it (the reduced
+    space's constant-true activations, exactly like ``pos_bits_r``)."""
+    occ_pos = np.full((V, O), -1, np.int32)
+    occ_neg = np.full((V, O), -1, np.int32)
+    rows, cols = np.nonzero(clauses)
+    lits = clauses[rows, cols]
+    if n_vars is not None:
+        keep = np.abs(lits) <= n_vars
+        rows, lits = rows[keep], lits[keep]
+    v = np.abs(lits).astype(np.int64) - 1
+    neg = lits < 0
+    order = np.lexsort((rows, neg, v))  # group by (v, sign), row-stable
+    v, neg, rows = v[order], neg[order], rows[order]
+    key = 2 * v + neg
+    first = np.searchsorted(key, key, side="left")
+    rank = np.arange(key.size) - first
+    for plane, m in ((occ_pos, ~neg), (occ_neg, neg)):
+        plane[v[m], rank[m]] = rows[m]
+    return occ_pos, occ_neg
+
+
+def card_occ_np(card_ids: np.ndarray, NV: int, Oc: int) -> np.ndarray:
+    """Member index matrix [NA, M] (-1 pad) → ``i32[NV, Oc]`` member →
+    AtMost-row adjacency (-1 pad)."""
+    out = np.full((NV, Oc), -1, np.int32)
+    rows, cols = np.nonzero(card_ids >= 0)
+    mem = card_ids[rows, cols].astype(np.int64)
+    order = np.lexsort((rows, mem))
+    mem, rows = mem[order], rows[order]
+    first = np.searchsorted(mem, mem, side="left")
+    rank = np.arange(mem.size) - first
+    out[mem, rank] = rows
+    return out
+
+
+# --------------------------------------------------------------------------
+# bank construction — device side
+
+
+def _grouped_scatter(keys: jax.Array, rows: jax.Array, n_keys: int,
+                     O: int) -> jax.Array:
+    """Grouped fill: for each key group (ascending), scatter its rows
+    into ``out[key, 0..count-1]``.  ``keys == n_keys`` is the invalid
+    sentinel (dropped).  The sort is stable, so rows land in clause
+    order — identical to the numpy build."""
+    order = jnp.argsort(keys)
+    ks = keys[order]
+    rs = rows[order]
+    first = jnp.searchsorted(ks, ks, side="left").astype(jnp.int32)
+    rank = jnp.arange(ks.shape[0], dtype=jnp.int32) - first
+    out = jnp.full((n_keys + 1, O), -1, jnp.int32)
+    # Axis-1 overflow (rank >= O) only ever happens in the sentinel
+    # group — the driver sizes O from the batch's measured max
+    # occurrence before routing a dispatch here — and mode="drop"
+    # discards it along with the sentinel row.
+    out = out.at[ks, rank].set(rs, mode="drop")
+    return out[:n_keys]
+
+
+def derive_banks(clauses: jax.Array, card_ids: jax.Array,
+                 n_vars: jax.Array, *, V: int, NV: int, Ob: int, Oc: int,
+                 red: bool, full: bool = True) -> Tuple[jax.Array, ...]:
+    """Batched device bank build from the compact clause tensors.
+
+    Returns (occ_pos, occ_neg, occ_pos_r, occ_neg_r, card_occ); spaces
+    not requested come back as 1-row dummies (the same placeholder
+    convention as :func:`core.derive_planes` — the watched fixpoint
+    detects a dummy bank by its row count and falls back to dense
+    rounds).  The driver calls this once per uploaded chunk, jitted and
+    cached per shape (:func:`deppy_tpu.engine.driver._bank_fn`)."""
+    B, C, K = clauses.shape
+
+    def occ_one(cl, nv, width, drop_acts):
+        lit = cl.reshape(-1)
+        v = jnp.where(lit != 0, jnp.abs(lit) - 1, 0)
+        valid = lit != 0
+        if drop_acts:
+            valid = valid & (jnp.abs(lit) <= nv)
+        key = jnp.where(valid, v * 2 + (lit < 0), 2 * width)
+        rows = (jnp.arange(C * K, dtype=jnp.int32) // K)
+        occ2 = _grouped_scatter(key.astype(jnp.int32), rows,
+                                2 * width, O=Ob)
+        return occ2[0::2], occ2[1::2]
+
+    def card_one(ci):
+        mem = ci.reshape(-1)
+        valid = mem >= 0
+        key = jnp.where(valid, mem, NV)
+        rows = (jnp.arange(mem.shape[0], dtype=jnp.int32)
+                // ci.shape[-1])
+        return _grouped_scatter(key.astype(jnp.int32), rows, NV, O=Oc)
+
+    if full:
+        occ_pos, occ_neg = jax.vmap(
+            lambda cl, nv: occ_one(cl, nv, V, False))(clauses, n_vars)
+    else:
+        occ_pos = jnp.full((B, 1, 1), -1, jnp.int32)
+        occ_neg = jnp.full((B, 1, 1), -1, jnp.int32)
+    if red:
+        occ_pos_r, occ_neg_r = jax.vmap(
+            lambda cl, nv: occ_one(cl, nv, NV, True))(clauses, n_vars)
+    else:
+        occ_pos_r = jnp.full((B, 1, 1), -1, jnp.int32)
+        occ_neg_r = jnp.full((B, 1, 1), -1, jnp.int32)
+    card_occ = jax.vmap(card_one)(card_ids)
+    return occ_pos, occ_neg, occ_pos_r, occ_neg_r, card_occ
+
+
+# --------------------------------------------------------------------------
+# implication-driven fixpoint
+
+
+def bank_ready(occ: jax.Array) -> bool:
+    """Static check: is this a real adjacency bank (vs the 1-row dummy
+    the driver ships when the impl is not 'watched' or the batch's max
+    occurrence exceeded its size class's OCC cap)?  Every real bank has
+    V >= 2 rows (NV >= 1 and NCON >= 1)."""
+    return occ.shape[-2] > 1
+
+
+def watched_fixpoint(clauses: jax.Array, n_vars: jax.Array,
+                     occ_pos: jax.Array, occ_neg: jax.Array,
+                     card_occ: jax.Array,
+                     pos: jax.Array, neg: jax.Array, mem: jax.Array,
+                     card_active: jax.Array, card_n2: jax.Array,
+                     min_bits: jax.Array, min_w: jax.Array,
+                     t0: jax.Array, f0: jax.Array, enabled: jax.Array,
+                     red: bool) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Propagate ``(t0, f0)`` to fixpoint via the clause bank.  Shapes
+    as in :func:`core.round_planes` plus the bank tables
+    (``occ_pos``/``occ_neg`` ``i32[Vb, O]``, ``card_occ``
+    ``i32[NVb, Oc]``) and the compact ``clauses i32[C, K]`` the visits
+    recompute from.  Returns (conflict, t, f) — byte-identical to the
+    dense rounds by confluence.
+
+    One dense entry round settles every consequence of the entry state;
+    after that each loop trip pops the lowest pending literal and
+    touches only its adjacency rows.  The pending planes are the
+    propagation queue: a bit enters pending in the same update that
+    sets it in ``t``/``f``, so every visit's recompute sees ALL
+    assignments derived so far (pending ⊆ assigned — the invariant that
+    makes one-literal-at-a-time processing reach the same closure as
+    whole-round processing).
+
+    ``red`` statically selects the reduced problem-var space: literals
+    past ``n_vars`` (constant-true activations) are dropped from the
+    visit recompute exactly as they are folded out of ``pos_bits_r``.
+
+    A disabled lane runs the entry round value-gated and zero loop
+    trips (the engine's lane-gating idiom: under ``vmap`` the cheap
+    entry compute runs for every lane anyway; the *loop* is where
+    skipping matters)."""
+    Wv = t0.shape[1]
+    C, K = clauses.shape
+    Vb = occ_pos.shape[0]
+    NVb = card_occ.shape[0]
+    word_idx = jnp.arange(Wv, dtype=jnp.int32)
+    # Host-built banks arrive as numpy; the loop indexes them with
+    # traced literals, which needs device arrays.
+    clauses = jnp.asarray(clauses)
+    occ_pos = jnp.asarray(occ_pos)
+    occ_neg = jnp.asarray(occ_neg)
+    card_occ = jnp.asarray(card_occ)
+    mem = jnp.asarray(mem)
+    card_active = jnp.asarray(card_active)
+    card_n2 = jnp.asarray(card_n2)
+
+    var_all = jnp.where(clauses != 0, jnp.abs(clauses) - 1, 0)
+    sign_all = jnp.sign(clauses)
+    live_lit = clauses != 0
+    if red:
+        live_lit = live_lit & (jnp.abs(clauses) <= n_vars)
+
+    run = jnp.asarray(enabled, bool)
+    # Dense entry round: the engine's fixpoints start from a restored
+    # snapshot plus a few fresh literals, and their first consequences
+    # can hide behind ANY clause — only a full scan finds them all.
+    c0, t1, f1, _ = core.round_planes(
+        pos, neg, mem, card_active, card_n2, min_bits, min_w, t0, f0,
+    )
+    conflict0 = run & c0
+    t1 = jnp.where(run, t1, t0)
+    f1 = jnp.where(run, f1, f0)
+    pend_t0 = t1 & ~t0
+    pend_f0 = f1 & ~f0
+    # Incremental row counters, seeded from the ENTRY assignment: the
+    # entry round's fresh literals sit in pending and count when
+    # popped.
+    trues0 = core.tree_sum(core.popcount32(mem & t0), axis=1,
+                           keepdims=True)
+    mtrues0 = core.tree_sum(core.popcount32(min_bits & t0))
+
+    def body(st):
+        conflict, t, f, pend_t, pend_f, trues, mtrues = st
+        p_any = (pend_t | pend_f)[0]
+        wi = jnp.argmax(p_any != 0).astype(jnp.int32)
+        word = p_any[wi]
+        lsb = word & -word
+        v = wi * WORD + core.popcount32(lsb - 1)
+        vm = jnp.where(word_idx == wi, lsb, 0)[None, :]
+        is_true = (pend_t & vm).any()
+        pend_t = pend_t & ~vm
+        pend_f = pend_f & ~vm
+
+        # Visit the adjacency rows of the falsified polarity: v=TRUE
+        # falsifies literal -v (occ_neg), v=FALSE falsifies +v.
+        vi = jnp.clip(v, 0, Vb - 1)
+        rows = jnp.where(is_true, occ_neg[vi], occ_pos[vi])
+        valid = rows >= 0
+        c = jnp.where(valid, rows, 0)
+        vv = var_all[c]
+        ss = sign_all[c]
+        lv = live_lit[c]
+        w = core._srl(vv, 5)
+        b = jnp.int32(1) << (vv & 31)
+        tb = (t[0][w] & b) != 0
+        fb = (f[0][w] & b) != 0
+        val = ss * jnp.where(tb, 1, jnp.where(fb, -1, 0))
+        val = jnp.where(lv, val, jnp.int32(core.FALSE))
+        sat_c = (val == core.TRUE).any(axis=1)
+        n_un = (val == core.UNASSIGNED).sum(axis=1)
+        visited = valid & lv.any(axis=1)
+        dead = visited & ~sat_c & (n_un == 0)
+        unit = visited & ~sat_c & (n_un == 1)
+        ucol = jnp.argmax(val == core.UNASSIGNED, axis=1)
+        uvar = jnp.take_along_axis(vv, ucol[:, None], 1)[:, 0]
+        usign = jnp.take_along_axis(ss, ucol[:, None], 1)[:, 0]
+        wsel = (core._srl(uvar, 5)[:, None] == word_idx[None, :])
+        ubit = (jnp.int32(1) << (uvar & 31))[:, None]
+        add_t = core.or_reduce_rows(
+            jnp.where(wsel & (unit & (usign > 0))[:, None], ubit, 0))
+        add_f = core.or_reduce_rows(
+            jnp.where(wsel & (unit & (usign < 0))[:, None], ubit, 0))
+
+        a_now = t | f
+
+        # AtMost rows: only a TRUE assignment moves a row's count.
+        crows = card_occ[jnp.clip(v, 0, NVb - 1)]
+        cvalid = (crows >= 0) & is_true & (v < NVb)
+        r = jnp.where(cvalid, crows, 0)
+        trues = trues.at[r, 0].add(cvalid.astype(jnp.int32))
+        tr = trues[r, 0]
+        act = card_active[r, 0] & cvalid
+        over = act & (tr > card_n2[r, 0])
+        full_r = act & (tr == card_n2[r, 0])
+        add_f = add_f | core.or_reduce_rows(
+            jnp.where(full_r[:, None], mem[r] & ~a_now, 0))
+
+        # Dynamic "at most w of the extras" bound.
+        in_min = is_true & ((min_bits & vm) != 0).any()
+        mtrues = mtrues + in_min.astype(jnp.int32)
+        min_over = in_min & (mtrues > min_w)
+        add_f = jnp.where(in_min & (mtrues == min_w),
+                          add_f | (min_bits & ~a_now), add_f)
+
+        new_t = add_t & ~a_now
+        new_f = add_f & ~a_now
+        t = t | new_t
+        f = f | new_f
+        pend_t = pend_t | new_t
+        pend_f = pend_f | new_f
+        conflict = (conflict | dead.any() | over.any() | min_over
+                    | ((t & f) != 0).any())
+        return conflict, t, f, pend_t, pend_f, trues, mtrues
+
+    def cond(st):
+        conflict, _, _, pend_t, pend_f, _, _ = st
+        return ~conflict & ((pend_t | pend_f) != 0).any()
+
+    st = (conflict0, t1, f1, pend_t0, pend_f0, trues0, mtrues0)
+    conflict, t, f, _, _, _, _ = lax.while_loop(cond, body, st)
+    return conflict, t, f

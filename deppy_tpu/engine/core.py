@@ -107,6 +107,18 @@ class ProblemTensors(NamedTuple):
     neg_bits_r: jax.Array       # i32[C, Wr]
     card_member_bits_r: jax.Array  # i32[NA, Wr]
     card_valid: jax.Array       # i32[NA]  1 on real AtMost rows, 0 on pads
+    # Compressed clause banks (ISSUE 12): literal→clause adjacency for
+    # the implication-driven "watched" BCP impl — occ_pos/occ_neg list
+    # the clause rows containing +v/-v (i32[V, Ob], -1 padded; _r =
+    # the reduced problem-var space), card_occ the AtMost rows each
+    # member variable sits in (i32[NV, Oc]).  Every other impl (and a
+    # batch whose occurrence width exceeds its size class's OCC cap)
+    # ships 1-row dummies; see deppy_tpu.engine.clause_bank.
+    occ_pos: jax.Array          # i32[V, Ob]
+    occ_neg: jax.Array          # i32[V, Ob]
+    occ_pos_r: jax.Array        # i32[NV, Ob]
+    occ_neg_r: jax.Array        # i32[NV, Ob]
+    card_occ: jax.Array         # i32[NV, Oc]
 
 
 class SolveResult(NamedTuple):
@@ -474,14 +486,25 @@ def bcp_round(pt: ProblemTensors, assign: jax.Array,
 
 # BCP implementation selection: "gather" = the [C, K] literal-gather round
 # above; "bits" = jnp bitplane algebra; "pallas" = the fused fixpoint kernel
-# holding the planes in VMEM across rounds.  "auto" = "bits": measured on a
-# real v5-lite chip (256-problem random-catalog batch), bits is 18.7× faster
-# than gather (368/s vs 19.7/s) and 1.8× faster than the Pallas kernel —
-# under vmap, XLA vectorizes the batch axis of the bitplane algebra across
-# VPU lanes, while a vmapped pallas_call serializes problems into grid
-# steps.  The kernel pays off only for single very large problems (clause
-# planes near VMEM capacity), so it stays opt-in.
+# holding the planes in VMEM across rounds; "watched" = the compressed
+# clause-bank implication-driven path (engine/clause_bank.py — visits
+# only the clauses adjacent to a newly-falsified literal instead of
+# scanning every row per round).  "auto" = the measured-defaults
+# registry's "bcp" row for this backend when one exists, else "bits":
+# measured on a real v5-lite chip (256-problem random-catalog batch),
+# bits is 18.7× faster than gather (368/s vs 19.7/s) and 1.8× faster
+# than the Pallas kernel — under vmap, XLA vectorizes the batch axis of
+# the bitplane algebra across VPU lanes, while a vmapped pallas_call
+# serializes problems into grid steps.  The kernel pays off only for
+# single very large problems (clause planes near VMEM capacity), so it
+# stays opt-in; "watched" likewise defaults off until a measured A/B
+# row lands (scripts/tpu_ab.py carries the variant).  Measured on this
+# box (CPU XLA, r12): watched wins 7x on deep-implication-chain batches
+# (1855/s vs 260/s, 96 lanes x depths 48-192) and loses ~10% on the
+# mixed random-catalog fleet — benchmarks/results/bcp_rewrite_r12.json.
 _BCP_IMPL = config.env_raw("DEPPY_TPU_BCP", "auto")
+
+_BCP_IMPLS = ("auto", "gather", "bits", "pallas", "blockwise", "watched")
 
 # Propagation rounds applied per fixpoint while_loop trip (the "bits"
 # path only).  >1 trades redundant work on converged lanes for fewer
@@ -609,9 +632,9 @@ def clear_batched_caches() -> None:
 
 def set_bcp_impl(name: str) -> None:
     """Select the BCP implementation ('auto'|'gather'|'bits'|'pallas'|
-    'blockwise') and invalidate compiled solves."""
+    'blockwise'|'watched') and invalidate compiled solves."""
     global _BCP_IMPL
-    if name not in ("auto", "gather", "bits", "pallas", "blockwise"):
+    if name not in _BCP_IMPLS:
         raise ValueError(f"unknown BCP impl {name!r}")
     _BCP_IMPL = name
     clear_batched_caches()
@@ -642,15 +665,24 @@ _MEASURED_DEFAULTS: Optional[dict] = None
 def measured_default(key: str) -> Optional[str]:
     """The measured default recorded for ``key`` on the current backend
     (None when no measured row exists).  Keys in use: ``search``
-    (phase-substrate: 'fused'|'xla') and ``spec_core`` ('on'|'off')."""
+    (phase-substrate: 'fused'|'xla'), ``spec_core`` ('on'|'off'), and
+    ``bcp`` (propagation impl, e.g. 'watched'|'bits')."""
     global _MEASURED_DEFAULTS
+    # Reachable at trace time via _resolved_impl (the auto impl route):
+    # the registry read is memoized into module state whose only write
+    # path (reload_measured_defaults) drops every compiled program, so
+    # a traced program can never go stale against it — the exact
+    # contract the compile-surface/trace-purity rules exist to enforce.
+    # deppy: lint-ok[compile-surface] memoized; reload_measured_defaults invalidates the jit caches
     if _MEASURED_DEFAULTS is None:
         try:
+            # deppy: lint-ok[trace-purity] one memoized registry read; re-traces reuse the cached dict
             with open(_MEASURED_DEFAULTS_PATH) as f:
                 loaded = json.load(f)
             _MEASURED_DEFAULTS = loaded if isinstance(loaded, dict) else {}
         except (OSError, ValueError):
             _MEASURED_DEFAULTS = {}
+    # deppy: lint-ok[compile-surface] memoized; reload_measured_defaults invalidates the jit caches
     entry = _MEASURED_DEFAULTS.get(jax.default_backend())
     val = entry.get(key) if isinstance(entry, dict) else None
     return val if isinstance(val, str) else None
@@ -719,7 +751,14 @@ def _has_full_planes(pts, V: int) -> bool:
 def _resolved_impl() -> str:
     # deppy: lint-ok[compile-surface] trace-time impl dispatch by design: set_bcp_impl's write invalidates every compiled program via clear_batched_caches
     impl = _BCP_IMPL
-    return "bits" if impl == "auto" else impl
+    if impl != "auto":
+        return impl
+    # Measured-defaults route (ISSUE 12 policy: engine bets become
+    # defaults only behind a same-backend A/B row, never by fiat).
+    measured = measured_default("bcp")
+    if measured in _BCP_IMPLS and measured != "auto":
+        return measured
+    return "bits"
 
 
 def _bcp_gather(pt: ProblemTensors, assign: jax.Array,
@@ -798,7 +837,8 @@ def planes_fixpoint(pt: ProblemTensors, t: jax.Array, f: jax.Array,
         return (conflict | pre_conflict,
                 pack_mask(assign == TRUE, Wv), pack_mask(assign == FALSE, Wv))
     if red:
-        assert impl == "bits", "reduced planes are a bits-impl path"
+        assert impl in ("bits", "watched"), \
+            "reduced planes are a bits/watched-impl path"
         pos, neg, mem = pt.pos_bits_r, pt.neg_bits_r, pt.card_member_bits_r
         card_active = (pt.card_valid != 0)[:, None]
     else:
@@ -806,6 +846,25 @@ def planes_fixpoint(pt: ProblemTensors, t: jax.Array, f: jax.Array,
         # Activation bits never flip inside a fixpoint (see round_planes),
         # so row activity is computed once from the entry state.
         card_active = ((pt.card_act_bits & t) != 0).any(axis=1, keepdims=True)
+    if impl == "watched" and _clause_axis_name() is None:
+        # Implication-driven propagation over the compressed clause
+        # bank (ISSUE 12).  A dummy bank — the driver ships one when
+        # the batch's occurrence width exceeds its size class's OCC cap
+        # — statically falls through to the dense rounds below.  Under
+        # clause sharding the bank rows would straddle shards, so the
+        # sharded program stays on the dense rounds (which carry the
+        # per-round collective).
+        from . import clause_bank
+
+        occ_p = pt.occ_pos_r if red else pt.occ_pos
+        occ_n = pt.occ_neg_r if red else pt.occ_neg
+        if clause_bank.bank_ready(occ_p):
+            conflict, t, f = clause_bank.watched_fixpoint(
+                pt.clauses, pt.n_vars, occ_p, occ_n, pt.card_occ,
+                pos, neg, mem, card_active, card_n2, min_bits,
+                min_w, t, f, run, red,
+            )
+            return conflict | pre_conflict, t, f
     if impl == "pallas":
         from . import pallas_bcp
 
@@ -1558,8 +1617,8 @@ def solve_full(pt: ProblemTensors, budget: jax.Array,
 
 def phases_reduced() -> bool:
     """Whether the search/minimization phases run in the reduced
-    problem-var plane space (bits impl only; see ProblemTensors)."""
-    return _resolved_impl() == "bits"
+    problem-var plane space (bits/watched impls; see ProblemTensors)."""
+    return _resolved_impl() in ("bits", "watched")
 
 
 @functools.lru_cache(maxsize=128)

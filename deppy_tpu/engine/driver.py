@@ -31,6 +31,7 @@ import numpy as np
 
 from .. import config, faults, telemetry
 from .. import profile as _profile
+from .. import size_classes as _size_classes
 from ..analysis import compileguard
 from ..sat.constraints import Variable
 from ..sat.encode import Problem, encode
@@ -94,12 +95,10 @@ def _telem_record_pad(problems, total: int, d: _Dims, n_chunks: int,
 
 
 def _bucket(n: int, minimum: int = 1) -> int:
-    """Round up to the next power of two (≥ minimum)."""
-    n = max(n, minimum)
-    out = 1
-    while out < n:
-        out <<= 1
-    return out
+    """Round up to the next power of two (≥ minimum) — delegated to the
+    shared size-class module so class arithmetic and live padding use
+    one quantum."""
+    return _size_classes.bucket(n, minimum)
 
 
 def _pad2(a: np.ndarray, rows: int, cols: int, fill: int) -> np.ndarray:
@@ -138,6 +137,35 @@ class _Dims:
         if b % batch_multiple:
             b *= batch_multiple // np.gcd(b, batch_multiple)
         self.B = b
+        # Clause-bank widths (ISSUE 12) are data-dependent (max literal
+        # occurrence / card membership over the batch) and only the
+        # watched impl reads them — computed lazily so every other
+        # dispatch skips the counting pass.
+        self._problems = list(problems)
+        self._Ob: Optional[int] = None
+        self._Oc: Optional[int] = None
+
+    @property
+    def Ob(self) -> int:
+        """Bucketed literal-occurrence width of the watched clause bank."""
+        if self._Ob is None:
+            from . import clause_bank
+
+            self._Ob = _bucket(max(
+                (clause_bank.max_occurrence(p.clauses)
+                 for p in self._problems), default=0))
+        return self._Ob
+
+    @property
+    def Oc(self) -> int:
+        """Bucketed member→AtMost-row width of the watched bank."""
+        if self._Oc is None:
+            from . import clause_bank
+
+            self._Oc = _bucket(max(
+                (clause_bank.max_card_membership(p.card_ids)
+                 for p in self._problems), default=0))
+        return self._Oc
 
 
 def _pack_planes(clauses: np.ndarray, Wv: int) -> tuple:
@@ -194,6 +222,26 @@ def pad_problem(p: Problem, d: _Dims, pack: bool = True) -> core.ProblemTensors:
         pos_bits_r = np.zeros((d.C, 1), np.int32)
         neg_bits_r = np.zeros((d.C, 1), np.int32)
         member_r = np.zeros((d.NA, 1), np.int32)
+    if pack and d.Ob <= _bank_cap(d):
+        # Clause banks ride every packed single-problem build (tests
+        # flip impls AFTER padding via set_bcp_impl, so the bank must
+        # already be there); the dispatch paths (pack=False) derive
+        # them on device only when the watched impl is selected.  The
+        # size-class OCC cap applies here exactly as on the device
+        # path: past it every impl runs dense rounds, so building (and
+        # — on the clause-sharded path — replicating) a huge bank a
+        # popular literal inflated would be pure dead weight.
+        from . import clause_bank
+
+        occ_pos, occ_neg = clause_bank.occ_from_clauses_np(
+            clauses, d.V, d.Ob)
+        occ_pos_r, occ_neg_r = clause_bank.occ_from_clauses_np(
+            clauses, d.NV, d.Ob, n_vars=p.n_vars)
+        card_occ = clause_bank.card_occ_np(card_ids, d.NV, d.Oc)
+    else:
+        occ_pos = occ_neg = np.full((1, 1), -1, np.int32)
+        occ_pos_r = occ_neg_r = np.full((1, 1), -1, np.int32)
+        card_occ = np.full((1, 1), -1, np.int32)
     return core.ProblemTensors(
         clauses=clauses,
         card_ids=card_ids,
@@ -212,6 +260,11 @@ def pad_problem(p: Problem, d: _Dims, pack: bool = True) -> core.ProblemTensors:
         neg_bits_r=neg_bits_r,
         card_member_bits_r=member_r,
         card_valid=(card_act >= 0).astype(np.int32),
+        occ_pos=occ_pos,
+        occ_neg=occ_neg,
+        occ_pos_r=occ_pos_r,
+        occ_neg_r=occ_neg_r,
+        card_occ=card_occ,
     )
 
 
@@ -306,6 +359,24 @@ def pad_stack(problems: Sequence[Problem], d: _Dims, total: int,
         pos_bits_r = np.zeros((total, d.C, 1), np.int32)
         neg_bits_r = np.zeros((total, d.C, 1), np.int32)
         member_r = np.zeros((total, d.NA, 1), np.int32)
+    if pack and d.Ob <= _bank_cap(d):
+        from . import clause_bank
+
+        occ_pos = np.full((total, d.V, d.Ob), -1, np.int32)
+        occ_neg = np.full((total, d.V, d.Ob), -1, np.int32)
+        occ_pos_r = np.full((total, d.NV, d.Ob), -1, np.int32)
+        occ_neg_r = np.full((total, d.NV, d.Ob), -1, np.int32)
+        card_occ = np.full((total, d.NV, d.Oc), -1, np.int32)
+        for i, p in enumerate(problems):
+            occ_pos[i], occ_neg[i] = clause_bank.occ_from_clauses_np(
+                clauses[i], d.V, d.Ob)
+            occ_pos_r[i], occ_neg_r[i] = clause_bank.occ_from_clauses_np(
+                clauses[i], d.NV, d.Ob, n_vars=int(p.n_vars))
+            card_occ[i] = clause_bank.card_occ_np(card_ids[i], d.NV, d.Oc)
+    else:
+        occ_pos = occ_neg = np.full((total, 1, 1), -1, np.int32)
+        occ_pos_r = occ_neg_r = np.full((total, 1, 1), -1, np.int32)
+        card_occ = np.full((total, 1, 1), -1, np.int32)
     return core.ProblemTensors(
         clauses=clauses,
         card_ids=card_ids,
@@ -324,6 +395,11 @@ def pad_stack(problems: Sequence[Problem], d: _Dims, total: int,
         neg_bits_r=neg_bits_r,
         card_member_bits_r=member_r,
         card_valid=(card_act >= 0).astype(np.int32),
+        occ_pos=occ_pos,
+        occ_neg=occ_neg,
+        occ_pos_r=occ_pos_r,
+        occ_neg_r=occ_neg_r,
+        card_occ=card_occ,
     )
 
 
@@ -344,6 +420,50 @@ def _planes_fn(Wv: int, Wr: int, red: bool, full: bool):
                            full=full),
         static=(Wv, Wr, red, full),
     ))
+
+
+# Watched-bank occurrence-width cap (0 = the dispatch's size-class OCC
+# cap from the shared ladder): a batch whose max per-literal clause
+# count exceeds the cap would pay an occ table of V x Ob cells mostly
+# for one popular literal — those dispatches ship dummy banks and the
+# compiled program statically falls back to the dense rounds.
+BANK_OCC_CAP = int(config.env_raw("DEPPY_TPU_BANK_OCC_CAP", "0"))
+
+
+@_functools.lru_cache(maxsize=128)
+def _bank_fn(V: int, NV: int, Ob: int, Oc: int, red: bool, full: bool):
+    from . import clause_bank
+
+    return jax.jit(compileguard.observe(
+        "driver.bank_fn",
+        _functools.partial(clause_bank.derive_banks, V=V, NV=NV, Ob=Ob,
+                           Oc=Oc, red=red, full=full),
+        static=(V, NV, Ob, Oc, red, full),
+    ))
+
+
+def _bank_cap(d: "_Dims") -> int:
+    if BANK_OCC_CAP > 0:
+        return BANK_OCC_CAP
+    name = _size_classes.class_of_cost((d.C + 2 * d.NV) * d.Wv)
+    return _size_classes.occ_cap(name)
+
+
+def _derive_banks(pts: core.ProblemTensors, d: "_Dims", red: bool,
+                  full: bool) -> core.ProblemTensors:
+    """Replace the dummy clause-bank fields with device-derived banks
+    (watched impl only; reads the chunk's device-resident compact
+    tensors).  A batch whose occurrence width exceeds its cap keeps the
+    dummies — the watched program detects them statically and runs the
+    dense rounds instead."""
+    if d.Ob > _bank_cap(d):
+        return pts
+    occ_pos, occ_neg, occ_pos_r, occ_neg_r, card_occ = _bank_fn(
+        d.V, d.NV, d.Ob, d.Oc, red, full
+    )(pts.clauses, pts.card_ids, pts.n_vars)
+    return pts._replace(occ_pos=occ_pos, occ_neg=occ_neg,
+                        occ_pos_r=occ_pos_r, occ_neg_r=occ_neg_r,
+                        card_occ=card_occ)
 
 
 def _derive_planes(pts: core.ProblemTensors, d: _Dims,
@@ -374,10 +494,13 @@ def _derive_planes(pts: core.ProblemTensors, d: _Dims,
     pos, neg, mem, act, pos_r, neg_r, mem_r = _planes_fn(
         d.Wv, d.Wr, red, full
     )(pts.clauses, pts.card_ids, pts.card_act, pts.n_vars)
-    return pts._replace(
+    pts = pts._replace(
         pos_bits=pos, neg_bits=neg, card_member_bits=mem, card_act_bits=act,
         pos_bits_r=pos_r, neg_bits_r=neg_r, card_member_bits_r=mem_r,
     )
+    if core._resolved_impl() == "watched":
+        pts = _derive_banks(pts, d, red, full)
+    return pts
 
 
 def _derive_full(pts: core.ProblemTensors, d: _Dims) -> core.ProblemTensors:
@@ -387,9 +510,17 @@ def _derive_full(pts: core.ProblemTensors, d: _Dims) -> core.ProblemTensors:
     pos, neg, mem, act, _, _, _ = _planes_fn(d.Wv, d.Wr, False, True)(
         pts.clauses, pts.card_ids, pts.card_act, pts.n_vars
     )
-    return pts._replace(
+    pts = pts._replace(
         pos_bits=pos, neg_bits=neg, card_member_bits=mem, card_act_bits=act,
     )
+    if core._resolved_impl() == "watched" and d.Ob <= _bank_cap(d):
+        # Full-space banks only — the chunk's reduced banks stay.
+        occ_pos, occ_neg, _, _, card_occ = _bank_fn(
+            d.V, d.NV, d.Ob, d.Oc, False, True
+        )(pts.clauses, pts.card_ids, pts.n_vars)
+        pts = pts._replace(occ_pos=occ_pos, occ_neg=occ_neg,
+                           card_occ=card_occ)
+    return pts
 
 
 _EMPTY_PROBLEM: Optional[Problem] = None
@@ -702,9 +833,11 @@ def _profile_dispatch(t0, problems, d: _Dims, steps: np.ndarray,
     AFTER the result fetch (host numpy in hand — never inside traced
     code).  ``steps`` are the dispatch's final per-lane counts, live
     lanes first; ``chunk`` is the lockstep program width."""
+    cost = max(_cost_proxy(p) for p in problems)
     _profile.record_device_dispatch(
         t0, steps=steps, live=live, chunk=chunk,
-        size_class=_bucket(max(_cost_proxy(p) for p in problems)),
+        size_class=_bucket(cost),
+        size_class_name=_size_classes.class_of_cost(cost),
         pad_cells=int(total) * d.C * d.K,
         live_cells=int(sum(p.clauses.size for p in problems)))
 
@@ -1017,53 +1150,106 @@ def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
 
 
 # Size-class bucketing (SURVEY.md §7.3 items 4-5): a heterogeneous fleet
-# batch is partitioned into up to MAX_BUCKETS shape classes so one large
-# straggler doesn't inflate every lane's padded planes.  Buckets below
-# MIN_BUCKET problems aren't worth a separate dispatch and merge upward.
+# batch is partitioned into size classes so one large straggler doesn't
+# inflate every lane's padded planes.  The class boundaries come from the
+# SHARED ladder (deppy_tpu.size_classes — the same table the
+# block-contract lint tier evaluates), so a 64-clause problem lands in
+# `xs` and never shares dims with an `l` problem, whatever the cost
+# distribution between them looks like.  The pre-ISSUE-12 splitter cut
+# only at >= SPLIT_RATIO jumps between ADJACENT sorted costs — on a
+# smooth distribution no adjacent jump ever reaches the ratio even when
+# the extremes span 64x, which is exactly how a 64-clause problem ended
+# up paying a 4096-clause pad (`block-pad-waste`, ROADMAP item 1).  That
+# splitter is kept behind DEPPY_TPU_SIZE_LADDER=off for A/B (and
+# MAX_BUCKETS keeps its pre-ladder value so that arm reproduces the
+# replaced partitioner exactly; under the ladder it caps the jump
+# splits WITHIN each class).  Buckets below MIN_BUCKET problems aren't
+# worth a separate dispatch and merge with their neighbor.
 MAX_BUCKETS = 4
 MIN_BUCKET = 16
 # Only split at a size-class boundary when the padded per-lane cost ratio
-# across it is at least this factor.
-SPLIT_RATIO = 2.0
+# across it is at least this factor (shared with the lint contracts).
+SPLIT_RATIO = _size_classes.SPLIT_RATIO
+
+# Ladder-vs-legacy partitioner selection ('on' = the shared size-class
+# ladder; 'off' = the adjacent-jump splitter, kept for A/B).
+_SIZE_LADDER = config.env_raw("DEPPY_TPU_SIZE_LADDER", "on")
 
 
 def _cost_proxy(p: Problem) -> int:
-    """Padded per-lane cost proxy: clause-plane area dominates BCP; the
-    var count drives DPLL snapshot size and iteration count."""
-    NV = _bucket(max(p.n_vars, 1))
-    NCON = _bucket(max(p.n_cons, 1))
-    Wv = -(-(NV + NCON) // core.WORD)
-    C = _bucket(p.clauses.shape[0])
-    return (C + 2 * NV) * Wv
+    """Padded per-lane cost proxy (shared model:
+    :func:`deppy_tpu.size_classes.cost_proxy`): clause-plane area
+    dominates BCP; the var count drives DPLL snapshot size and
+    iteration count."""
+    return _size_classes.cost_proxy(p.clauses.shape[0], p.n_vars,
+                                    p.n_cons)
+
+
+def _merge_small(buckets: List[List[int]]) -> List[List[int]]:
+    """Merge under-MIN_BUCKET buckets into the previous (smaller-class)
+    neighbor: a dedicated dispatch for a handful of lanes wastes more
+    than the neighbor's re-pad."""
+    merged: List[List[int]] = []
+    for idxs in buckets:
+        if merged and (len(idxs) < MIN_BUCKET
+                       or len(merged[-1]) < MIN_BUCKET):
+            merged[-1].extend(idxs)
+        else:
+            merged.append(idxs)
+    return merged
+
+
+def _jump_splits(costs: np.ndarray, order: np.ndarray,
+                 max_buckets: int) -> List[List[int]]:
+    """Cut a sorted cost run at its largest adjacent-cost jumps (up to
+    ``max_buckets - 1`` of them, each >= SPLIT_RATIO)."""
+    n = order.size
+    sc = costs[order]
+    ratios = sc[1:] / np.maximum(sc[:-1], 1)
+    cand = np.nonzero(ratios >= SPLIT_RATIO)[0]
+    cand = cand[np.argsort(ratios[cand])[::-1][: max_buckets - 1]]
+    splits = sorted(int(i) + 1 for i in cand)
+    bounds = [0] + splits + [n]
+    return [order[lo:hi].tolist()
+            for lo, hi in zip(bounds[:-1], bounds[1:])]
+
+
+def _partition_legacy(costs: np.ndarray, order: np.ndarray,
+                      n: int) -> List[List[int]]:
+    """Pre-ladder splitter: adjacent-cost jumps only — blind to a
+    smooth distribution whose extremes span a class boundary."""
+    return _merge_small(_jump_splits(costs, order, MAX_BUCKETS))
 
 
 def partition_buckets(problems: Sequence[Problem]) -> List[List[int]]:
-    """Partition problem indices into ≤ MAX_BUCKETS size classes, splitting
-    only at ≥ SPLIT_RATIO jumps in padded cost.  Returns index lists; a
-    homogeneous batch comes back as one bucket."""
+    """Partition problem indices into size-class buckets: first along
+    the shared ladder's class boundaries (a 64-clause problem never
+    shares dims with a 4096-clause one, however smooth the cost
+    distribution), then at >= SPLIT_RATIO adjacent-cost jumps WITHIN
+    each class (a class can still span a big jump — e.g. 24-var and
+    96-var problems both landing in `xs`).  Strictly finer than the
+    legacy jump-only splitter before the small-bucket merge.  Returns
+    index lists; a homogeneous batch comes back as one bucket."""
     n = len(problems)
     if n < 2 * MIN_BUCKET:
         return [list(range(n))]
     costs = np.array([_cost_proxy(p) for p in problems], dtype=np.int64)
     order = np.argsort(costs, kind="stable")
-    sc = costs[order]
-    ratios = sc[1:] / np.maximum(sc[:-1], 1)
-    cand = np.nonzero(ratios >= SPLIT_RATIO)[0]
-    # Keep the largest jumps first, at most MAX_BUCKETS - 1 splits.
-    cand = cand[np.argsort(ratios[cand])[::-1][: MAX_BUCKETS - 1]]
-    splits = sorted(int(i) + 1 for i in cand)
-    bounds = [0] + splits + [n]
-    buckets = []
-    for lo, hi in zip(bounds[:-1], bounds[1:]):
-        idxs = order[lo:hi].tolist()
-        # Too-small buckets merge into the previous (larger-capacity
-        # neighbors would re-pad them; merging upward wastes less than a
-        # dedicated dispatch for a handful of lanes).
-        if buckets and (len(idxs) < MIN_BUCKET or len(buckets[-1]) < MIN_BUCKET):
-            buckets[-1].extend(idxs)
-        else:
-            buckets.append(idxs)
-    return buckets
+    if _SIZE_LADDER == "off":
+        return _partition_legacy(costs, order, n)
+    buckets: List[List[int]] = []
+    run: List[int] = []
+    cur: Optional[str] = None
+    for i in order.tolist():
+        name = _size_classes.class_of_cost(int(costs[i]))
+        if name != cur and run:
+            buckets += _jump_splits(costs, np.array(run), MAX_BUCKETS)
+            run = []
+        cur = name
+        run.append(i)
+    if run:
+        buckets += _jump_splits(costs, np.array(run), MAX_BUCKETS)
+    return _merge_small(buckets)
 
 
 # Progressive budget escalation (SURVEY.md §7.3 item 4's "compaction of

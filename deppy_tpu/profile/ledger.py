@@ -173,7 +173,8 @@ def _percentile(sorted_vals: np.ndarray, q: float) -> int:
 
 def record_device_dispatch(t0: float, *, steps: np.ndarray, live: int,
                            chunk: int, size_class: int, pad_cells: int,
-                           live_cells: int, backend: str = "device") -> None:
+                           live_cells: int, backend: str = "device",
+                           size_class_name: Optional[str] = None) -> None:
     """Record one sampled device dispatch's trip ledger.
 
     ``steps`` is the dispatch's final per-lane iteration counts
@@ -238,7 +239,14 @@ def record_device_dispatch(t0: float, *, steps: np.ndarray, live: int,
         "Padded clause-cell waste per sampled dispatch.",
         buckets=telemetry.RATIO_BUCKETS).observe(pad_waste)
     _backend_counters(reg, backend, dur_s, live)
+    fields = {}
+    if size_class_name is not None:
+        # The dispatch's ladder class (deppy_tpu.size_classes): keys
+        # the `deppy profile` per-class table by name instead of the
+        # raw bucketed cost.
+        fields["size_class_name"] = size_class_name
     reg.event("profile", backend=backend, size_class=int(size_class),
+              **fields,
               lanes=total, live=live, chunk=chunk, trips=trips,
               lane_steps=lane_work, lane_p50=p50, lane_p99=p99,
               useful_work_ratio=round(useful, 4),

@@ -95,7 +95,10 @@ def _trip_regression(device: List[dict]) -> Optional[dict]:
 def _size_classes(device: List[dict]) -> Dict[str, dict]:
     classes: Dict[str, dict] = {}
     for ev in device:
-        key = str(ev.get("size_class", "?"))
+        # Ladder-class name when the event carries one (ISSUE 12);
+        # older sinks fall back to the raw bucketed-cost key.
+        key = str(ev.get("size_class_name")
+                  or ev.get("size_class", "?"))
         agg = classes.setdefault(key, {
             "dispatches": 0, "lanes": 0, "live": 0, "trips": 0,
             "lane_steps": 0, "solve_s": 0.0,

@@ -1,0 +1,230 @@
+"""ISSUE 12 A/B harness: measured evidence for the clause-bank rewrite.
+
+Produces ``benchmarks/results/bcp_rewrite_r12.json``: `deppy profile`
+cost-model snapshots (µs/trip regression, useful-work ratio, pad waste
+per size class) BEFORE the rewrite (legacy adjacent-jump partitioner,
+dense bits propagation) and AFTER (shared size-class ladder; ladder +
+watched clause banks), on two workloads —
+
+  * **fleet** — a mixed-size batch spanning ladder classes, where the
+    partitioner change is the lever (pad waste / useful work);
+  * **chain** — deep implication chains, the watched impl's target
+    class (fixpoint rounds = chain depth for the dense rounds, one
+    visit per derived literal for the bank).
+
+Each variant runs in a fresh subprocess with its knobs in env (the
+tpu_ab pattern: no cross-variant compile-cache contamination), timing
+min-of-passes (2-CPU boxes are noisy) with the trip ledger recorded on
+a separate untimed armed pass — the same methodology as the bench
+harness.  ``--with-bench`` appends fresh headline + churn bench rows
+(the PR 10 ledger columns ride in both).
+
+Run: ``python scripts/bcp_ab.py [--passes 3] [--with-bench]``.
+Forced CPU unless the caller overrides JAX_PLATFORMS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT_PATH = os.path.join(REPO, "benchmarks", "results",
+                        "bcp_rewrite_r12.json")
+
+VARIANTS = [
+    # (name, knobs) — "before" is the pre-ISSUE-12 engine: adjacent-jump
+    # partitioner + dense bitplane rounds.
+    ("before", {"DEPPY_TPU_SIZE_LADDER": "off", "DEPPY_TPU_BCP": "bits"}),
+    ("ladder", {"DEPPY_TPU_SIZE_LADDER": "on", "DEPPY_TPU_BCP": "bits"}),
+    ("ladder+watched", {"DEPPY_TPU_SIZE_LADDER": "on",
+                        "DEPPY_TPU_BCP": "watched"}),
+]
+
+
+# ------------------------------------------------------------------ worker
+
+
+def _fleet_problems():
+    from deppy_tpu import sat
+    from deppy_tpu.models import random_instance
+    from deppy_tpu.sat.encode import encode
+
+    def clausey(n_vars, n_clauses):
+        cons, k = [], 0
+        for i in range(1, n_vars):
+            for j in range(i + 1, n_vars):
+                if k >= n_clauses:
+                    break
+                cons.append(sat.dependency(f"v{i}", f"v{j}"))
+                k += 1
+            if k >= n_clauses:
+                break
+        vs = [sat.variable("v0", sat.mandatory(), *cons)]
+        vs += [sat.variable(f"v{i}") for i in range(1, n_vars)]
+        return encode(vs)
+
+    out = [encode(random_instance(length=24, seed=s)) for s in range(64)]
+    out += [encode(random_instance(length=48, seed=s)) for s in range(64)]
+    for n_clauses, count in ((20, 32), (40, 32), (80, 64)):
+        out += [clausey(96, n_clauses)] * count
+    return out
+
+
+def _chain_problems():
+    """Deep implication chains at a few depths (distinct trip counts
+    feed the µs/trip regression): each solves by pure propagation."""
+    from deppy_tpu import sat
+    from deppy_tpu.sat.encode import encode
+
+    out = []
+    for depth in (48, 96, 192):
+        vs = [sat.variable("a0", sat.mandatory(), sat.dependency("a1"))]
+        vs += [sat.variable(f"a{i}", sat.dependency(f"a{i + 1}"))
+               for i in range(1, depth - 1)]
+        vs += [sat.variable(f"a{depth - 1}")]
+        out += [encode(vs)] * 32
+    return out
+
+
+def _worker(workload: str, passes: int, sink: str) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from deppy_tpu import profile, telemetry
+    from deppy_tpu.engine import driver
+
+    problems = (_fleet_problems() if workload == "fleet"
+                else _chain_problems())
+    driver.solve_problems(problems)  # warm-up: compiles, first-touch
+    walls = []
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        driver.solve_problems(problems)
+        walls.append(time.perf_counter() - t0)
+    # Untimed armed pass: the ledger events deppy profile summarizes.
+    telemetry.configure_sink(sink)
+    with profile.override("on", 1.0):
+        driver.solve_problems(problems)
+    best = min(walls)
+    print(json.dumps({
+        "n_problems": len(problems),
+        "wall_s_passes": [round(w, 4) for w in walls],
+        "wall_s_min": round(best, 4),
+        "problems_per_s_min_pass": round(len(problems) / best, 1),
+    }), flush=True)
+    return 0
+
+
+# ------------------------------------------------------------------ parent
+
+
+def _run_variant(workload: str, name: str, knobs: dict,
+                 passes: int) -> dict:
+    sink = tempfile.mktemp(prefix=f"bcp_ab_{workload}_{name}_",
+                           suffix=".jsonl")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    for v in ("DEPPY_TPU_BCP", "DEPPY_TPU_SIZE_LADDER",
+              "DEPPY_TPU_TELEMETRY_FILE", "DEPPY_TPU_PROFILE"):
+        env.pop(v, None)
+    env.update(knobs)
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           workload, "--passes", str(passes), "--sink", sink]
+    print(f"[bcp-ab] {workload}/{name}: {knobs}", file=sys.stderr,
+          flush=True)
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=REPO, timeout=1800)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{workload}/{name} worker failed rc={proc.returncode}:\n"
+            f"{proc.stderr[-2000:]}")
+    timing = json.loads(proc.stdout.strip().splitlines()[-1])
+    from deppy_tpu.profile.report import summarize
+
+    snapshot = summarize(sink)
+    try:
+        os.unlink(sink)
+    except OSError:
+        pass
+    return {"knobs": knobs, "timing": timing,
+            "profile_snapshot": snapshot}
+
+
+def _bench_row(module: str, timeout_s: int, extra=()) -> "dict | None":
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, "-m", module, *extra]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              env=env, cwd=REPO, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.stderr:
+        print(proc.stderr, file=sys.stderr, end="", flush=True)
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return rec
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--worker", choices=["fleet", "chain"], default=None)
+    ap.add_argument("--passes", type=int, default=3)
+    ap.add_argument("--sink", default=None)
+    ap.add_argument("--with-bench", action="store_true",
+                    help="append fresh headline + churn bench rows")
+    ap.add_argument("--out", default=OUT_PATH)
+    a = ap.parse_args()
+    if a.worker:
+        return _worker(a.worker, a.passes, a.sink)
+
+    import platform
+
+    record = {
+        "issue": 12,
+        "record": "bcp_rewrite_r12",
+        "platform": {
+            "python": platform.python_version(),
+            "machine": platform.machine(),
+            "cpus": os.cpu_count(),
+            "jax_platforms": os.environ.get("JAX_PLATFORMS", "cpu"),
+        },
+        "note": ("forced-CPU A/B; min-of-passes (2-CPU box, timing "
+                 "noisy); ledger columns from a separate untimed "
+                 "armed pass"),
+        "workloads": {},
+    }
+    for workload in ("fleet", "chain"):
+        rows = {}
+        for name, knobs in VARIANTS:
+            rows[name] = _run_variant(workload, name, knobs, a.passes)
+        record["workloads"][workload] = rows
+    if a.with_bench:
+        print("[bcp-ab] headline bench row...", file=sys.stderr,
+              flush=True)
+        record["headline"] = _bench_row(
+            "deppy_tpu.benchmarks.headline", 1800,
+            extra=["--platform", "cpu"])
+        print("[bcp-ab] churn bench row...", file=sys.stderr, flush=True)
+        record["churn"] = _bench_row("deppy_tpu.benchmarks.churn", 1800)
+    os.makedirs(os.path.dirname(a.out), exist_ok=True)
+    with open(a.out, "w", encoding="utf-8") as fh:
+        json.dump(record, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    print(f"[bcp-ab] wrote {a.out}", file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,7 +29,8 @@ from scripts._stage import emit, make_healthy, run_stage, solve_stage_src
 
 KNOB_VARS = ("DEPPY_TPU_BCP_UNROLL", "DEPPY_TPU_STAGE1_STEPS",
              "DEPPY_TPU_SEARCH", "DEPPY_TPU_MAX_LANES",
-             "DEPPY_TPU_DPLL_UNROLL", "DEPPY_TPU_CTL_UNROLL")
+             "DEPPY_TPU_DPLL_UNROLL", "DEPPY_TPU_CTL_UNROLL",
+             "DEPPY_TPU_BCP")
 
 # (name, knobs, tpu_only): tpu_only variants are SKIPPED when the pinned
 # backend is cpu — search-fused there runs the Pallas kernel in
@@ -46,6 +47,14 @@ VARIANTS = [
     # mid-F before this variant ran), and baseline+fused is the pair
     # the round's central bet needs — the knob ladder can wait.
     ("search-fused", {"DEPPY_TPU_SEARCH": "fused"}, True),
+    # The ISSUE 12 engine bet: implication-driven propagation over the
+    # compressed clause bank (engine/clause_bank.py) instead of
+    # scan-every-clause rounds.  The cost model says it pays where
+    # clause sets are large and implication chains deep; CPU XLA
+    # numbers live in benchmarks/results/bcp_rewrite_r12.json.  A
+    # measured win here is what writes the measured-defaults "bcp" row
+    # that flips auto to watched on the chip.
+    ("bcp-watched", {"DEPPY_TPU_BCP": "watched"}, False),
     ("stage1-96", {"DEPPY_TPU_STAGE1_STEPS": "96"}, False),
     # Decision-level unroll (round 5): K gated dpll decisions per while
     # trip — attacks the middle factor of the trip product (episodes ×

@@ -1,9 +1,10 @@
-"""BCP implementation equivalence: gather vs bits vs pallas.
+"""BCP implementation equivalence: gather vs bits vs pallas vs watched.
 
 Kernel-level tests on hand-built clause tensors plus randomized
 differential checks, per the rebuild test plan (SURVEY.md §4 item 4).  The
 gather path is the executable spec (it mirrors the host engine's
-per-occurrence counting); the bitplane paths must reach the same fixpoints,
+per-occurrence counting); the bitplane paths — and the clause-bank
+implication-driven path (ISSUE 12) — must reach the same fixpoints,
 conflicts, and full-solve outcomes.
 """
 
@@ -15,7 +16,7 @@ from deppy_tpu.models import random_instance
 from deppy_tpu.sat import at_most, conflict, dependency, mandatory, variable
 from deppy_tpu.sat.encode import encode
 
-IMPLS = ["gather", "bits", "pallas", "blockwise"]
+IMPLS = ["gather", "bits", "pallas", "blockwise", "watched"]
 
 
 @pytest.fixture(autouse=True)
@@ -202,7 +203,7 @@ class TestRandomizedEquivalence:
             for v in picks:
                 base[v] = rng.choice([core.TRUE, core.FALSE])
             ref = _bcp(pt, d, base, "gather")
-            for impl in ("bits", "pallas"):
+            for impl in ("bits", "pallas", "watched"):
                 got = _bcp(pt, d, base, impl)
                 assert got[0] == ref[0], (seed, impl)
                 if not ref[0]:
@@ -222,5 +223,7 @@ class TestRandomizedEquivalence:
             installs[impl] = [np.asarray(r.installed).tolist() for r in res]
         assert outcomes["bits"] == outcomes["gather"]
         assert outcomes["pallas"] == outcomes["gather"]
+        assert outcomes["watched"] == outcomes["gather"]
         assert installs["bits"] == installs["gather"]
         assert installs["pallas"] == installs["gather"]
+        assert installs["watched"] == installs["gather"]

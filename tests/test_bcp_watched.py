@@ -1,0 +1,332 @@
+"""Watched-literal clause-bank BCP (ISSUE 12).
+
+The watched impl (:mod:`deppy_tpu.engine.clause_bank`) replaces
+scan-every-clause propagation with implication-driven visits over a
+literal→clause adjacency bank.  BCP is confluent, so its results must be
+BYTE-identical to the dense rounds and to the host reference engine —
+models, unsat cores, and step counts — which this suite pins with
+randomized differentials, alongside the bank build itself, the
+occ-cap dense fallback, the ladder partitioner, and a
+compile-guard-armed no-retrace run over the new jit entries.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deppy_tpu import sat
+from deppy_tpu.models import random_instance
+from deppy_tpu.sat.encode import encode
+
+pytest.importorskip("jax")
+
+import jax.numpy as jnp  # noqa: E402
+
+from deppy_tpu import size_classes  # noqa: E402
+from deppy_tpu.engine import clause_bank, core, driver  # noqa: E402
+
+pytestmark = pytest.mark.bcp
+
+
+@pytest.fixture(autouse=True)
+def _restore_impl():
+    yield
+    core.set_bcp_impl("auto")
+
+
+def _solve_key(results):
+    return [
+        (int(r.outcome), np.asarray(r.installed).tolist(),
+         np.asarray(r.core).tolist(), int(r.steps))
+        for r in results
+    ]
+
+
+# --------------------------------------------------------------- bank build
+
+
+class TestBankBuild:
+    def test_numpy_bank_matches_hand_expectation(self):
+        clauses = np.array(
+            [[1, -2, 0], [2, -3, 0], [-1, -2, 0], [0, 0, 0]], np.int32)
+        occ_pos, occ_neg = clause_bank.occ_from_clauses_np(clauses, 4, 2)
+        assert occ_pos[0].tolist() == [0, -1]       # +v0 in clause 0
+        assert occ_neg[0].tolist() == [2, -1]       # -v0 in clause 2
+        assert occ_pos[1].tolist() == [1, -1]       # +v1 in clause 1
+        assert occ_neg[1].tolist() == [0, 2]        # -v1 in clauses 0, 2
+        assert occ_neg[2].tolist() == [1, -1]
+        assert occ_pos[3].tolist() == [-1, -1]
+
+    def test_max_occurrence(self):
+        clauses = np.array([[1, -2], [1, 2], [1, 0]], np.int32)
+        assert clause_bank.max_occurrence(clauses) == 3  # +v0 thrice
+        assert clause_bank.max_occurrence(np.zeros((2, 2), np.int32)) == 0
+
+    def test_device_banks_match_numpy(self):
+        problems = [encode(random_instance(length=28, seed=s))
+                    for s in range(6)]
+        d = driver._Dims(problems, len(problems))
+        host = driver.pad_stack(problems, d, d.B, pack=True)
+        occ_pos, occ_neg, occ_pos_r, occ_neg_r, card_occ = \
+            clause_bank.derive_banks(
+                jnp.asarray(host.clauses), jnp.asarray(host.card_ids),
+                jnp.asarray(host.n_vars), V=d.V, NV=d.NV, Ob=d.Ob,
+                Oc=d.Oc, red=True, full=True)
+        np.testing.assert_array_equal(np.asarray(occ_pos), host.occ_pos)
+        np.testing.assert_array_equal(np.asarray(occ_neg), host.occ_neg)
+        np.testing.assert_array_equal(np.asarray(occ_pos_r),
+                                      host.occ_pos_r)
+        np.testing.assert_array_equal(np.asarray(occ_neg_r),
+                                      host.occ_neg_r)
+        np.testing.assert_array_equal(np.asarray(card_occ), host.card_occ)
+
+    def test_dummy_banks_not_ready(self):
+        assert not clause_bank.bank_ready(np.full((1, 1), -1, np.int32))
+        assert clause_bank.bank_ready(np.full((8, 4), -1, np.int32))
+
+
+# ------------------------------------------------------- fuzz differential
+
+
+class TestDifferential:
+    def test_full_solves_byte_identical(self):
+        """watched == bits == gather on (outcome, model, core, steps)
+        across the benchmark distribution plus a conflict-heavy tail —
+        both SAT (minimization) and UNSAT (core) phases exercised."""
+        from _depth import depth
+
+        n = depth(8, 4)
+        problems = [encode(random_instance(length=32, seed=s))
+                    for s in range(n)]
+        problems += [
+            encode(random_instance(length=20, seed=s, p_mandatory=0.5,
+                                   p_conflict=0.5, n_conflict=4))
+            for s in range(n)
+        ]
+        keys = {}
+        for impl in ("gather", "bits", "watched"):
+            core.set_bcp_impl(impl)
+            keys[impl] = _solve_key(driver.solve_problems(problems))
+        assert keys["watched"] == keys["gather"]
+        assert keys["bits"] == keys["gather"]
+
+    def test_vs_host_engine(self):
+        """Watched results against the host reference engine (the
+        semantic spec): outcomes, installed sets, unsat cores."""
+        from _depth import depth
+
+        instances = [random_instance(length=28, seed=s)
+                     for s in range(depth(6, 3))]
+        host = []
+        for variables in instances:
+            try:
+                installed = sat.Solver(variables, backend="host").solve()
+                host.append(("sat",
+                             sorted(v.identifier for v in installed)))
+            except sat.NotSatisfiable as e:
+                host.append(("unsat", sorted(
+                    (ac.variable.identifier, str(ac))
+                    for ac in e.constraints)))
+        core.set_bcp_impl("watched")
+        got = []
+        for variables in instances:
+            try:
+                installed = sat.Solver(variables, backend="tpu").solve()
+                got.append(("sat",
+                            sorted(v.identifier for v in installed)))
+            except sat.NotSatisfiable as e:
+                got.append(("unsat", sorted(
+                    (ac.variable.identifier, str(ac))
+                    for ac in e.constraints)))
+        assert got == host
+
+    def test_occ_cap_fallback_identical(self, monkeypatch):
+        """A batch past the occ cap ships dummy banks; the compiled
+        watched program statically falls back to dense rounds — same
+        answers, no bank resident."""
+        problems = [encode(random_instance(length=24, seed=s))
+                    for s in range(8)]
+        core.set_bcp_impl("bits")
+        ref = _solve_key(driver.solve_problems(problems))
+        core.set_bcp_impl("watched")
+        monkeypatch.setattr(driver, "BANK_OCC_CAP", 1)
+        assert _solve_key(driver.solve_problems(problems)) == ref
+
+    def test_larger_class_with_cardinality_identical(self):
+        """Class-m problems (the random distributions above stay in
+        xs/s) with AtMost rows live — the bank's card_occ counters and
+        full-force path at scale, SAT and UNSAT both."""
+        def big(unsat: bool):
+            n = 96
+            cons, k = [], 0
+            # Dependency pairs avoid the AtMost members (v1..v5), so
+            # the base problem is satisfiable by the later candidates.
+            for i in range(6, n):
+                for j in range(i + 1, n):
+                    if k >= 400:
+                        break
+                    cons.append(sat.dependency(f"v{i}", f"v{j}"))
+                    k += 1
+                if k >= 400:
+                    break
+            cons.append(sat.at_most(2, "v1", "v2", "v3", "v4", "v5"))
+            cons.append(sat.dependency("v1"))  # one live card member
+            if unsat:
+                cons.append(sat.dependency("v2"))
+                cons.append(sat.dependency("v3"))
+                cons.append(sat.at_most(1, "v1", "v2", "v3"))
+            vs = [sat.variable("v0", sat.mandatory(), *cons)]
+            vs += [sat.variable(f"v{i}") for i in range(1, n)]
+            return encode(vs)
+
+        problems = [big(False), big(True)]
+        assert size_classes.class_of_cost(
+            driver._cost_proxy(problems[0])) not in ("xs", "s")
+        keys = {}
+        for impl in ("gather", "bits", "watched"):
+            core.set_bcp_impl(impl)
+            keys[impl] = _solve_key(driver.solve_problems(problems))
+        assert keys["watched"] == keys["gather"]
+        assert keys["bits"] == keys["gather"]
+        outcomes = [k[0] for k in keys["watched"]]
+        assert outcomes == [core.SAT, core.UNSAT]
+
+    def test_incremental_fixpoint_agrees(self):
+        """planes_fixpoint from a mid-search-style partial state (the
+        snapshot-restore entry every dpll iteration makes): watched ==
+        bits on (conflict, t, f)."""
+        rng = np.random.default_rng(3)
+        for seed in range(6):
+            p = encode(random_instance(length=24, seed=seed))
+            d = driver._Dims([p], 1)
+            pt = driver.pad_problem(p, d)
+            base = np.array(core._base_assignment(pt, d.V, d.NCON))
+            k = int(rng.integers(0, 5))
+            for v in rng.choice(p.n_vars, size=k, replace=False):
+                base[v] = rng.choice([core.TRUE, core.FALSE])
+            t0 = core.pack_mask(jnp.asarray(base == core.TRUE), d.Wv)
+            f0 = core.pack_mask(jnp.asarray(base == core.FALSE), d.Wv)
+            no_min = jnp.zeros((1, d.Wv), jnp.int32)
+            out = {}
+            for impl in ("bits", "watched"):
+                core.set_bcp_impl(impl)
+                c, t, f = core.planes_fixpoint(
+                    pt, t0, f0, no_min, jnp.int32(0), jnp.bool_(True),
+                    d.V)
+                out[impl] = (bool(c), np.asarray(t), np.asarray(f))
+            assert out["watched"][0] == out["bits"][0], seed
+            if not out["bits"][0]:
+                np.testing.assert_array_equal(out["watched"][1],
+                                              out["bits"][1])
+                np.testing.assert_array_equal(out["watched"][2],
+                                              out["bits"][2])
+
+
+# ------------------------------------------------------------- size ladder
+
+
+def _sized_problem(n_vars: int, n_deps: int):
+    vs = [sat.variable(f"v{i}") for i in range(n_vars)]
+    vs[0] = sat.variable(
+        "v0", sat.mandatory(),
+        *[sat.dependency(f"v{i}") for i in range(1, n_deps)])
+    return encode(vs)
+
+
+def _clausey_problem(n_vars: int, n_clauses: int):
+    """Problem whose clause count scales independently of its var
+    count (dependency pairs), for cost-ladder shaping."""
+    cons = []
+    k = 0
+    for i in range(1, n_vars):
+        for j in range(i + 1, n_vars):
+            if k >= n_clauses:
+                break
+            cons.append(sat.dependency(f"v{i}", f"v{j}"))
+            k += 1
+        if k >= n_clauses:
+            break
+    vs = [sat.variable("v0", sat.mandatory(), *cons)]
+    vs += [sat.variable(f"v{i}") for i in range(1, n_vars)]
+    return encode(vs)
+
+
+class TestLadder:
+    def test_smooth_distribution_still_splits(self):
+        """The legacy adjacent-jump splitter's blind spot (ROADMAP item
+        1): cost levels each < SPLIT_RATIO apart show no adjacent jump
+        to cut at, so one bucket forms and the smallest problem pays
+        the largest pad — even though the span crosses a class
+        boundary.  The ladder splits at the boundary regardless."""
+        problems = []
+        for n_clauses in (20, 40, 80):
+            problems += [_clausey_problem(96, n_clauses)] * 20
+        costs = [driver._cost_proxy(p) for p in problems]
+        # Premise: adjacent cost levels are < SPLIT_RATIO apart (the
+        # legacy splitter sees nothing to cut) yet the span crosses a
+        # declared class boundary.
+        levels = sorted(set(costs))
+        assert max(b / a for a, b in zip(levels, levels[1:])) \
+            < size_classes.SPLIT_RATIO
+        assert len({size_classes.class_of_cost(c) for c in costs}) > 1
+        legacy = driver._partition_legacy(
+            np.array(costs, dtype=np.int64),
+            np.argsort(np.array(costs), kind="stable"), len(problems))
+        assert len(legacy) == 1  # the blind spot, pinned
+        buckets = driver.partition_buckets(problems)
+        assert len(buckets) > 1
+        for idxs in buckets:
+            assert len({size_classes.class_of_cost(costs[i])
+                        for i in idxs}) == 1
+
+    def test_small_class_pays_small_dims(self):
+        problems = [_sized_problem(8, 4)] * 32 + \
+            [_sized_problem(300, 150)] * 32
+        buckets = driver.partition_buckets(problems)
+        assert len(buckets) == 2
+        small = min(buckets,
+                    key=lambda b: driver._cost_proxy(problems[b[0]]))
+        d_small = driver._Dims([problems[i] for i in small], len(small))
+        d_all = driver._Dims(problems, len(problems))
+        assert d_small.C < d_all.C or d_small.NV < d_all.NV
+
+    def test_legacy_splitter_selectable(self, monkeypatch):
+        monkeypatch.setattr(driver, "_SIZE_LADDER", "off")
+        problems = [_sized_problem(4, 2)] * 32 + \
+            [_sized_problem(200, 60)] * 32
+        buckets = driver.partition_buckets(problems)
+        assert sorted(len(b) for b in buckets) == [32, 32]
+
+
+# ----------------------------------------------------------- compile guard
+
+
+class TestCompileGuard:
+    def test_no_retraces_on_repeat_dispatch(self, monkeypatch):
+        """Every watched-path jit entry (bank derive + the batched
+        phases) memoizes: re-dispatching an identical batch with the
+        guard ARMED adds zero traces and trips no budget."""
+        from deppy_tpu.analysis import compileguard
+
+        problems = [encode(random_instance(length=20, seed=s))
+                    for s in range(8)]
+        core.set_bcp_impl("watched")
+        driver.solve_problems(problems)  # compile warm-up
+        compileguard.reset_counts()
+        monkeypatch.setenv("DEPPY_TPU_COMPILE_GUARD", "1")
+        driver.solve_problems(problems)
+        snap = compileguard.snapshot()
+        assert sum(e["traces"] for e in snap.values()) == 0, snap
+
+    def test_bank_fn_on_jit_surface(self):
+        """The new derive entry is on the static jit-surface registry,
+        memoized and compile-guard observed (the ISSUE 8 contract for
+        every jit surface)."""
+        from deppy_tpu.analysis.compile_surface import jit_surface
+
+        entries = {e.name: e for e in jit_surface()
+                   if e.kind in ("jit", "pjit")}
+        assert "_bank_fn" in entries, "jit surface lost _bank_fn"
+        assert entries["_bank_fn"].memoized
+        assert entries["_bank_fn"].observed
