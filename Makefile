@@ -108,6 +108,14 @@ bcp-smoke: ## Watched clause-bank engine end to end: impl byte-identity, device-
 test-bcp: ## Watched clause-bank BCP subsystem tests only (the `bcp` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m bcp
 
+.PHONY: portfolio-smoke
+portfolio-smoke: ## Portfolio engine racing end to end: racing-on byte-identity, poisoned-entrant chaos, grad certification, profile race table, straggler triage (ISSUE 13 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/portfolio_smoke.py
+
+.PHONY: test-portfolio
+test-portfolio: ## Portfolio racing subsystem tests only (the `portfolio` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m portfolio
+
 .PHONY: lint
 lint: ## Static analysis: the six deppy-lint checkers vs analysis/baseline.json (ISSUE 7/8 acceptance; docs/analysis.md).
 	$(PYTHON) -m deppy_tpu lint

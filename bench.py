@@ -398,6 +398,37 @@ def _newest_record(lines, max_age: float | None) -> dict | None:
     return None
 
 
+def _run_hard(timeout_s: int) -> dict | None:
+    """Run the hard-instance portfolio-racing workload (ISSUE 13) on
+    the forced-CPU platform — it measures racing vs fixed backends on
+    the host path, so the accelerator probe/retry machinery has
+    nothing to add — and return its parsed record or None."""
+    from deppy_tpu.utils.platform_env import run_captured
+
+    cmd = [sys.executable, "-m", "deppy_tpu.benchmarks.hard"]
+    if "DEPPY_BENCH_N" in os.environ:
+        cmd += ["--lanes-per-depth", os.environ["DEPPY_BENCH_N"]]
+    try:
+        rc, stdout, stderr = run_captured(
+            cmd, timeout_s=timeout_s, cwd=REPO, env=_cpu_env())
+    except subprocess.TimeoutExpired:
+        _log(f"hard workload timed out after {timeout_s}s")
+        return None
+    if stderr:
+        print(stderr, file=sys.stderr, end="", flush=True)
+    if rc != 0:
+        _log(f"hard workload failed rc={rc}")
+        return None
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            return rec
+    return None
+
+
 def _run_churn(timeout_s: int) -> dict | None:
     """Run the churn-replay workload (ISSUE 10) on the forced-CPU
     platform — it measures the host-path warm-vs-cold serving ratio, so
@@ -430,6 +461,22 @@ def _run_churn(timeout_s: int) -> dict | None:
 
 
 def main(workload: str = "headline") -> int:
+    if workload == "hard":
+        rec = _run_hard(RUN_TIMEOUT_S)
+        if rec is None:
+            rec = {
+                "metric": ("hard-instance resolutions/sec "
+                           "(portfolio race vs best fixed backend)"),
+                "value": 0.0,
+                "unit": "problems/s",
+                "vs_baseline": 0.0,
+                "workload": "hard",
+                "backend": "none",
+                "error": "hard workload produced no record",
+            }
+        rec.setdefault("backend", "cpu")
+        print(json.dumps(rec), flush=True)
+        return 0
     if workload == "churn":
         rec = _run_churn(RUN_TIMEOUT_S)
         if rec is None:
@@ -528,11 +575,12 @@ if __name__ == "__main__":
     import argparse
 
     _ap = argparse.ArgumentParser()
-    _ap.add_argument("--workload", choices=["headline", "churn"],
+    _ap.add_argument("--workload", choices=["headline", "churn", "hard"],
                      default="headline",
                      help="headline = batched device vs serial host; "
                      "churn = warm-start vs cold re-resolution replay "
-                     "(ISSUE 10)")
+                     "(ISSUE 10); hard = deep-implication-chain "
+                     "portfolio racing vs fixed backends (ISSUE 13)")
     _args = _ap.parse_args()
     try:
         rc = main(workload=_args.workload)

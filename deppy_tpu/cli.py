@@ -220,6 +220,15 @@ def _build_parser() -> argparse.ArgumentParser:
         "disables the tier; also via DEPPY_TPU_INCREMENTAL_INDEX_SIZE)",
     )
     p_serve.add_argument(
+        "--portfolio", choices=["auto", "on", "off"], default=None,
+        help="portfolio engine racing (ISSUE 13): race the top-K "
+        "candidate backends per cold flush and serve the first "
+        "definitive finisher, cross-checked by sampled differential "
+        "comparison (default auto — race only size classes with a "
+        "measured `portfolio` row; 'off' restores single-backend "
+        "dispatch byte for byte; also via DEPPY_TPU_PORTFOLIO)",
+    )
+    p_serve.add_argument(
         "--slo", default=None, metavar="SPEC",
         help="declarative per-tenant SLO config: inline JSON, @FILE, "
         "or a path mapping tenant -> {target_p99_s, error_budget} "
@@ -291,8 +300,10 @@ def _build_parser() -> argparse.ArgumentParser:
         help="render the engine cost model from a telemetry JSONL "
         "sink's `profile` events (armed via DEPPY_TPU_PROFILE=on): "
         "trip-overhead regression, useful-work ratio per size class, "
-        "straggler/pad waste, per-backend us/solve (see "
-        "docs/observability.md, Profiling)",
+        "straggler/pad waste, per-backend us/solve — plus the "
+        "portfolio race table (wins/cancels/win-margin per backend "
+        "per size class, straggler resubmissions) from `race` events "
+        "(see docs/observability.md, Profiling)",
     )
     p_profile.add_argument(
         "file", nargs="?", default=None,
@@ -424,6 +435,7 @@ _CONFIG_KEYS = {
     "incrementalMaxDelta": ("incremental_max_delta", float),
     "incrementalIndexSize": ("incremental_index_size", int),
     "slo": ("slo", str),
+    "portfolio": ("portfolio", str),
     "profile": ("profile", str),
     "profileSample": ("profile_sample", float),
     "bcp": ("bcp", str),
@@ -923,9 +935,10 @@ def _cmd_profile(args) -> int:
         json.dump(summary, sys.stdout, indent=2, sort_keys=True)
         print()
         return 0
-    if not summary["profile_events"]:
-        print(f"no profile events in {path} (arm with "
-              f"DEPPY_TPU_PROFILE=on and a telemetry sink)")
+    if not summary["profile_events"] and not summary.get("races"):
+        print(f"no profile or race events in {path} (arm with "
+              f"DEPPY_TPU_PROFILE=on and a telemetry sink; race events "
+              f"ride every portfolio race)")
         return 0
     print(profile_report.render_text(summary, path))
     return 0
@@ -1051,6 +1064,7 @@ def _cmd_serve(args) -> int:
         "incremental_max_delta": None,
         "incremental_index_size": None,
         "slo": None,
+        "portfolio": None,
         "profile": None,
         "profile_sample": None,
         "bcp": None,
@@ -1074,6 +1088,7 @@ def _cmd_serve(args) -> int:
             ("incremental_max_delta", args.incremental_max_delta),
             ("incremental_index_size", args.incremental_index_size),
             ("slo", args.slo),
+            ("portfolio", args.portfolio),
             ("profile", args.profile),
             ("profile_sample", args.profile_sample),
             ("bcp", args.bcp),

@@ -148,6 +148,22 @@ _DECLARATIONS: List[EnvVar] = [
        "Canonical-form result-cache capacity in entries (0 disables; "
        "also --cache-size).",
        flag="--cache-size", config_key="cacheSize"),
+    # --- portfolio racing -------------------------------------------------
+    _v("DEPPY_TPU_PORTFOLIO", "str", "auto", "deppy_tpu.sched.scheduler",
+       "Portfolio engine racing: 'on' races the top-K candidate "
+       "backends per cold flush and serves the first definitive "
+       "finisher; 'auto' races only size classes holding a measured "
+       "`portfolio` row; 'off' restores the single-backend dispatch "
+       "path byte for byte (also --portfolio).",
+       flag="--portfolio", config_key="portfolio"),
+    _v("DEPPY_TPU_PORTFOLIO_K", "int", 2, "deppy_tpu.sched.scheduler",
+       "Top-K candidate backends raced per coalesced flush (min 2)."),
+    _v("DEPPY_TPU_PORTFOLIO_SAMPLE_CHECK", "float", 0.0625,
+       "deppy_tpu.sched.scheduler",
+       "Deterministic 1-in-N fraction of non-canonical race wins "
+       "cross-checked against the canonical backend's answer "
+       "(mismatches serve canonical and raise a race_mismatch fault "
+       "event; 0 disables)."),
     # --- incremental tier ------------------------------------------------
     _v("DEPPY_TPU_INCREMENTAL", "str", "on", "deppy_tpu.sched.scheduler",
        "Delta-aware incremental resolution: clause-set index + "

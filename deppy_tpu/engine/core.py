@@ -42,6 +42,7 @@ import functools
 import json
 import os
 import threading
+from contextlib import contextmanager
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -748,11 +749,64 @@ def _has_full_planes(pts, V: int) -> bool:
     return rows_ok and width_ok
 
 
+# Per-size-class impl override (ISSUE 13 satellite): the driver scopes
+# each dispatch to its ladder class's measured `bcp.<class>` row so
+# deep-chain classes can run `watched` while the mixed fleet keeps
+# `bits` — closing PR 12's "~10% loss on the mixed fleet" compromise.
+# Thread-local (mesh shard workers dispatch concurrently).  Safe
+# against stale compiled programs because the driver classifies each
+# dispatch by its PADDED batch dims (driver.padded_class: cost over
+# the bucketed C/NV/NCON maxima — a function of exactly the dims that
+# key jit's shape cache), so two dispatches reaching the same
+# compiled program always resolve the same class, hence the same
+# impl.  Only
+# the reduced-space impls (bits/watched) are honored per class —
+# a per-class `gather` row would flip ``phases_reduced()`` under a
+# factory wrapper whose ``red`` was baked at a shape key that does not
+# include C.
+_IMPL_TLS = threading.local()
+_CLASS_ROUTABLE = ("bits", "watched")
+
+
+@contextmanager
+def impl_scope(impl: "Optional[str]"):
+    """Scope the resolved BCP impl for one dispatch (driver use only).
+    ``None`` is a no-op scope — the global resolution applies."""
+    prev = getattr(_IMPL_TLS, "impl", None)
+    _IMPL_TLS.impl = impl
+    try:
+        yield
+    finally:
+        _IMPL_TLS.impl = prev
+
+
+def resolved_impl_for(class_name: "Optional[str]") -> str:
+    """The BCP impl a dispatch of ladder class ``class_name`` should
+    run: the explicit global knob when set, else the measured
+    ``bcp.<class>`` row, else the global ``bcp`` row, else bits."""
+    if _BCP_IMPL != "auto":
+        return _BCP_IMPL
+    if class_name is not None:
+        measured = measured_default(f"bcp.{class_name}")
+        if measured in _CLASS_ROUTABLE:
+            return measured
+    measured = measured_default("bcp")
+    if measured in _BCP_IMPLS and measured != "auto":
+        return measured
+    return "bits"
+
+
 def _resolved_impl() -> str:
     # deppy: lint-ok[compile-surface] trace-time impl dispatch by design: set_bcp_impl's write invalidates every compiled program via clear_batched_caches
     impl = _BCP_IMPL
     if impl != "auto":
         return impl
+    # Per-dispatch class scope (impl_scope) wins over the global row —
+    # the driver only installs one when the global knob is "auto", and
+    # the class↔shape argument above keeps traced programs consistent.
+    override = getattr(_IMPL_TLS, "impl", None)
+    if override is not None:
+        return override
     # Measured-defaults route (ISSUE 12 policy: engine bets become
     # defaults only behind a same-backend A/B row, never by fiat).
     measured = measured_default("bcp")

@@ -842,6 +842,47 @@ def _profile_dispatch(t0, problems, d: _Dims, steps: np.ndarray,
         live_cells=int(sum(p.clauses.size for p in problems)))
 
 
+def padded_class(problems) -> str:
+    """The ladder class of a dispatch group's PADDED batch dims — the
+    same classification :func:`_bank_cap` applies to the same dispatch
+    (cost over the bucketed C/NV/NCON maxima), and a function of
+    exactly the dims that key jit's shape cache.  The max of
+    per-problem cost proxies is NOT such a function (a wide-clause
+    problem and a wide-var problem can trade maxima), so per-class
+    impl routing must classify here, not there."""
+    C = _size_classes.bucket(max((p.clauses.shape[0]
+                                  for p in problems), default=1))
+    NV = _size_classes.bucket(max((p.n_vars for p in problems),
+                                  default=1))
+    NCON = _size_classes.bucket(max((p.n_cons for p in problems),
+                                    default=1))
+    Wv = -(-(NV + NCON) // _size_classes.WORD)
+    return _size_classes.class_of_cost((C + 2 * NV) * Wv)
+
+
+def _class_impl_scoped(fn):
+    """Scope a dispatch-group impl to its ladder class's resolved BCP
+    impl (ISSUE 13 satellite: the measured-defaults ``bcp`` row is
+    keyed per size class, so deep-chain classes run ``watched`` while
+    the mixed fleet keeps ``bits``).  The class comes from
+    :func:`padded_class` — a function of the padded dims that key the
+    compiled programs, so two dispatches reaching the same program
+    always resolve the same impl.  With the global knob set, or no
+    per-class row measured, the scope resolves to exactly what the
+    global resolution would — byte-identical dispatch."""
+
+    @_functools.wraps(fn)
+    def wrapped(problems, budget, mesh, trace_cap, **kw):
+        if not problems or core._BCP_IMPL != "auto":
+            return fn(problems, budget, mesh, trace_cap, **kw)
+        with core.impl_scope(
+                core.resolved_impl_for(padded_class(problems))):
+            return fn(problems, budget, mesh, trace_cap, **kw)
+
+    return wrapped
+
+
+@_class_impl_scoped
 def _solve_monolith(problems, budget, mesh, trace_cap,
                     _spmd_entry: bool = False) -> List[core.SolveResult]:
     """Single-dispatch path (one jitted program, all phases lane-gated):
@@ -947,6 +988,7 @@ def _rows(pts: core.ProblemTensors, sl: slice) -> core.ProblemTensors:
     )
 
 
+@_class_impl_scoped
 def _solve_split(problems, budget, mesh, trace_cap) -> List[core.SolveResult]:
     """Chunked three-phase path: search over the batch in ≤ MAX_LANES
     dispatches, then minimization on compacted SAT-lane chunks and core

@@ -1,0 +1,282 @@
+"""Engine-backend registry facade (ISSUE 13 tentpole).
+
+The repo grew five engine paths one PR at a time — batched device DPLL
+(:mod:`deppy_tpu.engine.driver`), the warm-start device screen
+(:mod:`deppy_tpu.incremental`), the inline host engine
+(:mod:`deppy_tpu.sat.host`), the hostpool workers
+(:mod:`deppy_tpu.hostpool`), and now the gradient-relaxation entrant
+(:mod:`deppy_tpu.engine.grad_relax`) — each reachable through its own
+ad-hoc call site.  This module is the one declaration point: every
+backend registers a :class:`BackendSpec` (capabilities: size-class
+range, cardinality support, warm-start support, whether it can decide
+ANY instance) plus a per-class cost estimate, and a uniform
+``solve_via`` adapter that renders every backend's answers in the one
+lane vocabulary (:class:`~deppy_tpu.hostpool.worker.HostLaneResult`)
+the scheduler's host drain already decodes.
+
+The portfolio racer (:class:`deppy_tpu.sched.scheduler.PortfolioRacer`)
+consumes this surface: :func:`candidates` ranks the backends for a size
+class — by the measured-defaults registry's ``portfolio.<class>`` /
+``portfolio`` rows when one was learned (``scripts/tpu_ab.py``'s
+portfolio variant writes them), else by the static canonical-first
+order — and the racer dispatches the top K concurrently.
+
+Answer identity: the host engine is the executable spec and the device
+engine is pinned bit-identical to it (models, unsat cores), so any
+definitive backend's answers are interchangeable; the grad entrant
+serves only what its certification proves identical.  Step counts are
+engine-relative — exactly as they already are on the breaker's
+host-fallback path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import size_classes as _size_classes
+from ..hostpool.worker import HostLaneResult
+
+_CLASS_NAMES = tuple(name for name, _ in _size_classes.ordered_classes())
+
+
+@dataclass(frozen=True)
+class BackendSpec:
+    """One registered engine backend.
+
+    ``classes``: ladder classes the backend serves.  ``definitive``:
+    whether the backend can decide ANY instance it accepts (the grad
+    entrant cannot — unverified lanes come back None and the racer
+    treats its result as non-definitive).  ``cost_us``: rough per-lane
+    µs-per-solve by class — the ranking fallback when no measured
+    ``portfolio`` row exists, and the straggler-triage estimate's
+    floor.  The numbers are order-of-magnitude anchors from the
+    measured repo artifacts (bcp_rewrite_r12, hostpool_baseline), not
+    promises; measured rows override the ordering entirely."""
+
+    name: str
+    classes: Tuple[str, ...]
+    cardinality: bool
+    warm_start: bool
+    definitive: bool
+    cost_us: Dict[str, float]
+
+
+_SPECS: Dict[str, BackendSpec] = {
+    spec.name: spec
+    for spec in (
+        BackendSpec("device", _CLASS_NAMES, cardinality=True,
+                    warm_start=False, definitive=True,
+                    cost_us={"xs": 400.0, "s": 700.0, "m": 2000.0,
+                             "l": 8000.0, "xl": 16000.0}),
+        BackendSpec("host", _CLASS_NAMES, cardinality=True,
+                    warm_start=True, definitive=True,
+                    cost_us={"xs": 600.0, "s": 2500.0, "m": 12000.0,
+                             "l": 60000.0, "xl": 150000.0}),
+        BackendSpec("hostpool", _CLASS_NAMES, cardinality=True,
+                    warm_start=False, definitive=True,
+                    cost_us={"xs": 300.0, "s": 900.0, "m": 4000.0,
+                             "l": 20000.0, "xl": 50000.0}),
+        BackendSpec("warm", _CLASS_NAMES, cardinality=True,
+                    warm_start=True, definitive=False,
+                    cost_us={"xs": 60.0, "s": 120.0, "m": 400.0,
+                             "l": 1500.0, "xl": 3000.0}),
+        BackendSpec("grad_relax", _CLASS_NAMES, cardinality=True,
+                    warm_start=False, definitive=False,
+                    cost_us={"xs": 250.0, "s": 500.0, "m": 1500.0,
+                             "l": 5000.0, "xl": 10000.0}),
+    )
+}
+
+# Canonical-first static ranking: without measured evidence the racer
+# must keep the canonical winner cheap — the device engine leads (it is
+# what racing-off dispatches), the cancellable inline host engine is
+# the default second lane, the certified heuristic third, the
+# (abandon-only, pool-lock-holding) hostpool last.
+_STATIC_ORDER = ("device", "host", "grad_relax", "hostpool")
+
+
+def specs() -> Dict[str, BackendSpec]:
+    """The registered backends (read-only view by convention)."""
+    return dict(_SPECS)
+
+
+def get(name: str) -> BackendSpec:
+    return _SPECS[name]
+
+
+def estimate_us(name: str, class_name: str) -> float:
+    """Per-lane cost estimate for one backend in one ladder class."""
+    spec = _SPECS[name]
+    return spec.cost_us.get(class_name,
+                            max(spec.cost_us.values()))
+
+
+def ranked(class_name: str) -> Tuple[List[str], bool]:
+    """Candidate backend names for a size class, best first, plus
+    whether the order came from a MEASURED ``portfolio`` row (the
+    ``auto`` racing mode engages only then).  Rows are comma-separated
+    backend names under the measured-defaults keys
+    ``portfolio.<class>`` (per class) or ``portfolio`` (global)."""
+    from . import core
+
+    for key in (f"portfolio.{class_name}", "portfolio"):
+        row = core.measured_default(key)
+        if row:
+            names = [n.strip() for n in row.split(",")
+                     if n.strip() in _SPECS]
+            if len(names) >= 2:
+                return names, True
+    return list(_STATIC_ORDER), False
+
+
+def candidates(class_name: str, k: int, device_ok: bool = True,
+               pool_ok: Optional[bool] = None,
+               cardinality: bool = False) -> Tuple[List[str], bool]:
+    """Top-K raceable backends for one flush: the ranked order filtered
+    by capability (class served, cardinality when the flush carries
+    AtMost rows) and availability (``device_ok`` — the resolved
+    backend and breaker verdict; ``pool_ok`` — hostpool spawnability,
+    probed lazily when None).  The warm screen never races (warm lanes
+    coalesce in their own scheduler class)."""
+    names, measured = ranked(class_name)
+    out: List[str] = []
+    for name in names:
+        spec = _SPECS.get(name)
+        if spec is None or spec.name == "warm":
+            continue
+        if class_name not in spec.classes:
+            continue
+        if cardinality and not spec.cardinality:
+            continue
+        if name == "device" and not device_ok:
+            continue
+        if name == "hostpool":
+            if pool_ok is None:
+                from .. import hostpool
+
+                pool = hostpool.default_pool()
+                pool_ok = pool is not None and pool.available
+            if not pool_ok:
+                continue
+        out.append(name)
+        if len(out) >= max(int(k), 2):
+            break
+    return out, measured
+
+
+# ------------------------------------------------------------- adapters
+#
+# One lane vocabulary for every backend: HostLaneResult — the shape the
+# hostpool workers already emit and the scheduler's host drain already
+# decodes (models via _solution_dict, cores via applied-index lists),
+# so racing cannot invent a second decode path to drift.
+
+
+def _from_solve_result(problem, res) -> HostLaneResult:
+    """Render one device :class:`core.SolveResult` in the lane
+    vocabulary.  Index lists are in ascending index order — exactly the
+    order ``driver.decode_results`` walks, so the decoded answers are
+    byte-identical."""
+    from . import core
+
+    o = int(res.outcome)
+    if o == core.SAT:
+        idx = np.nonzero(np.asarray(res.installed)[: problem.n_vars])[0]
+        return HostLaneResult("sat", [int(i) for i in idx], [],
+                              int(res.steps),
+                              backtracks=int(res.trace_n))
+    if o == core.UNSAT:
+        idx = np.nonzero(np.asarray(res.core)[: problem.n_cons])[0]
+        return HostLaneResult("unsat", [], [int(i) for i in idx],
+                              int(res.steps),
+                              backtracks=int(res.trace_n))
+    return HostLaneResult("incomplete", [], [], int(res.steps),
+                          backtracks=int(res.trace_n))
+
+
+def _solve_device(problems, max_steps, deadlines, cancel, mesh=None):
+    """Batched device dispatch through the full driver pipeline
+    (size-class bucketing, phase compaction, escalation, fault
+    domain).  Device programs cannot be cooperatively cancelled — a
+    losing race lane runs to completion and its fetch is dropped."""
+    from . import driver
+
+    if mesh is not None and getattr(mesh, "size", 1) >= 2:
+        results = driver.solve_problems_sharded(problems, mesh=mesh,
+                                                max_steps=max_steps)
+    else:
+        results = driver.solve_problems(problems, max_steps=max_steps)
+    return [_from_solve_result(p, r) for p, r in zip(problems, results)]
+
+
+def _solve_host(problems, max_steps, deadlines, cancel, mesh=None):
+    """Inline host-engine lanes — the cancellable spelling (the race's
+    cooperative stop flag is checked at every engine step boundary)."""
+    from ..hostpool.worker import solve_lane
+
+    n = len(problems)
+    dls = list(deadlines) if deadlines is not None else [None] * n
+    per = (list(max_steps) if isinstance(max_steps, (list, tuple))
+           else [max_steps] * n)
+    return [solve_lane(p, max_steps=ms, deadline=dl, cancel=cancel)
+            for p, ms, dl in zip(problems, per, dls)]
+
+
+def _solve_hostpool(problems, max_steps, deadlines, cancel, mesh=None):
+    """The shared worker-pool entry.  No cross-process cancel flag —
+    a losing pool entrant is abandoned (its results dropped) and its
+    dispatch drains in the background."""
+    from .. import hostpool
+
+    return hostpool.solve_host_problems(problems, max_steps=max_steps,
+                                        deadlines=deadlines)
+
+
+def _solve_warm(plans, max_steps, deadlines, cancel, mesh=None):
+    """Certified warm-start attempts (ISSUE 10) — ``plans`` are
+    WarmPlan objects, one per lane; None per lane on fallback.  The
+    scheduler's incremental class is the only caller; listed here so
+    the registry fronts every engine path."""
+    from .. import incremental as inc
+
+    out = []
+    for plan in plans:
+        res = inc.attempt(plan, max_steps)
+        if res is None:
+            out.append(None)
+            continue
+        out.append(HostLaneResult(
+            "sat", list(res.installed_idx), [], res.steps,
+            decisions=res.decisions,
+            propagation_rounds=res.propagation_rounds,
+            backtracks=res.backtracks))
+    return out
+
+
+def _solve_grad(problems, max_steps, deadlines, cancel, mesh=None):
+    from . import grad_relax
+
+    return grad_relax.solve_lanes(problems, max_steps=max_steps,
+                                  deadlines=deadlines, cancel=cancel)
+
+
+_SOLVERS = {
+    "device": _solve_device,
+    "host": _solve_host,
+    "hostpool": _solve_hostpool,
+    "warm": _solve_warm,
+    "grad_relax": _solve_grad,
+}
+
+
+def solve_via(name: str, problems: Sequence,
+              max_steps=None, deadlines: Optional[Sequence] = None,
+              cancel=None, mesh=None):
+    """Dispatch one lane set through the named backend.  Returns a list
+    of :class:`HostLaneResult` (None per lane a non-definitive backend
+    could not certify)."""
+    return _SOLVERS[name](problems, max_steps, deadlines, cancel,
+                          mesh=mesh)

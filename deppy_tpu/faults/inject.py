@@ -83,6 +83,7 @@ KNOWN_POINTS = (
     "checkpoint.save_group",
     "service.resolve",
     "sched.dispatch",
+    "sched.race.*",
     "hostpool.dispatch",
     "hostpool.worker_crash",
 )

@@ -76,13 +76,19 @@ def _degraded_result() -> HostLaneResult:
 
 
 def solve_lane(problem, max_steps: Optional[int] = None,
-               deadline=None) -> HostLaneResult:
+               deadline=None, cancel=None) -> HostLaneResult:
     """Solve one lowered problem on the host spec engine.
 
     ``deadline`` is any object with ``expired()`` (``faults.Deadline``
     inline; a worker-local clock over the pipe): expiry before the solve
     starts degrades the lane — admission control, exactly like the
     driver's per-group check — never mid-solve preemption.
+
+    ``cancel`` (inline callers only — events don't cross the worker
+    pipe) is the portfolio race's cooperative stop flag: the engine
+    checks it at step boundaries and raises
+    :class:`~deppy_tpu.sat.host.SolveCancelled`, which propagates (a
+    cancelled lane has no answer to report).
 
     ``InternalSolverError`` (malformed problem, minimization failure)
     propagates: the host engine is the last line of defense and masking
@@ -93,7 +99,7 @@ def solve_lane(problem, max_steps: Optional[int] = None,
 
     if deadline is not None and deadline.expired():
         return _degraded_result()
-    eng = HostEngine(problem, max_steps=max_steps)
+    eng = HostEngine(problem, max_steps=max_steps, cancel=cancel)
     t0 = time.perf_counter()
     outcome = "incomplete"
     installed_idx: List[int] = []

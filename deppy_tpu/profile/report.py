@@ -25,14 +25,23 @@ from typing import Dict, List, Optional
 
 
 def summarize(path: str) -> dict:
-    """Aggregate a sink's ``profile`` events into the cost model."""
+    """Aggregate a sink's ``profile`` events into the cost model, and
+    its ``race`` events (ISSUE 13) into the per-class portfolio table
+    — wins/cancels/win-margin per backend plus straggler-resubmission
+    counts, from the sink alone."""
     from ..telemetry import iter_sink_events
 
     device: List[dict] = []
     backends: Dict[str, dict] = {}
+    races: Dict[str, dict] = {}
     n_events = 0
     for ev in iter_sink_events(path):
-        if ev is None or ev.get("kind") != "profile":
+        if ev is None:
+            continue
+        if ev.get("kind") == "race":
+            _take_race(races, ev)
+            continue
+        if ev.get("kind") != "profile":
             continue
         n_events += 1
         backend = str(ev.get("backend", "?"))
@@ -51,13 +60,49 @@ def summarize(path: str) -> dict:
         agg["us_per_solve"] = (
             round(agg["solve_s"] * 1e6 / agg["lanes"], 2)
             if agg["lanes"] else 0.0)
+    for agg in races.values():
+        margins = agg.pop("_margins")
+        agg["win_margin_s_mean"] = (
+            round(sum(margins) / len(margins), 6) if margins else None)
+        agg["win_margin_s_min"] = (round(min(margins), 6)
+                                   if margins else None)
     return {
         "profile_events": n_events,
         "device_dispatches": len(device),
         "trip_overhead": _trip_regression(device),
         "size_classes": _size_classes(device),
         "backends": backends,
+        "races": races,
     }
+
+
+def _take_race(races: Dict[str, dict], ev: dict) -> None:
+    key = str(ev.get("size_class_name", "?"))
+    agg = races.setdefault(key, {
+        "races": 0, "starts": {}, "wins": {}, "cancels": {},
+        "resubmitted": 0, "no_winner": 0, "checked": 0,
+        "check_mismatches": 0, "_margins": [],
+    })
+    if ev.get("resubmitted") is not None:
+        agg["resubmitted"] += int(ev.get("resubmitted") or 0)
+        return
+    agg["races"] += 1
+    for name in ev.get("entrants") or []:
+        agg["starts"][name] = agg["starts"].get(name, 0) + 1
+    winner = ev.get("winner")
+    if winner is None:
+        agg["no_winner"] += 1
+    else:
+        agg["wins"][winner] = agg["wins"].get(winner, 0) + 1
+    for name in ev.get("cancelled") or []:
+        agg["cancels"][name] = agg["cancels"].get(name, 0) + 1
+    if ev.get("checked") is not None:
+        agg["checked"] += 1
+        if ev.get("checked") == "mismatch":
+            agg["check_mismatches"] += 1
+    m = ev.get("win_margin_s")
+    if isinstance(m, (int, float)):
+        agg["_margins"].append(float(m))
 
 
 def _trip_regression(device: List[dict]) -> Optional[dict]:
@@ -167,4 +212,25 @@ def render_text(summary: dict, path: str) -> str:
             lines.append(f"  {name:>10}  {a['events']:>6}  "
                          f"{a['lanes']:>7}  {a['solve_s']:>9.3f}  "
                          f"{a['us_per_solve']:>9.1f}")
+    races = summary.get("races") or {}
+    if races:
+        lines.append("portfolio races (per size class):")
+        lines.append(f"  {'class':>10}  {'races':>5}  "
+                     f"{'wins':<28}  {'cancels':<24}  {'margin':>8}  "
+                     f"{'resub':>5}")
+        for key in sorted(races, key=lambda k: (len(k), k)):
+            a = races[key]
+            wins = " ".join(f"{n}={c}" for n, c in
+                            sorted(a["wins"].items())) or "-"
+            cancels = " ".join(f"{n}={c}" for n, c in
+                               sorted(a["cancels"].items())) or "-"
+            margin = (f"{a['win_margin_s_mean'] * 1e3:.1f}ms"
+                      if a.get("win_margin_s_mean") is not None else "-")
+            lines.append(
+                f"  {key:>10}  {a['races']:>5}  {wins:<28}  "
+                f"{cancels:<24}  {margin:>8}  {a['resubmitted']:>5}")
+            if a.get("check_mismatches"):
+                lines.append(
+                    f"  {'':>10}  !! {a['check_mismatches']} sampled "
+                    f"cross-check mismatch(es) — served canonical")
     return "\n".join(lines)

@@ -67,6 +67,32 @@ class WarmStartConflict(Exception):
         self.reason = reason
 
 
+class GuidanceUnverified(Exception):
+    """A gradient-guided solve could not certify byte-identity to
+    :meth:`HostEngine.solve` and must fall back (ISSUE 13).
+
+    Raised by :meth:`HostEngine.solve_guided` whenever the rounded
+    relaxation fails its BCP verification pass, the problem's baseline
+    is UNSAT (cores stay the discrete engines' business), or the
+    zero-backtrack completion walk would need real backtracking.  Like
+    :class:`WarmStartConflict` this is control flow, not an error: the
+    portfolio racer answers with a discrete engine and the result
+    stays exact."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class SolveCancelled(Exception):
+    """A cooperatively-cancelled solve (ISSUE 13 portfolio racing).
+
+    Raised from :meth:`HostEngine._count_step` when the engine's
+    ``cancel`` event is set: a race's losing host lane stops at the
+    next step boundary instead of running to completion.  Never a
+    solve verdict — the racer discards the lane entirely."""
+
+
 @dataclass
 class _Guess:
     """One entry of the guess stack (reference search.go:16-21)."""
@@ -102,8 +128,15 @@ class HostEngine:
         problem: Problem,
         tracer: Optional[Tracer] = None,
         max_steps: Optional[int] = None,
+        cancel=None,
     ):
         self.p = problem
+        # Cooperative cancellation (ISSUE 13): any object with
+        # ``is_set()`` (a ``threading.Event``).  Checked at step
+        # boundaries only — a race's losing lane stops at the next
+        # step, never mid-propagation.  None (the default) keeps the
+        # hot path free of the check's branch.
+        self._cancel = cancel
         # StatsTracer is the default tracer (SURVEY.md §5): every host
         # solve — including the driver's host-fallback rows — counts
         # decisions/propagation rounds/backtracks into the same channel
@@ -205,6 +238,13 @@ class HostEngine:
         self.last_conflicts = []
         while True:
             self._bcp_rounds += 1
+            # Cooperative cancel, per propagation round (ISSUE 13): the
+            # minimization sweep's conflict-probing BCP passes are the
+            # engine's dominant cost on deep chains and never reach
+            # _count_step — a losing race lane must stop here, not
+            # minutes later.
+            if self._cancel is not None and self._cancel.is_set():
+                raise SolveCancelled()
             changed = False
             conflict = False
             want = np.zeros(self.v, dtype=np.int8)  # pending implications
@@ -656,6 +696,151 @@ class HostEngine:
         # answer cold instead of guessing.
         raise WarmStartConflict("cone-minimization-failed")
 
+    # ------------------------------------------------- guided (ISSUE 13)
+    #
+    # The gradient-relaxation entrant's certification surface.  The
+    # continuous descent (engine/grad_relax.py) proposes a rounded
+    # assignment; this entry serves an answer ONLY when that answer is
+    # provably the one :meth:`solve` would produce, and raises
+    # :class:`GuidanceUnverified` the moment that proof breaks — the
+    # portfolio racer then falls back to the discrete engines, so
+    # correctness never depends on the heuristic.
+    #
+    # The equivalence argument, case by case:
+    #
+    #   * baseline-SAT (propagation from the base assumptions alone
+    #     yields a total assignment): every variable is BCP-forced, so
+    #     the extras-minimization sweep can only return that exact
+    #     fixpoint (each w < n_extras conflicts on the forced trues;
+    #     w = n_extras reproduces it) — serving the fixpoint directly
+    #     is byte-identical while skipping the O(extras) sweep.  This
+    #     is the deep-implication-chain class where lockstep DPLL
+    #     burns whole-batch trips (the `hard` bench workload).
+    #   * baseline-UNKNOWN: the rounded relaxation is first verified by
+    #     one BCP pass (assume every variable at its rounded polarity;
+    #     SAT means the rounding is a genuine model — a satisfiability
+    #     certificate).  Then the preference-ordered guess search and
+    #     the completion DPLL re-run exactly as :meth:`solve` would,
+    #     except ANY would-be backtrack aborts (the solve_warm
+    #     zero-backtrack discipline; _dpll_guided allows the one
+    #     immediate false→true flip canonical DPLL performs in place).
+    #     A run that never backtracks IS the canonical run, so the
+    #     model — and the canonical `_minimize` that follows — match
+    #     byte for byte.
+    #   * baseline-UNSAT: unsat cores stay the discrete engines'
+    #     business — always unverified.
+
+    def solve_guided(
+        self, hint_model: Optional[np.ndarray] = None
+    ) -> Tuple[List[Variable], List[int]]:
+        """Serve :meth:`solve`'s exact answer via the gradient-guided
+        fast path, or raise :class:`GuidanceUnverified` (the caller
+        falls back).  ``hint_model`` is the descent's rounded candidate
+        (bool[n_vars]); None skips the verification gate and attempts
+        the zero-backtrack walk directly (baseline-SAT problems need no
+        hint at all)."""
+        p = self.p
+        if p.errors:
+            raise InternalSolverError(p.errors)
+        outcome, assign = self._test(guessed=())
+        if outcome == UNSAT:
+            raise GuidanceUnverified("baseline-unsat")
+        if outcome == SAT:
+            installed_idx = [i for i in range(self.n)
+                             if assign[i] == _TRUE]
+            return [p.variables[i] for i in installed_idx], installed_idx
+        if hint_model is not None:
+            hint = np.asarray(hint_model, dtype=bool)[: self.n]
+            v_outcome, _ = self._test(
+                guessed=(),
+                extra_true=[int(i) for i in np.nonzero(hint)[0]],
+                extra_false=[int(i) for i in np.nonzero(~hint)[0]],
+            )
+            if v_outcome != SAT:
+                raise GuidanceUnverified("rounding-unverified")
+        result, guessed_order, model = self._search_guided()
+        if result != SAT or model is None:
+            raise GuidanceUnverified("search-would-backtrack")
+        return self._minimize(model, set(guessed_order))
+
+    def _search_guided(self) -> Tuple[int, List[int], Optional[np.ndarray]]:
+        """:meth:`_search` with the zero-backtrack discipline of
+        :meth:`_search_warm` over the WHOLE problem: same deque walk,
+        same Tests, but any UNSAT result aborts (via the UNSAT return —
+        the caller raises) and the final completion runs
+        :meth:`_dpll_guided`.  A walk that completes is, operation for
+        operation, the canonical search's own no-backtrack trace."""
+        p = self.p
+        dq: _deque = _deque()
+        for r in range(len(p.anchors)):
+            dq.append((r, 0))
+        guesses: List[_Guess] = []
+        result = UNKNOWN
+        model: Optional[np.ndarray] = None
+
+        def assumed_vars() -> List[int]:
+            return [g.var for g in guesses if g.var >= 0]
+
+        while True:
+            if not dq and result == UNKNOWN:
+                model = self._dpll_guided(assumed_vars())
+                result = SAT
+            if result == UNSAT:
+                return UNSAT, assumed_vars(), None
+            if not dq:
+                break
+            cid, idx = dq.popleft()
+            cands = [int(c) for c in p.choice_cand[cid] if c >= 0]
+            var = cands[idx] if idx < len(cands) else -1
+            assumed = set(assumed_vars())
+            if any(c in assumed for c in cands):
+                var = -1
+            g = _Guess(choice=cid, index=idx, var=var, children=0)
+            guesses.append(g)
+            if var < 0:
+                continue
+            self._count_decision()
+            for ch in p.var_choices[var] if var < len(p.var_choices) else []:
+                if ch >= 0:
+                    g.children += 1
+                    dq.append((int(ch), 0))
+            result, assign = self._test(guessed=assumed_vars())
+            if result == SAT:
+                model = assign
+        return result, assumed_vars(), model
+
+    def _dpll_guided(self, fixed_true: Sequence[int]) -> np.ndarray:
+        """The completion DPLL of :meth:`_dpll`, restricted to the
+        no-backtrack regime: lowest-index false-first decisions with the
+        single in-place false→true flip canonical chronological
+        backtracking performs on an immediate conflict.  Needing to pop
+        a PREVIOUS decision voids the canonical-identity argument —
+        raise and fall back."""
+        assign = self._base.copy()
+        assign[self.p.anchors] = _TRUE
+        for m in fixed_true:
+            assign[m] = _TRUE
+        conflict, assign = self._bcp(assign)
+        if conflict:
+            raise GuidanceUnverified("completion-root-conflict")
+        while True:
+            self._count_step()
+            unassigned = np.nonzero(assign[: self.n] == _UNASSIGNED)[0]
+            if unassigned.size == 0:
+                return assign
+            var = int(unassigned[0])
+            self._count_decision()
+            trial = assign.copy()
+            trial[var] = _FALSE
+            conflict, trial = self._bcp(trial)
+            if conflict:
+                trial = assign.copy()
+                trial[var] = _TRUE
+                conflict, trial = self._bcp(trial)
+                if conflict:
+                    raise GuidanceUnverified("needs-backtrack")
+            assign = trial
+
     # ----------------------------------------------------------- minimize
 
     def _minimize(
@@ -742,6 +927,8 @@ class HostEngine:
 
     def _count_step(self) -> None:
         self._steps += 1
+        if self._cancel is not None and self._cancel.is_set():
+            raise SolveCancelled()
         if self.max_steps is not None and self._steps > self.max_steps:
             raise Incomplete()
 

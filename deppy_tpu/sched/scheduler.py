@@ -68,6 +68,13 @@ DEFAULT_CACHE_SIZE = 1024
 DEFAULT_MAX_DEPTH = 4096
 DEFAULT_INCREMENTAL_INDEX = 512
 DEFAULT_INCREMENTAL_MAX_DELTA = 0.25
+# Portfolio racing (ISSUE 13): top-K backends raced per cold flush, and
+# the deterministic 1-in-N fraction of non-canonical race wins that are
+# cross-checked against the canonical backend through the differential
+# machinery (the canonical entrant is exempted from cancellation on
+# sampled races so its answer exists to compare).
+DEFAULT_PORTFOLIO_K = 2
+DEFAULT_PORTFOLIO_SAMPLE_CHECK = 0.0625
 
 # The "incremental" size class (ISSUE 10): warm-started lanes coalesce
 # with each other — their cost is a handful of host propagation passes,
@@ -164,6 +171,357 @@ class _Group:
         self.timing: dict = {}
 
 
+def _count_lane_outcome(rep, r) -> None:
+    """Fold one HostLaneResult into a SolveReport — exactly the
+    accounting the host drain performs (degraded lanes count as
+    incomplete with no engine counters)."""
+    if r.degraded:
+        rep.count_outcome("incomplete")
+        return
+    rep.count_outcome(r.outcome)
+    rep.steps += r.steps
+    rep.decisions += r.decisions
+    rep.propagation_rounds += r.propagation_rounds
+    rep.backtracks += r.backtracks
+
+
+def _apply_lane_result(lane: "_Lane", r, point: str,
+                       canonical: bool = True) -> None:
+    """Decode one HostLaneResult onto its lane — the host drain's
+    decode convention, shared so racing cannot grow a second decode
+    path.  ``canonical=False`` (a race won by a non-canonical backend)
+    clears the lane's backtrack observation: the winner's count is not
+    the canonical engine's, and the clause-set index must never seed a
+    warm start from a non-canonical cost observation."""
+    if r.degraded:
+        faults.note_deadline_exceeded(point, tenant=lane.tenant)
+        lane.result = Incomplete()
+        lane.degraded = True
+        return
+    if r.outcome == "sat":
+        lane.result = _solution_dict(lane.problem, r.installed_idx)
+    elif r.outcome == "unsat":
+        lane.result = NotSatisfiable(
+            [lane.problem.applied[j] for j in r.core_idx])
+    else:
+        lane.result = Incomplete()
+    lane.steps = r.steps
+    lane.backtracks = r.backtracks if canonical else None
+
+
+class _RacePlan:
+    """One flush's race decision: the candidate backends and the class
+    they were ranked for."""
+
+    __slots__ = ("names", "class_name", "canonical")
+
+    def __init__(self, names: List[str], class_name: str,
+                 canonical: str):
+        self.names = names
+        self.class_name = class_name
+        self.canonical = canonical
+
+
+# Abandoned race losers (a device program mid-execution, a grad descent
+# mid-compile) must not be killed as daemon threads while they hold XLA
+# runtime locks — the C++ runtime calls std::terminate at interpreter
+# teardown.  Every race thread registers here and an atexit hook joins
+# the stragglers (bounded: losers see the stop flag at their next step
+# boundary; a device program runs out its dispatch).
+_RACE_THREADS: List[threading.Thread] = []
+_RACE_THREADS_LOCK = threading.Lock()
+_RACE_ATEXIT = [False]
+
+
+def _note_race_thread(t: threading.Thread) -> None:
+    with _RACE_THREADS_LOCK:
+        _RACE_THREADS[:] = [x for x in _RACE_THREADS if x.is_alive()]
+        _RACE_THREADS.append(t)
+        if not _RACE_ATEXIT[0]:
+            import atexit
+
+            atexit.register(_join_race_threads)
+            _RACE_ATEXIT[0] = True
+
+
+def _join_race_threads(timeout_s: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    with _RACE_THREADS_LOCK:
+        threads = list(_RACE_THREADS)
+    for t in threads:
+        t.join(max(deadline - time.monotonic(), 0.0))
+
+
+class PortfolioRacer:
+    """First-finisher-wins racing across registered engine backends
+    (ISSUE 13 tentpole).
+
+    One coalesced cold flush is dispatched to the top-K candidate
+    backends of its size class concurrently (:mod:`deppy_tpu.engine.
+    registry` ranks them — measured ``portfolio`` rows first, the
+    static canonical-first order otherwise); the first DEFINITIVE
+    finisher (every lane answered) wins, and the losers are
+    cancelled: host lanes check a cooperative stop flag at step
+    boundaries, device programs run to completion with their fetch
+    dropped, hostpool dispatches are abandoned.  A deterministic
+    1-in-N sample of non-canonical wins is cross-checked against the
+    canonical backend's answer through the differential lane
+    comparison — a mismatch is a loud ``race_mismatch`` fault event
+    and the canonical answer is served.
+
+    Modes: ``on`` races wherever ≥2 candidates serve the class;
+    ``auto`` races only classes with a measured ``portfolio`` row
+    (the tpu_ab-learned default posture).  ``off`` never constructs a
+    racer — the scheduler's dispatch path is byte-identical to the
+    pre-portfolio tree."""
+
+    def __init__(self, mode: str, k: int, sample_check: float,
+                 registry: "telemetry.Registry"):
+        self.mode = mode
+        self.k = max(int(k), 2)
+        rate = max(float(sample_check), 0.0)
+        self._check_interval = (int(round(1.0 / min(rate, 1.0)))
+                                if rate > 0 else 0)
+        # Non-canonical wins since the last cross-check.  The sampling
+        # contract is 1-in-N NON-CANONICAL WINS (not 1-in-N races —
+        # counting races would let deterministic aliasing against the
+        # flush pattern starve the check forever); seeded so the very
+        # FIRST non-canonical win is checked.  The cancel exemption
+        # must be decided before racing, so the check arms whenever
+        # the next non-canonical win would be the Nth.
+        self._check_lock = threading.Lock()
+        self._since_check = max(self._check_interval - 1, 0)
+        self._registry = registry
+
+    # ------------------------------------------------------------- plan
+
+    def plan(self, live: List["_Lane"], backend: str) -> Optional[_RacePlan]:
+        """Decide whether THIS flush races: candidate backends for its
+        ladder class, capability- and availability-filtered.  None
+        means the canonical single-backend path runs untouched."""
+        from ..engine import registry as engine_registry
+        from ..engine.driver import padded_class
+
+        class_name = padded_class([lane.problem for lane in live])
+        device_ok = (backend != "host"
+                     and not faults.default_breaker().blocks_device())
+        need_card = any(lane.problem.card_act.shape[0] > 0
+                        and (lane.problem.card_act >= 0).any()
+                        for lane in live)
+        names, measured = engine_registry.candidates(
+            class_name, self.k, device_ok=device_ok,
+            cardinality=need_card)
+        if self.mode == "auto" and not measured:
+            return None
+        if len(names) < 2:
+            return None
+        canonical = "host" if backend == "host" else "device"
+        if canonical == "device" and not device_ok:
+            canonical = "host"
+        return _RacePlan(names, class_name, canonical)
+
+    # ------------------------------------------------------------- race
+
+    def race(self, plan: _RacePlan, live: List["_Lane"], rep,
+             timing: dict, mesh_fn) -> bool:
+        """Run one race.  Returns True when a winner's results were
+        applied to the lanes (and merged into ``rep``); False when no
+        entrant finished definitively — the caller falls back to the
+        canonical path exactly as if racing were off."""
+        from ..engine import registry as engine_registry
+        from ..sat.host import SolveCancelled
+
+        reg = self._registry
+        problems = [lane.problem for lane in live]
+        deadlines = [lane.deadline for lane in live]
+        dl = faults.current_deadline()
+        mesh = mesh_fn() if "device" in plan.names else None
+        stop = threading.Event()
+        with self._check_lock:
+            check = (self._check_interval > 0
+                     and plan.canonical in plan.names
+                     and self._since_check + 1 >= self._check_interval)
+        cv = threading.Condition()
+        finished: List[tuple] = []  # (name, dt, out, err, srep) in
+        #                             completion order
+
+        def run(name: str, t0: float) -> None:
+            srep, owns = telemetry.begin_report(backend=name)
+            out = None
+            err = None
+            try:
+                if stop.is_set() and not (check
+                                          and name == plan.canonical):
+                    raise SolveCancelled()
+                with faults.deadline_scope(dl):
+                    faults.inject(f"sched.race.{name}")
+                    out = engine_registry.solve_via(
+                        name, problems, max_steps=live[0].max_steps,
+                        deadlines=deadlines,
+                        cancel=(None if (check and name == plan.canonical)
+                                else stop),
+                        mesh=mesh if name == "device" else None)
+                if name != "device" and out is not None:
+                    # Non-device backends don't flow through the
+                    # driver's report plumbing: account their lanes
+                    # here, on the entrant's own report (merged only
+                    # if this entrant wins / cross-checks).
+                    for r in out:
+                        if r is not None:
+                            _count_lane_outcome(srep, r)
+            except SolveCancelled:
+                err = "cancelled"
+            except BaseException as e:  # noqa: BLE001 — entrant-local
+                err = e
+            finally:
+                telemetry.detach_report(srep, owns)
+            with cv:
+                finished.append((name, time.perf_counter() - t0, out,
+                                 err, srep))
+                cv.notify_all()
+
+        t0 = time.perf_counter()
+        with reg.span("race", lanes=len(live), entrants=len(plan.names),
+                      size_class=plan.class_name) as sp:
+            threads = {}
+            for name in plan.names:
+                reg.counter(
+                    "deppy_race_starts_total",
+                    "Portfolio race entrant launches, by backend.",
+                    labelname="backend").inc(label=name)
+                t = threading.Thread(target=run, args=(name, t0),
+                                     name=f"deppy-race-{name}",
+                                     daemon=True)
+                threads[name] = t
+                _note_race_thread(t)
+                t.start()
+
+            def _definitive(name, out):
+                """A non-canonical entrant's budget-exhaustion
+                'incomplete' is that ENGINE's verdict, not the
+                canonical one (step accounting is engine-relative) —
+                letting it win would serve (and cache) Incomplete
+                where racing-off decides.  Only the canonical entrant
+                may call Incomplete; deadline-degraded lanes pass
+                (deadline behavior is timing-dependent and never
+                cached)."""
+                if out is None:
+                    return False
+                for r in out:
+                    if r is None:
+                        return False
+                    if (r.outcome == "incomplete" and not r.degraded
+                            and name != plan.canonical):
+                        return False
+                return True
+
+            def _winner_locked():
+                for entry in finished:
+                    name, _, out, err, _ = entry
+                    if err is None and _definitive(name, out):
+                        return entry
+                return None
+
+            with cv:
+                winner = _winner_locked()
+                while winner is None and len(finished) < len(plan.names):
+                    cv.wait()
+                    winner = _winner_locked()
+            stop.set()
+            if winner is None:
+                sp.set(winner="none")
+                telemetry.default_registry().event(
+                    "race", size_class_name=plan.class_name,
+                    entrants=list(plan.names), lanes=len(live),
+                    winner=None)
+                return False
+
+            noncanonical_win = winner[0] != plan.canonical
+            checked = None
+            if check and noncanonical_win:
+                # Sampled differential cross-check: the canonical
+                # entrant was exempt from cancellation — wait for its
+                # answer and compare outcome/model/core per lane.
+                # Deadline-degraded lanes are excluded on either side:
+                # degradation is pure timing (the entrants admitted
+                # the lane at different instants), not disagreement.
+                with cv:
+                    while not any(e[0] == plan.canonical
+                                  for e in finished):
+                        cv.wait()
+                    canon = next(e for e in finished
+                                 if e[0] == plan.canonical)
+                if canon[3] is None and canon[2] is not None and all(
+                        r is not None for r in canon[2]):
+                    mismatch = any(
+                        (w.outcome, tuple(w.installed_idx),
+                         tuple(w.core_idx))
+                        != (c.outcome, tuple(c.installed_idx),
+                            tuple(c.core_idx))
+                        for w, c in zip(winner[2], canon[2])
+                        if not w.degraded and not c.degraded)
+                    checked = "mismatch" if mismatch else "ok"
+                    if mismatch:
+                        reg.counter(
+                            "deppy_race_check_mismatch_total",
+                            "Sampled race cross-checks that disagreed "
+                            "with the canonical backend (served "
+                            "canonical; investigate).").inc()
+                        telemetry.default_registry().event(
+                            "fault", fault="race_mismatch",
+                            winner=winner[0],
+                            canonical=plan.canonical,
+                            lanes=len(live))
+                        winner = canon  # serve the canonical answer
+            if noncanonical_win:
+                with self._check_lock:
+                    if check:
+                        self._since_check = 0
+                    else:
+                        self._since_check += 1
+
+            wname, wdt, wout, _, wsrep = winner
+            with cv:
+                margins = [e[1] - wdt for e in finished
+                           if e[0] != wname and e[3] is None
+                           and e[2] is not None]
+                clean_done = {e[0] for e in finished if e[3] is None}
+            for name in plan.names:
+                if name != wname and name not in clean_done:
+                    reg.counter(
+                        "deppy_race_cancels_total",
+                        "Race entrants cancelled or abandoned after "
+                        "losing, by backend.",
+                        labelname="backend").inc(label=name)
+            reg.counter(
+                "deppy_race_wins_total",
+                "Races won (first definitive finisher), by backend.",
+                labelname="backend").inc(label=wname)
+            margin = min(margins) if margins else None
+            if margin is not None:
+                reg.histogram(
+                    "deppy_race_win_margin_seconds",
+                    "Winner-vs-best-finished-loser wall-clock margin "
+                    "per race.").observe(max(margin, 0.0))
+            sp.set(winner=wname)
+            telemetry.default_registry().event(
+                "race", size_class_name=plan.class_name, winner=wname,
+                canonical=plan.canonical, entrants=list(plan.names),
+                lanes=len(live),
+                cancelled=[n for n in plan.names
+                           if n != wname and n not in clean_done],
+                win_margin_s=(round(margin, 6)
+                              if margin is not None else None),
+                checked=checked, wall_s=round(wdt, 6))
+        rep.merge(wsrep)
+        canonical_won = wname == plan.canonical
+        for lane, r in zip(live, wout):
+            _apply_lane_result(lane, r, "sched.race",
+                               canonical=canonical_won)
+        timing["solve_s"] = timing.get("solve_s", 0.0) + wdt
+        return True
+
+
 class Scheduler:
     """Coalesce concurrent resolve requests into shared dispatches."""
 
@@ -182,6 +540,9 @@ class Scheduler:
         incremental: Optional[str] = None,
         incremental_max_delta: Optional[float] = None,
         incremental_index_size: Optional[int] = None,
+        portfolio: Optional[str] = None,
+        portfolio_k: Optional[int] = None,
+        portfolio_sample_check: Optional[float] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -246,6 +607,26 @@ class Scheduler:
         self.incremental = index
         self.cache = ResultCache(cache_size, registry=self._registry,
                                  incremental=index)
+        # Portfolio engine racing (ISSUE 13).  "off" constructs no
+        # racer at all — the dispatch path is byte-identical to the
+        # pre-portfolio tree; "auto" (the default) races only size
+        # classes holding a measured `portfolio` row; "on" races
+        # wherever ≥2 candidate backends serve the class.
+        if portfolio is None:
+            portfolio = config.env_raw("DEPPY_TPU_PORTFOLIO", "auto")
+        mode = str(portfolio).strip().lower()
+        self._racer: Optional[PortfolioRacer] = None
+        if mode not in ("off", "0", "false", "no"):
+            if portfolio_k is None:
+                portfolio_k = _env_int("DEPPY_TPU_PORTFOLIO_K",
+                                       DEFAULT_PORTFOLIO_K)
+            if portfolio_sample_check is None:
+                portfolio_sample_check = faults.env_float(
+                    "DEPPY_TPU_PORTFOLIO_SAMPLE_CHECK",
+                    DEFAULT_PORTFOLIO_SAMPLE_CHECK, warn=True)
+            self._racer = PortfolioRacer(
+                "on" if mode in ("on", "1", "true", "yes") else "auto",
+                portfolio_k, portfolio_sample_check, self._registry)
         reg = self._registry
         self._g_depth = reg.gauge(
             "deppy_sched_queue_depth",
@@ -732,12 +1113,36 @@ class Scheduler:
                     t1 = time.perf_counter()
                     self._solve_incremental(live, rep, timing, backend)
                     timing["solve_s"] = time.perf_counter() - t1
-                elif backend == "host":
-                    t1 = time.perf_counter()
-                    self._solve_host(live, rep)
-                    timing["solve_s"] = time.perf_counter() - t1
-                else:
-                    self._solve_device(live, timing)
+                    return rep
+                # Portfolio racing (ISSUE 13): cold flushes only.  A
+                # None plan (racing off / auto with no measured row /
+                # <2 candidates) leaves the canonical single-backend
+                # path below byte-identical to the pre-portfolio tree.
+                plan = (self._racer.plan(live, backend)
+                        if self._racer is not None else None)
+                finisher = None
+                raced = False
+                try:
+                    if plan is not None:
+                        live, finisher = self._triage_stragglers(
+                            live, plan.class_name)
+                        if live:
+                            raced = self._racer.race(
+                                plan, live, rep, timing,
+                                self._resolve_mesh)
+                        else:
+                            raced = True
+                    if not raced:
+                        if backend == "host":
+                            t1 = time.perf_counter()
+                            self._solve_host(live, rep)
+                            timing["solve_s"] = (time.perf_counter()
+                                                 - t1)
+                        else:
+                            self._solve_device(live, timing)
+                finally:
+                    if finisher is not None:
+                        finisher(rep)
         finally:
             telemetry.end_report(rep, owns)
         return rep
@@ -843,6 +1248,82 @@ class Scheduler:
             else:
                 self._solve_device(cold, timing)
 
+    def _triage_stragglers(self, live: List[_Lane], class_name: str):
+        """Per-lane deadline triage (ISSUE 13): lanes whose remaining
+        wall-clock budget cannot survive the expected device dispatch
+        (the dispatch EWMA, floored by the engine registry's per-class
+        device estimate — the ledger-informed cost model) are
+        resubmitted to the host pool, where they start immediately
+        instead of pinning — or expiring inside — a lockstep device
+        batch.  Returns (kept lanes, finisher|None); the finisher joins
+        the resubmission and merges its report.  Racing-path only: with
+        the portfolio off, deadline semantics are untouched."""
+        from ..engine import registry as engine_registry
+
+        with self._cv:
+            est = self._dispatch_ewma_s
+        est = max(est,
+                  engine_registry.estimate_us("device", class_name) / 1e6)
+        resub = [lane for lane in live
+                 if lane.deadline is not None
+                 and 0.0 < lane.deadline.remaining() < est]
+        if not resub:
+            return live, None
+        keep = [lane for lane in live
+                if not any(lane is r for r in resub)]
+        reg = self._registry
+        reg.counter(
+            "deppy_race_straggler_resubmits_total",
+            "Deadline-straggler lanes resubmitted to the host pool "
+            "instead of riding a device batch.").inc(len(resub))
+        telemetry.default_registry().event(
+            "race", resubmitted=len(resub),
+            size_class_name=class_name)
+        box: dict = {}
+
+        def side() -> None:
+            from .. import hostpool
+
+            srep, owns = telemetry.begin_report(backend="hostpool")
+            try:
+                results = hostpool.solve_host_problems(
+                    [lane.problem for lane in resub],
+                    max_steps=[lane.max_steps for lane in resub],
+                    deadlines=[lane.deadline for lane in resub])
+                for lane, r in zip(resub, results):
+                    _count_lane_outcome(srep, r)
+                    _apply_lane_result(lane, r, "sched.race",
+                                       canonical=False)
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                box["error"] = e
+            finally:
+                telemetry.detach_report(srep, owns)
+                box["rep"] = srep
+
+        t = threading.Thread(target=side, name="deppy-race-resubmit",
+                             daemon=True)
+        t.start()
+
+        def finisher(rep) -> None:
+            t.join()
+            rep.merge(box["rep"])
+            if "error" in box:
+                import sys
+
+                if sys.exc_info()[1] is not None:
+                    # A primary exception is already propagating out of
+                    # the dispatch (the finisher runs in its finally):
+                    # re-raising here would MASK it — surface the side
+                    # failure on the sink instead.
+                    telemetry.default_registry().event(
+                        "fault", fault="race_resubmit_failed",
+                        error=type(box["error"]).__name__,
+                        lanes=len(resub))
+                    return
+                raise box["error"]
+
+        return keep, finisher
+
     def _solve_host(self, live: List[_Lane], rep) -> None:
         """Host-engine drain — the breaker's host-only mode and the
         explicit host backend.  Lanes run through the shared hostpool
@@ -868,25 +1349,8 @@ class Scheduler:
                     time.perf_counter() - prof_t0,
                     tenant=_single_tenant(live))
             for lane, r in zip(live, results):
-                if r.degraded:
-                    faults.note_deadline_exceeded("sched.host_solve",
-                                                  tenant=lane.tenant)
-                    rep.count_outcome("incomplete")
-                    lane.result = Incomplete()
-                    lane.degraded = True
-                    continue
-                if r.outcome == "sat":
-                    lane.result = _solution_dict(lane.problem,
-                                                 r.installed_idx)
-                elif r.outcome == "unsat":
-                    lane.result = NotSatisfiable(
-                        [lane.problem.applied[j] for j in r.core_idx])
-                else:
-                    lane.result = Incomplete()
-                lane.steps = r.steps
-                lane.backtracks = r.backtracks
-                rep.count_outcome(r.outcome)
-                rep.steps += r.steps
-                rep.decisions += r.decisions
-                rep.propagation_rounds += r.propagation_rounds
-                rep.backtracks += r.backtracks
+                # The ONE lane decode + accounting (shared with the
+                # racer's winner application and the straggler
+                # resubmission, so the paths cannot drift).
+                _count_lane_outcome(rep, r)
+                _apply_lane_result(lane, r, "sched.host_solve")

@@ -247,6 +247,7 @@ class Server:
         incremental_max_delta: Optional[float] = None,
         incremental_index_size: Optional[int] = None,
         slo: Optional[str] = None,
+        portfolio: Optional[str] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -288,7 +289,8 @@ class Server:
                 mesh_devices=mesh_devices,
                 incremental=incremental,
                 incremental_max_delta=incremental_max_delta,
-                incremental_index_size=incremental_index_size)
+                incremental_index_size=incremental_index_size,
+                portfolio=portfolio)
         # Fault-domain knobs (ISSUE 2).  request_deadline_s: default
         # wall-clock budget per /v1/resolve (clients override per request
         # via the X-Deppy-Deadline-S header; None = unbounded).  drain_s
@@ -879,6 +881,7 @@ def serve(
     incremental_max_delta: Optional[float] = None,
     incremental_index_size: Optional[int] = None,
     slo: Optional[str] = None,
+    portfolio: Optional[str] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -895,7 +898,7 @@ def serve(
                  mesh_devices=mesh_devices, incremental=incremental,
                  incremental_max_delta=incremental_max_delta,
                  incremental_index_size=incremental_index_size,
-                 slo=slo)
+                 slo=slo, portfolio=portfolio)
     srv.start()
     stop = threading.Event()
 

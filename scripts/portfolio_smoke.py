@@ -1,0 +1,181 @@
+"""Portfolio engine-racing smoke (ISSUE 13 acceptance).
+
+End-to-end on CPU JAX, asserting the five properties racing promises:
+
+  1. **Byte-identity** — racing on (device / host / grad_relax, k=3)
+     serves exactly what racing off serves, on a mixed batch covering
+     chains, SAT, and UNSAT instances; `DEPPY_TPU_PORTFOLIO=off` (and
+     the default `auto` with no measured rows) registers no race
+     metric families at all — the pre-change dispatch path.
+  2. **Chaos** — a fault-poisoned entrant losing the race never
+     corrupts the winner: results stay byte-identical, another backend
+     wins, the poisoned start is still counted.
+  3. **Certification** — the grad entrant never serves an unverified
+     rounding (an adversarial hint on a search-needing instance comes
+     back None, and solve_guided answers match HostEngine.solve).
+  4. **Observability** — race sink events render through
+     `deppy profile`'s race table; wins/cancels/starts ride /metrics
+     families on the scheduler registry.
+  5. **Straggler triage** — a deadline-tight lane is resubmitted to
+     the host pool (counted) while its batchmates race on.
+
+Run: ``make portfolio-smoke``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _chain(depth: int):
+    from deppy_tpu import sat
+
+    vs = [sat.variable("a0", sat.mandatory(), sat.dependency("a1"))]
+    vs += [sat.variable(f"a{i}", sat.dependency(f"a{i + 1}"))
+           for i in range(1, depth - 1)]
+    vs += [sat.variable(f"a{depth - 1}")]
+    return vs
+
+
+def _mixed_requests():
+    from deppy_tpu import sat
+    from deppy_tpu.models import random_instance
+
+    reqs = [_chain(48)] * 4 + [_chain(96)] * 4
+    reqs += [random_instance(length=16, seed=s) for s in range(8)]
+    # One UNSAT instance: conflicting mandatory prohibition pair.
+    reqs.append([
+        sat.variable("u0", sat.mandatory(), sat.dependency("u1")),
+        sat.variable("u1", sat.prohibited()),
+    ])
+    return reqs
+
+
+def main() -> int:
+    import numpy as np  # noqa: F401 — env sanity
+
+    from deppy_tpu import faults, io as pio, telemetry
+    from deppy_tpu.sched import scheduler as sched_mod
+    from deppy_tpu.sched.scheduler import Scheduler
+
+    reqs = _mixed_requests()
+
+    # ---- 1. byte-identity + off/auto leave the path untouched -------
+    reg_off = telemetry.Registry()
+    off = [pio.result_to_dict(r) for r in Scheduler(
+        backend="auto", portfolio="off",
+        registry=reg_off).submit(reqs)]
+    if any(k.startswith("deppy_race") for k in reg_off.snapshot()):
+        fail("portfolio=off registered race metric families")
+    reg_auto = telemetry.Registry()
+    auto = [pio.result_to_dict(r) for r in Scheduler(
+        backend="auto", portfolio="auto",
+        registry=reg_auto).submit(reqs)]
+    if auto != off:
+        fail("portfolio=auto (no measured rows) changed results")
+    if any(k.startswith("deppy_race") for k in reg_auto.snapshot()):
+        fail("portfolio=auto with no measured rows raced anyway")
+
+    sink = tempfile.mktemp(prefix="portfolio_smoke_", suffix=".jsonl")
+    telemetry.configure_sink(sink)
+    reg_on = telemetry.Registry()
+    on = [pio.result_to_dict(r) for r in Scheduler(
+        backend="auto", portfolio="on", portfolio_k=3,
+        portfolio_sample_check=1.0, registry=reg_on).submit(reqs)]
+    if on != off:
+        fail("racing-on results differ from racing-off")
+    snap = reg_on.snapshot()
+    starts = snap.get("deppy_race_starts_total") or {}
+    wins = snap.get("deppy_race_wins_total") or {}
+    if not starts or sum(wins.values()) < 1:
+        fail(f"race metrics missing: starts={starts} wins={wins}")
+    print(f"ok: byte-identity (starts={starts} wins={wins})")
+
+    # ---- 2. chaos: poisoned entrant loses, winner uncorrupted -------
+    plan = faults.plan_from_spec(json.dumps({"faults": [
+        {"point": "sched.race.device", "kind": "error", "times": -1}]}))
+    faults.configure_plan(plan)
+    reg_chaos = telemetry.Registry()
+    try:
+        chaos = [pio.result_to_dict(r) for r in Scheduler(
+            backend="auto", portfolio="on", portfolio_k=3,
+            portfolio_sample_check=0.0,
+            registry=reg_chaos).submit(reqs)]
+    finally:
+        faults.configure_plan(None)
+    if chaos != off:
+        fail("poisoned race corrupted the winner's results")
+    cwins = reg_chaos.snapshot().get("deppy_race_wins_total") or {}
+    if cwins.get("device"):
+        fail(f"poisoned device entrant won anyway: {cwins}")
+    print(f"ok: chaos (wins={cwins})")
+
+    # ---- 3. grad certification --------------------------------------
+    from deppy_tpu.engine import grad_relax
+    from deppy_tpu.sat.encode import encode
+    from deppy_tpu.sat.host import HostEngine
+
+    chain_p = encode(_chain(48))
+    r = grad_relax.solve_lanes([chain_p])[0]
+    _, want = HostEngine(chain_p).solve()
+    if r is None or r.outcome != "sat" or r.installed_idx != want:
+        fail("grad entrant missed or mis-served the chain")
+    # Adversarial hint on an UNSAT problem: must never be served.
+    unsat_p = encode(_mixed_requests()[-1])
+    bad = grad_relax.attempt(unsat_p,
+                             np.ones(unsat_p.n_vars, dtype=bool))
+    if bad is not None:
+        fail("grad entrant served an unverifiable instance")
+    print("ok: grad certification")
+
+    # ---- 4. deppy profile race table --------------------------------
+    telemetry.configure_sink(None)
+    from deppy_tpu.profile.report import render_text, summarize
+
+    summary = summarize(sink)
+    races = summary.get("races") or {}
+    if not races or not any(a["races"] for a in races.values()):
+        fail(f"no race events reached the sink: {races}")
+    text = render_text(summary, sink)
+    if "portfolio races" not in text:
+        fail("deppy profile output lacks the race table")
+    print("ok: profile race table "
+          f"({sum(a['races'] for a in races.values())} races)")
+
+    # ---- 5. straggler triage ----------------------------------------
+    reg_tri = telemetry.Registry()
+    tri = Scheduler(backend="auto", portfolio="on", portfolio_k=3,
+                    portfolio_sample_check=0.0, registry=reg_tri)
+    tri._dispatch_ewma_s = 30.0  # any finite deadline reads straggler
+    results = tri.submit([reqs[0], reqs[1]], deadline_s=20.0)
+    resub = reg_tri.snapshot().get(
+        "deppy_race_straggler_resubmits_total")
+    if not resub:
+        fail("deadline-tight lanes were not resubmitted to the pool")
+    if any(pio.result_to_dict(r)["status"] != "sat" for r in results):
+        fail("resubmitted straggler lanes lost their answers")
+    print(f"ok: straggler triage (resubmitted={resub})")
+
+    sched_mod._join_race_threads()
+    try:
+        os.unlink(sink)
+    except OSError:
+        pass
+    print("portfolio smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,7 +30,7 @@ from scripts._stage import emit, make_healthy, run_stage, solve_stage_src
 KNOB_VARS = ("DEPPY_TPU_BCP_UNROLL", "DEPPY_TPU_STAGE1_STEPS",
              "DEPPY_TPU_SEARCH", "DEPPY_TPU_MAX_LANES",
              "DEPPY_TPU_DPLL_UNROLL", "DEPPY_TPU_CTL_UNROLL",
-             "DEPPY_TPU_BCP")
+             "DEPPY_TPU_BCP", "DEPPY_TPU_PORTFOLIO")
 
 # (name, knobs, tpu_only): tpu_only variants are SKIPPED when the pinned
 # backend is cpu — search-fused there runs the Pallas kernel in
@@ -80,6 +80,76 @@ VARIANTS = [
 ]
 
 
+def run_portfolio_ab(a, expected) -> None:
+    """ISSUE 13: the portfolio-racing A/B — the hard-instance workload
+    through the scheduler serving path, racing on vs off (the
+    ``bench.py --workload hard`` record, in-process byte-identity
+    included).  A measured racing win (``vs_baseline`` ≥ 1.5 with
+    ``race_identical_to_off`` true) is what writes the
+    measured-defaults ``portfolio.<class>`` rows (the hard chains span
+    the ``m``/``l`` ladder classes) that let ``auto`` racing engage
+    for those classes on this backend — the same
+    measured-row-before-default policy every engine bet follows."""
+    import json
+    import subprocess
+
+    env = dict(os.environ)
+    for k in KNOB_VARS:
+        env.pop(k, None)
+    env.setdefault("DEPPY_TPU_COMPILE_CACHE", "on")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "deppy_tpu.benchmarks.hard",
+             "--passes", "2"],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            timeout=max(a.step_timeout, 600))
+    except subprocess.TimeoutExpired:
+        emit({"variant": "portfolio-hard", "ok": False,
+              "error": "timeout"}, a.log)
+        return
+    rec = None
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            rec = parsed
+            break
+    if proc.returncode != 0 or rec is None:
+        emit({"variant": "portfolio-hard", "ok": False,
+              "rc": proc.returncode,
+              "tail": (proc.stderr or "")[-500:]}, a.log)
+        return
+    won = (rec.get("vs_baseline", 0) >= 1.5
+           and rec.get("race_identical_to_off"))
+    emit({"variant": "portfolio-hard", "ok": True, "won": bool(won),
+          **{k: rec[k] for k in ("value", "vs_baseline",
+                                 "race_identical_to_off",
+                                 "best_fixed_backend") if k in rec}},
+         a.log)
+    if won and a.write_portfolio_rows:
+        from deppy_tpu.engine import core as engine_core
+
+        path = engine_core._MEASURED_DEFAULTS_PATH
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+        backend = expected[0] or "cpu"
+        entry = doc.setdefault(backend, {})
+        for cls in ("m", "l"):
+            entry[f"portfolio.{cls}"] = "grad_relax,device,host"
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        emit({"note": f"wrote portfolio.m/.l rows for {backend} "
+              f"to {path}"}, a.log)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--count", type=int, default=1024)
@@ -94,6 +164,14 @@ def main() -> None:
                     "revalidation ladder when the Mosaic compile-smoke "
                     "failed it — a known-broken variant would abort the "
                     "A/B and lose the remaining measurements)")
+    ap.add_argument("--portfolio", action="store_true",
+                    help="append the ISSUE 13 portfolio-racing A/B "
+                    "(the hard-instance workload, racing on vs off)")
+    ap.add_argument("--write-portfolio-rows", action="store_true",
+                    help="on a measured racing win (>=1.5x, "
+                    "byte-identical), write the measured-defaults "
+                    "portfolio.<class> rows that let auto racing "
+                    "engage for the hard classes on this backend")
     a = ap.parse_args()
 
     expected = [None]
@@ -154,6 +232,8 @@ def main() -> None:
             sys.exit(1)
         if expected[0] is None:
             expected[0] = rec["backend"]
+    if a.portfolio:
+        run_portfolio_ab(a, expected)
 
 
 if __name__ == "__main__":
