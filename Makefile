@@ -116,6 +116,14 @@ portfolio-smoke: ## Portfolio engine racing end to end: racing-on byte-identity,
 test-portfolio: ## Portfolio racing subsystem tests only (the `portfolio` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m portfolio
 
+.PHONY: speculate-smoke
+speculate-smoke: ## Speculative pre-resolution end to end: publish burst against a live service, warm-hit ratio + live-lane latency under load, preview read-only, speculate-off 404 + byte-identity (ISSUE 14 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/speculate_smoke.py
+
+.PHONY: test-speculate
+test-speculate: ## Speculative pre-resolution subsystem tests only (the `speculate` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m speculate
+
 .PHONY: lint
 lint: ## Static analysis: the six deppy-lint checkers vs analysis/baseline.json (ISSUE 7/8 acceptance; docs/analysis.md).
 	$(PYTHON) -m deppy_tpu lint

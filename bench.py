@@ -460,7 +460,57 @@ def _run_churn(timeout_s: int) -> dict | None:
     return None
 
 
+def _run_publish(timeout_s: int) -> dict | None:
+    """Run the publish-churn speculative pre-resolution workload
+    (ISSUE 14) on the forced-CPU platform — it measures the host-path
+    serving p99 with speculation on vs off, so the accelerator
+    probe/retry machinery has nothing to add — and return its parsed
+    record or None.  Always writes the full artifact
+    (benchmarks/results/speculate_r14.json)."""
+    from deppy_tpu.utils.platform_env import run_captured
+
+    cmd = [sys.executable, "-m", "deppy_tpu.benchmarks.publish",
+           "--out", os.path.join(REPO, "benchmarks", "results",
+                                 "speculate_r14.json")]
+    if "DEPPY_BENCH_N" in os.environ:
+        cmd += ["--n-families", os.environ["DEPPY_BENCH_N"]]
+    try:
+        rc, stdout, stderr = run_captured(
+            cmd, timeout_s=timeout_s, cwd=REPO, env=_cpu_env())
+    except subprocess.TimeoutExpired:
+        _log(f"publish workload timed out after {timeout_s}s")
+        return None
+    if stderr:
+        print(stderr, file=sys.stderr, end="", flush=True)
+    if rc != 0:
+        _log(f"publish workload failed rc={rc}")
+        return None
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            return rec
+    return None
+
+
 def main(workload: str = "headline") -> int:
+    if workload == "publish":
+        rec = _run_publish(RUN_TIMEOUT_S)
+        if rec is None:
+            rec = {
+                "metric": ("publish-churn query p99 ms "
+                           "(speculative pre-resolution on vs off)"),
+                "value": 0.0,
+                "unit": "ms",
+                "vs_baseline": 0.0,
+                "workload": "publish",
+                "backend": "none",
+                "error": "publish workload produced no record",
+            }
+        print(json.dumps(rec), flush=True)
+        return 0
     if workload == "hard":
         rec = _run_hard(RUN_TIMEOUT_S)
         if rec is None:
@@ -575,12 +625,15 @@ if __name__ == "__main__":
     import argparse
 
     _ap = argparse.ArgumentParser()
-    _ap.add_argument("--workload", choices=["headline", "churn", "hard"],
+    _ap.add_argument("--workload",
+                     choices=["headline", "churn", "hard", "publish"],
                      default="headline",
                      help="headline = batched device vs serial host; "
                      "churn = warm-start vs cold re-resolution replay "
                      "(ISSUE 10); hard = deep-implication-chain "
-                     "portfolio racing vs fixed backends (ISSUE 13)")
+                     "portfolio racing vs fixed backends (ISSUE 13); "
+                     "publish = sustained publish+query load, "
+                     "speculative pre-resolution on vs off (ISSUE 14)")
     _args = _ap.parse_args()
     try:
         rc = main(workload=_args.workload)

@@ -229,6 +229,21 @@ def _build_parser() -> argparse.ArgumentParser:
         "dispatch byte for byte; also via DEPPY_TPU_PORTFOLIO)",
     )
     p_serve.add_argument(
+        "--speculate", choices=["on", "off"], default=None,
+        help="speculative pre-resolution (ISSUE 14): catalog publishes "
+        "(POST /v1/catalog/publish / `deppy publish`) pre-solve "
+        "affected cached families at idle priority and the what-if "
+        "preview endpoint serves proposed-change resolutions read-only "
+        "(default on; 'off' restores pre-change dispatch byte for byte "
+        "and 404s both endpoints; also via DEPPY_TPU_SPECULATE)",
+    )
+    p_serve.add_argument(
+        "--speculate-max-backlog", type=int, default=None, metavar="N",
+        help="speculative pre-solve backlog cap in lanes — pre-solves "
+        "past it are dropped and counted (default 2048; also via "
+        "DEPPY_TPU_SPECULATE_MAX_BACKLOG)",
+    )
+    p_serve.add_argument(
         "--slo", default=None, metavar="SPEC",
         help="declarative per-tenant SLO config: inline JSON, @FILE, "
         "or a path mapping tenant -> {target_p99_s, error_budget} "
@@ -266,6 +281,41 @@ def _build_parser() -> argparse.ArgumentParser:
         "single-device dispatch; also via DEPPY_TPU_MESH_DEVICES).  "
         "Each device gets its own fault domain and "
         "deppy_breaker_state{device=...} breaker",
+    )
+
+    p_publish = sub.add_parser(
+        "publish",
+        help="publish a catalog delta to a running service "
+        "(POST /v1/catalog/publish): the server invalidates retracted "
+        "cache entries and pre-solves every affected cached family at "
+        "idle priority, so dependents' re-asks become cache hits "
+        "(ISSUE 14; --preview resolves the change read-only instead)",
+    )
+    p_publish.add_argument(
+        "file",
+        help="JSON publish document: {\"updates\": [{\"id\": ..., "
+        "\"constraints\": [...]}], \"removed\": [...]} — constraint "
+        "objects use the deppy_tpu.io problem-file format",
+    )
+    p_publish.add_argument(
+        "--server", default="http://127.0.0.1:8080", metavar="URL",
+        help="base URL of the running service (default "
+        "http://127.0.0.1:8080)",
+    )
+    p_publish.add_argument(
+        "--preview", action="store_true",
+        help="POST /v1/resolve/preview instead: resolve the PROPOSED "
+        "change against the live index without serving or caching it "
+        "(upgrade-impact preview)",
+    )
+    p_publish.add_argument(
+        "--limit", type=int, default=None, metavar="N",
+        help="with --preview: cap the affected families previewed "
+        "(most recently served first; server default 32)",
+    )
+    p_publish.add_argument(
+        "--output", choices=["text", "json"], default="text",
+        help="output format (default: text)",
     )
 
     p_stats = sub.add_parser(
@@ -436,6 +486,8 @@ _CONFIG_KEYS = {
     "incrementalIndexSize": ("incremental_index_size", int),
     "slo": ("slo", str),
     "portfolio": ("portfolio", str),
+    "speculate": ("speculate", str),
+    "speculateMaxBacklog": ("speculate_max_backlog", int),
     "profile": ("profile", str),
     "profileSample": ("profile_sample", float),
     "bcp": ("bcp", str),
@@ -553,6 +605,88 @@ def _cmd_resolve(args) -> int:
         else:
             print(f"{prefix}resolution incomplete: {r['error']}")
     return rc
+
+
+def _cmd_publish(args) -> int:
+    """POST a catalog publish document to a running service — the
+    subscribe-side CLI of the speculative tier (ISSUE 14).  With
+    ``--preview`` the change resolves read-only instead (the what-if
+    endpoint); exit 0 on a 2xx response, 2 on usage/transport errors,
+    1 on any other HTTP status."""
+    from http.client import HTTPConnection, HTTPSConnection
+    from urllib.parse import urlsplit
+
+    try:
+        with open(args.file, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        print(f"error: no such file: {args.file}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: cannot read {args.file}: {e}", file=sys.stderr)
+        return 2
+    except json.JSONDecodeError as e:
+        print(f"error: invalid JSON in {args.file}: {e}", file=sys.stderr)
+        return 2
+    if args.preview and args.limit is not None:
+        if not isinstance(doc, dict):
+            print("error: publish document must be a JSON object",
+                  file=sys.stderr)
+            return 2
+        doc = dict(doc)
+        doc["limit"] = args.limit
+    parts = urlsplit(args.server if "://" in args.server
+                     else f"http://{args.server}")
+    if parts.scheme not in ("http", "https"):
+        print(f"error: unsupported --server scheme {parts.scheme!r} "
+              "(use http:// or https://)", file=sys.stderr)
+        return 2
+    path = "/v1/resolve/preview" if args.preview else "/v1/catalog/publish"
+    conn_cls = HTTPSConnection if parts.scheme == "https" \
+        else HTTPConnection
+    default_port = 443 if parts.scheme == "https" else 8080
+    try:
+        conn = conn_cls(parts.hostname or "127.0.0.1",
+                        parts.port or default_port, timeout=60)
+        conn.request("POST", path, body=json.dumps(doc),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = resp.read()
+        status = resp.status
+        conn.close()
+    except OSError as e:
+        print(f"error: cannot reach {args.server}: {e}", file=sys.stderr)
+        return 2
+    try:
+        payload = json.loads(body)
+    except (ValueError, json.JSONDecodeError):
+        payload = {"raw": body.decode(errors="replace")}
+    if args.output == "json" or status >= 400:
+        json.dump(payload, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0 if status < 300 else (2 if status == 404 else 1)
+    if args.preview:
+        entries = payload.get("preview", [])
+        print(f"preview: {len(entries)} affected famil"
+              f"{'y' if len(entries) == 1 else 'ies'}")
+        for e in entries:
+            r = e.get("result") or {}
+            status_s = r.get("status", e.get("error", "?"))
+            detail = ""
+            if status_s == "sat":
+                sel = r.get("selected") or []
+                detail = f"  selected: {', '.join(sel) or '(nothing)'}"
+            elif status_s == "unsat":
+                detail = f"  conflicts: {', '.join(r.get('conflicts', []))}"
+            print(f"  {e.get('fingerprint', '?')[:12]}  "
+                  f"[{e.get('delta_class') or 'cold'}]  {status_s}{detail}")
+    else:
+        p = payload.get("publish", {})
+        print("published: "
+              + "  ".join(f"{k}={p.get(k)}"
+                          for k in ("changed", "affected", "invalidated",
+                                    "queued", "dropped", "unchanged")))
+    return 0
 
 
 def _cmd_bench(args) -> int:
@@ -1065,6 +1199,8 @@ def _cmd_serve(args) -> int:
         "incremental_index_size": None,
         "slo": None,
         "portfolio": None,
+        "speculate": None,
+        "speculate_max_backlog": None,
         "profile": None,
         "profile_sample": None,
         "bcp": None,
@@ -1089,6 +1225,8 @@ def _cmd_serve(args) -> int:
             ("incremental_index_size", args.incremental_index_size),
             ("slo", args.slo),
             ("portfolio", args.portfolio),
+            ("speculate", args.speculate),
+            ("speculate_max_backlog", args.speculate_max_backlog),
             ("profile", args.profile),
             ("profile_sample", args.profile_sample),
             ("bcp", args.bcp),
@@ -1144,6 +1282,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_bench(args)
     if args.command == "serve":
         return _cmd_serve(args)
+    if args.command == "publish":
+        return _cmd_publish(args)
     if args.command == "stats":
         return _cmd_stats(args)
     if args.command == "trace":

@@ -164,6 +164,21 @@ _DECLARATIONS: List[EnvVar] = [
        "cross-checked against the canonical backend's answer "
        "(mismatches serve canonical and raise a race_mismatch fault "
        "event; 0 disables)."),
+    # --- speculative pre-resolution --------------------------------------
+    _v("DEPPY_TPU_SPECULATE", "str", "on", "deppy_tpu.sched.scheduler",
+       "Speculative pre-resolution: catalog publishes (POST "
+       "/v1/catalog/publish, `deppy publish`) invalidate retracted "
+       "cache entries and pre-solve affected cached families at idle "
+       "priority, and POST /v1/resolve/preview serves read-only "
+       "what-if resolutions ('off' restores pre-change dispatch byte "
+       "for byte and 404s both endpoints; also --speculate).",
+       flag="--speculate", config_key="speculate"),
+    _v("DEPPY_TPU_SPECULATE_MAX_BACKLOG", "int", 2048,
+       "deppy_tpu.sched.scheduler",
+       "Speculative pre-solve backlog cap in lanes; pre-solves past it "
+       "are dropped and counted (a drop costs a later cold solve, "
+       "never an answer; also --speculate-max-backlog).",
+       flag="--speculate-max-backlog", config_key="speculateMaxBacklog"),
     # --- incremental tier ------------------------------------------------
     _v("DEPPY_TPU_INCREMENTAL", "str", "on", "deppy_tpu.sched.scheduler",
        "Delta-aware incremental resolution: clause-set index + "

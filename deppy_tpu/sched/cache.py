@@ -217,6 +217,42 @@ class ResultCache:
             self._account(hit=False)
             return MISS
 
+    def peek(self, key: str, budget: int) -> bool:
+        """True when :meth:`lookup` would hit — WITHOUT the hit/miss
+        accounting or the LRU touch.  The speculation tier (ISSUE 14)
+        consults this before queuing a pre-solve: a probe must not
+        distort the serving hit ratio or refresh recency on behalf of
+        traffic that never arrived."""
+        if self.capacity == 0:
+            return False
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return False
+            if e.definitive:
+                return e.budget <= budget
+            return budget <= e.budget
+
+    def invalidate_keys(self, keys) -> int:
+        """Publish-driven invalidation (ISSUE 14 satellite): evict the
+        entries whose fingerprints a catalog publish retracted or
+        contradicted — they describe pre-publish states that can no
+        longer be re-asked and must not be served stale.  Returns the
+        eviction count; each one lands on the existing
+        ``deppy_cache_invalidations_total`` family."""
+        n = 0
+        with self._lock:
+            for key in keys:
+                e = self._entries.pop(key, None)
+                if e is None:
+                    continue
+                self._bytes -= e.nbytes
+                self._invalidations.inc()
+                n += 1
+            if n:
+                self._size_changed_locked()
+        return n
+
     def lookup_or_plan(self, problem: Problem, key: str, budget: int):
         """Exact lookup, then the delta tier: returns ``(hit, None)`` on
         an exact hit, ``(MISS, WarmPlan)`` when the incremental index

@@ -75,6 +75,13 @@ DEFAULT_INCREMENTAL_MAX_DELTA = 0.25
 # sampled races so its answer exists to compare).
 DEFAULT_PORTFOLIO_K = 2
 DEFAULT_PORTFOLIO_SAMPLE_CHECK = 0.0625
+# Speculative pre-resolution (ISSUE 14): catalog publishes queue
+# pre-solves on a SEPARATE idle-priority queue the dispatch loop drains
+# only while no live group is queued — live traffic preempts at every
+# flush boundary, and the backlog is capped (publishes are bursty and a
+# pre-solve is pure opportunism: dropping one costs a cold solve later,
+# never an answer).
+DEFAULT_SPECULATE_MAX_BACKLOG = 2048
 
 # The "incremental" size class (ISSUE 10): warm-started lanes coalesce
 # with each other — their cost is a handful of host propagation passes,
@@ -157,9 +164,10 @@ class _Group:
     request's queue-wait/dispatch/solve/decode breakdown."""
 
     __slots__ = ("lanes", "enq_t", "size_class", "budget", "event",
-                 "error", "report", "parent", "timing")
+                 "error", "report", "parent", "timing", "speculative")
 
-    def __init__(self, lanes: List[_Lane], size_class: int, budget: int):
+    def __init__(self, lanes: List[_Lane], size_class: int, budget: int,
+                 speculative: bool = False):
         self.lanes = lanes
         self.enq_t = time.monotonic()
         self.size_class = size_class
@@ -169,6 +177,10 @@ class _Group:
         self.report = None
         self.parent = telemetry.trace.capture_parent()
         self.timing: dict = {}
+        # ISSUE 14: a speculative pre-solve group — queued on the idle
+        # queue, no submitter waits on its event, and a dispatch failure
+        # is a sink event rather than a raised request error.
+        self.speculative = speculative
 
 
 def _count_lane_outcome(rep, r) -> None:
@@ -543,6 +555,8 @@ class Scheduler:
         portfolio: Optional[str] = None,
         portfolio_k: Optional[int] = None,
         portfolio_sample_check: Optional[float] = None,
+        speculate: Optional[str] = None,
+        speculate_max_backlog: Optional[int] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -657,6 +671,47 @@ class Scheduler:
         self._thread: Optional[threading.Thread] = None
         # EWMA of dispatch wall clock, seeding the Retry-After estimate.
         self._dispatch_ewma_s = 0.05
+        # Speculative pre-resolution (ISSUE 14).  "off" constructs no
+        # manager, no idle queue consumer, no metric families — the
+        # submit and dispatch paths are byte-identical to the
+        # pre-speculation tree.
+        self._spec_queue: List[_Group] = []
+        self._spec_depth = 0
+        # Fingerprints queued or mid-dispatch on the idle queue (CV-
+        # guarded): a duplicate publish burst arriving before the first
+        # pre-solves have stored must not double-burn the backlog cap
+        # solving the same families twice.
+        self._spec_keys: set = set()
+        if speculate is None:
+            speculate = config.env_raw("DEPPY_TPU_SPECULATE", "on")
+        self.speculate = None
+        self._g_spec_depth = None
+        if str(speculate).strip().lower() not in ("off", "0", "false",
+                                                  "no"):
+            if speculate_max_backlog is None:
+                speculate_max_backlog = _env_int(
+                    "DEPPY_TPU_SPECULATE_MAX_BACKLOG",
+                    DEFAULT_SPECULATE_MAX_BACKLOG)
+            self.spec_max_backlog = max(int(speculate_max_backlog), 0)
+            from ..speculate import SpeculationManager
+
+            self.speculate = SpeculationManager(self,
+                                                registry=self._registry)
+            self._g_spec_depth = reg.gauge(
+                "deppy_speculate_backlog",
+                "Speculative pre-solve lanes queued at idle priority "
+                "right now.")
+            self._g_spec_depth.set(0)
+        # Deferred background engine re-probe (ISSUE 14 satellite): a
+        # breaker-open host drain kicks ONE background probe loop that
+        # upgrades `auto` routing once the accelerator recovers, instead
+        # of waiting for a process restart (the service's startup
+        # pre-warm loop exits once a verdict lands and never watches
+        # the breaker).
+        self._reprobe_stop = threading.Event()
+        self._reprobe_thread: Optional[threading.Thread] = None
+        self._reprobe_s = faults.env_float("DEPPY_TPU_REPROBE", 600.0,
+                                           warn=True) or 0.0
         if self._mesh is not None:
             self._apply_mesh_sizing(self._mesh)
 
@@ -703,6 +758,10 @@ class Scheduler:
 
     def start(self) -> None:
         """Start the dispatch-loop thread (idempotent)."""
+        # Event, not CV state: internally synchronized, touched outside
+        # the lock on purpose (stop() and the re-probe loop read it
+        # lock-free).
+        self._reprobe_stop.clear()
         with self._cv:
             if self._thread is not None and self._thread.is_alive():
                 return
@@ -734,8 +793,11 @@ class Scheduler:
                          name="deppy-sched-prewarm", daemon=True).start()
 
     def stop(self, timeout: float = 10.0) -> None:
-        """Stop the loop; queued groups drain (dispatch) first so no
-        submitter is left hanging.  Submits after stop dispatch inline."""
+        """Stop the loop; queued LIVE groups drain (dispatch) first so
+        no submitter is left hanging — the speculative backlog is
+        discarded instead (nobody waits on a pre-solve).  Submits after
+        stop dispatch inline."""
+        self._reprobe_stop.set()
         with self._cv:
             self._stop = True
             self._cv.notify_all()
@@ -822,6 +884,10 @@ class Scheduler:
         warm_pending: List[tuple] = []
         for i, p in enumerate(problems):
             key = fingerprint(p)
+            if self.speculate is not None:
+                # ISSUE 14: retain the served family so a later catalog
+                # publish can be applied to it and pre-solved.
+                self.speculate.observe(key, problem_vars[i])
             hit, plan = self.cache.lookup_or_plan(p, key, budget)
             if hit is not MISS:
                 results[i] = hit  # bypasses the queue entirely
@@ -907,11 +973,115 @@ class Scheduler:
             stats["deadline_misses"] = deadline_misses
         return results
 
-    def _make_group(self, lanes: List[_Lane], budget: int) -> _Group:
+    def _make_group(self, lanes: List[_Lane], budget: int,
+                    speculative: bool = False) -> _Group:
         from ..engine.driver import _bucket, _cost_proxy
 
         size_class = _bucket(max(_cost_proxy(l.problem) for l in lanes))
-        return _Group(lanes, size_class, budget)
+        return _Group(lanes, size_class, budget, speculative=speculative)
+
+    # ------------------------------------------------ speculation (ISSUE 14)
+
+    def speculative_depth(self) -> int:
+        """Speculative pre-solve lanes queued at idle priority."""
+        with self._cv:
+            return self._spec_depth
+
+    def submit_speculative(
+        self,
+        problem_vars: Sequence[Sequence[Variable]],
+        max_steps: Optional[int] = None,
+    ) -> tuple:
+        """Queue pre-solves at IDLE priority and return immediately with
+        ``(queued, dropped)`` lane counts — fire-and-forget: results
+        land in the result cache and the clause-set index exactly like
+        ordinary solves, and nobody blocks on them.  The dispatch loop
+        drains these groups only while no live group is queued, so live
+        traffic preempts at every flush boundary.  Malformed families,
+        already-cached fingerprints, and within-call duplicates are
+        skipped; lanes past the backlog cap (or arriving while the loop
+        is not running — a pre-solve must never dispatch inline on a
+        publisher's thread) are dropped."""
+        if self.speculate is None:
+            return 0, len(problem_vars)
+        from ..engine.driver import _budget
+
+        if max_steps is None:
+            max_steps = self.max_steps
+        budget = int(_budget(max_steps))
+        dropped = 0
+        seen: set = set()
+        cold: List[_Lane] = []
+        warm: List[_Lane] = []
+        for vs in problem_vars:
+            try:
+                p = encode(vs)
+            except Exception as e:  # noqa: BLE001 — a malformed family
+                # must never abort the rest of a publish burst; it is a
+                # counted drop with a sink event, not a request error
+                # (no requester exists to answer).
+                telemetry.default_registry().event(
+                    "fault", fault="speculate_encode_failed",
+                    error=type(e).__name__)
+                dropped += 1
+                continue
+            if p.errors:
+                dropped += 1
+                continue
+            key = fingerprint(p)
+            if key in seen:
+                continue
+            seen.add(key)
+            if self.cache.peek(key, budget):
+                continue  # the answer is already served from cache
+            plan = (self.incremental.plan(p, key, budget)
+                    if self.incremental is not None else None)
+            lane = _Lane(p, key, max_steps, budget, None, warm=plan,
+                         tenant="speculate")
+            (warm if plan is not None else cold).append(lane)
+            # Retain the POST-publish family under its new fingerprint:
+            # a later publish must compose on this state, not the
+            # superseded one the publish just retired.
+            self.speculate.observe(key, vs)
+        groups: List[_Group] = []
+        # One group per cold family keeps size classes honest (the
+        # spec drain coalesces same-class neighbors like the live
+        # drain); warm lanes coalesce as the incremental class.
+        for lane in cold:
+            groups.append(self._make_group([lane], budget,
+                                           speculative=True))
+        if warm:
+            groups.append(_Group(warm, INCREMENTAL_CLASS, budget,
+                                 speculative=True))
+        queued = 0
+        with self._cv:
+            admit = self.running
+            for g in groups:
+                # Drop lanes whose fingerprint is already queued or
+                # mid-dispatch (a duplicate publish burst): neither
+                # queued nor dropped — the answer is already on its
+                # way.  The cache is re-peeked HERE because a pre-solve
+                # can complete (store + key release) between the
+                # pre-encode peek above and this enqueue; peek is a
+                # leaf lock, safe under the CV.
+                g.lanes = [lane for lane in g.lanes
+                           if lane.key not in self._spec_keys
+                           and not self.cache.peek(lane.key, budget)]
+                if not g.lanes:
+                    continue
+                if (not admit or self._spec_depth + len(g.lanes)
+                        > self.spec_max_backlog):
+                    dropped += len(g.lanes)
+                    continue
+                self._spec_keys.update(lane.key for lane in g.lanes)
+                self._spec_queue.append(g)
+                self._spec_depth += len(g.lanes)
+                queued += len(g.lanes)
+            if self._g_spec_depth is not None:
+                self._g_spec_depth.set(self._spec_depth)
+            if queued:
+                self._cv.notify_all()
+        return queued, dropped
 
     def _enqueue(self, group: _Group) -> None:
         with self._cv:
@@ -938,6 +1108,11 @@ class Scheduler:
                 orphans, self._queue = self._queue, []
                 self._depth = 0
                 self._g_depth.set(0)
+                self._spec_queue = []
+                self._spec_depth = 0
+                self._spec_keys.clear()
+                if self._g_spec_depth is not None:
+                    self._g_spec_depth.set(0)
             for g in orphans:
                 if not g.event.is_set():
                     g.error = RuntimeError(
@@ -946,18 +1121,70 @@ class Scheduler:
 
     def _loop_inner(self) -> None:
         while True:
+            discarded = 0
+            groups: List[_Group] = []
+            reason = None
             with self._cv:
-                while not self._queue and not self._stop:
+                while (not self._queue and not self._spec_queue
+                       and not self._stop):
                     self._cv.wait()
-                if not self._queue:
-                    return  # stopped and drained
-                groups, reason = self._drain_locked(force=self._stop)
-                if not groups:
-                    head_due = self._queue[0].enq_t + self.max_wait_s
-                    delay = head_due - time.monotonic()
-                    self._cv.wait(timeout=max(delay, 0.001))
-                    continue
+                if self._stop and self._spec_queue:
+                    # Shutdown discards the speculative backlog: no
+                    # submitter waits on a pre-solve, and opportunistic
+                    # work must never slow a drain.
+                    discarded = self._spec_depth
+                    self._spec_queue = []
+                    self._spec_depth = 0
+                    self._spec_keys.clear()
+                    if self._g_spec_depth is not None:
+                        self._g_spec_depth.set(0)
+                if self._queue:
+                    groups, reason = self._drain_locked(force=self._stop)
+                    if not groups:
+                        # A live flush is pending but not yet due.  The
+                        # speculative queue is NOT consulted in this
+                        # window: a pre-solve dispatch here could push
+                        # the live flush past max_wait — idle priority
+                        # means idle, not "between live flushes".
+                        head_due = self._queue[0].enq_t + self.max_wait_s
+                        delay = head_due - time.monotonic()
+                        self._cv.wait(timeout=max(delay, 0.001))
+                        continue
+                elif self._spec_queue:
+                    # ISSUE 14: live lanes are empty — drain ONE
+                    # speculative flush.  Live submits arriving during
+                    # the dispatch preempt at the next loop iteration
+                    # (the flush boundary).
+                    groups, reason = self._drain_spec_locked()
+            if discarded and self.speculate is not None:
+                self.speculate.note_discarded(discarded)
+            if not groups:
+                return  # stopped and drained
             self._dispatch(groups, reason)
+
+    def _drain_spec_locked(self):
+        """Pick one speculative flush (caller holds the lock): the
+        oldest speculative group plus its same-class, same-budget
+        neighbors up to ``max_fill`` lanes — the live drain's coalescing
+        rule applied to the idle queue."""
+        head = self._spec_queue[0]
+        take = [head]
+        lanes = len(head.lanes)
+        for g in self._spec_queue[1:]:
+            if lanes >= self.max_fill:
+                break
+            if (g.size_class == head.size_class
+                    and g.budget == head.budget
+                    and lanes + len(g.lanes) <= self.max_fill):
+                take.append(g)
+                lanes += len(g.lanes)
+        taken = set(map(id, take))
+        self._spec_queue = [g for g in self._spec_queue
+                            if id(g) not in taken]
+        self._spec_depth -= lanes
+        if self._g_spec_depth is not None:
+            self._g_spec_depth.set(self._spec_depth)
+        return take, "spec"
 
     def _drain_locked(self, force: bool = False):
         """Pick the flushable group set (caller holds the lock): the
@@ -1025,6 +1252,16 @@ class Scheduler:
         except BaseException as e:  # noqa: BLE001 — re-raised per request
             for g in groups:
                 g.error = e
+            if any(g.speculative for g in groups):
+                # No submitter exists to re-raise a speculative group's
+                # error into (ISSUE 14) — surface it on the sink: a
+                # publish burst silently failing to pre-solve would
+                # read as "speculation working, cache cold".
+                telemetry.default_registry().event(
+                    "fault", fault="speculate_dispatch_failed",
+                    error=type(e).__name__,
+                    lanes=sum(len(g.lanes) for g in groups
+                              if g.speculative))
         finally:
             dur = time.monotonic() - t0
             # Read-modify-write under the CV: admission_retry_after
@@ -1035,6 +1272,13 @@ class Scheduler:
             with self._cv:
                 self._dispatch_ewma_s = (0.8 * self._dispatch_ewma_s
                                          + 0.2 * dur)
+                for g in groups:
+                    if g.speculative:
+                        # The pre-solve is stored (or failed) — later
+                        # duplicates dedupe through the cache peek, not
+                        # the in-flight key set.
+                        self._spec_keys.difference_update(
+                            lane.key for lane in g.lanes)
             timing["dispatch_s"] = dur
             for g in groups:
                 g.timing.update(timing)
@@ -1102,6 +1346,13 @@ class Scheduler:
         if all(d is not None for d in deadlines):
             scope = max(deadlines, key=lambda d: d.remaining())
         backend = resolve_backend(self.backend, block=False)
+        if (self.backend == "auto" and backend == "host"
+                and faults.default_breaker().blocks_device()):
+            # ISSUE 14 satellite: this flush is a breaker-open host
+            # drain — kick the deferred background re-probe so auto
+            # routing upgrades once the accelerator recovers, instead
+            # of waiting for a restart.
+            self._kick_reprobe()
         rep, owns = telemetry.begin_report(backend=backend,
                                            n_problems=len(live))
         try:
@@ -1323,6 +1574,72 @@ class Scheduler:
                 raise box["error"]
 
         return keep, finisher
+
+    # ------------------------------------------- deferred re-probe (ISSUE 14)
+
+    def _kick_reprobe(self) -> None:
+        """Start the background re-probe loop (once) after a
+        breaker-open host drain.  The loop waits out the breaker
+        cooldown, then runs the killable subprocess engine probe OFF
+        the serving path — a success resets the breaker and replaces
+        the ``auto`` verdict (``sat.solver.reprobe_engine``), so
+        routing upgrades without risking a live dispatch on the
+        half-open probe; a failure retries on the
+        ``DEPPY_TPU_REPROBE`` interval while the breaker stays open."""
+        if self._reprobe_s <= 0:
+            return
+        with self._cv:
+            t = self._reprobe_thread
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(target=self._reprobe_loop,
+                                 name="deppy-sched-reprobe", daemon=True)
+            self._reprobe_thread = t
+        t.start()
+
+    def _reprobe_loop(self) -> None:
+        from ..sat import solver as sat_solver
+
+        c_reprobes = self._registry.counter(
+            "deppy_sched_reprobes_total",
+            "Deferred background engine re-probes after a breaker-open "
+            "host drain, by result.", labelname="result")
+        # First wake lands right after the cooldown elapses (probing a
+        # still-open breaker earlier would burn the probe timeout
+        # re-learning the failure that opened it — whatever the
+        # configured interval); FAILED probes retry on the full
+        # DEPPY_TPU_REPROBE interval — remaining_s() is 0 once the
+        # cooldown lapses, and a 75s subprocess probe must not hot-loop
+        # against a dead accelerator.
+        delay = max(faults.default_breaker().remaining_s(), 1.0)
+        while True:
+            if self._reprobe_stop.wait(delay):
+                return
+            state = faults.default_breaker().state()
+            if state == "closed":
+                # Recovered through the normal dispatch path while we
+                # slept — nothing left to upgrade.  A HALF-OPEN breaker
+                # is exactly what this loop exists for: probe it off
+                # the serving path so no live request pays the
+                # half-open dispatch gamble.
+                return
+            if state == "open":
+                # Re-opened (or still cooling) while we slept: wait out
+                # the (new) cooldown instead of probing a breaker that
+                # already knows the answer.
+                delay = max(faults.default_breaker().remaining_s(), 1.0)
+                continue
+            try:
+                ok = sat_solver.reprobe_engine()
+            # deppy: lint-ok[exception-hygiene] probe failure = not recovered; retried next tick
+            except Exception:
+                ok = False
+            c_reprobes.inc(label="upgraded" if ok else "failed")
+            if ok:
+                telemetry.default_registry().event(
+                    "fault", fault="sched_reprobe_upgraded")
+                return
+            delay = max(self._reprobe_s, 1.0)
 
     def _solve_host(self, live: List[_Lane], rep) -> None:
         """Host-engine drain — the breaker's host-only mode and the

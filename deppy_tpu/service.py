@@ -248,6 +248,8 @@ class Server:
         incremental_index_size: Optional[int] = None,
         slo: Optional[str] = None,
         portfolio: Optional[str] = None,
+        speculate: Optional[str] = None,
+        speculate_max_backlog: Optional[int] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -290,7 +292,9 @@ class Server:
                 incremental=incremental,
                 incremental_max_delta=incremental_max_delta,
                 incremental_index_size=incremental_index_size,
-                portfolio=portfolio)
+                portfolio=portfolio,
+                speculate=speculate,
+                speculate_max_backlog=speculate_max_backlog)
         # Fault-domain knobs (ISSUE 2).  request_deadline_s: default
         # wall-clock budget per /v1/resolve (clients override per request
         # via the X-Deppy-Deadline-S header; None = unbounded).  drain_s
@@ -701,14 +705,133 @@ def _api_handler(server: Server):
                     "application/json")
 
         def do_POST(self):
-            if self.path != "/v1/resolve":
-                self._send_json(404, {"error": "not found"})
+            if self.path == "/v1/resolve":
+                server._enter_request()
+                try:
+                    self._resolve_request()
+                finally:
+                    server._exit_request()
                 return
-            server._enter_request()
+            if self.path in ("/v1/catalog/publish", "/v1/resolve/preview"):
+                # Speculative pre-resolution (ISSUE 14): the publish
+                # watch endpoint and the read-only what-if preview.
+                # With the tier off these paths 404 exactly like any
+                # unknown path — pre-change behavior byte for byte.
+                sched = server.scheduler
+                spec = sched.speculate if sched is not None else None
+                if spec is None:
+                    self._send_json(404, {"error": "not found"})
+                    return
+                server._enter_request()
+                try:
+                    if self.path == "/v1/catalog/publish":
+                        self._publish_request(spec)
+                    else:
+                        self._preview_request(spec)
+                finally:
+                    server._exit_request()
+                return
+            self._send_json(404, {"error": "not found"})
+
+        def _read_json_body(self):
+            """``(doc, None)`` — the length-checked parsed JSON body —
+            or ``(None, status)`` after the error response has been
+            sent.  The /v1/resolve validation rules, shared so the
+            publish/preview endpoints cannot drift (a parsed ``null``
+            body is a valid doc, hence the explicit error channel)."""
             try:
-                self._resolve_request()
-            finally:
-                server._exit_request()
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                server.metrics.observe_error()
+                return None, self._send_json(
+                    400, {"error": "invalid Content-Length"})
+            if length < 0:
+                server.metrics.observe_error()
+                return None, self._send_json(
+                    400, {"error": "invalid Content-Length"})
+            if length > server.max_body_bytes:
+                server.metrics.observe_error()
+                return None, self._send_json(
+                    413,
+                    {"error": f"body exceeds {server.max_body_bytes} bytes"},
+                )
+            try:
+                return json.loads(self.rfile.read(length) or b"null"), None
+            except (ValueError, json.JSONDecodeError) as e:
+                server.metrics.observe_error()
+                return None, self._send_json(
+                    400, {"error": f"invalid JSON body: {e}"})
+
+        def _parse_delta(self, doc):
+            from .speculate import PublishDelta, PublishFormatError
+
+            try:
+                return PublishDelta.from_doc(doc)
+            except PublishFormatError as e:
+                server.metrics.observe_error()
+                self._send_json(400, {"error": str(e)})
+                return None
+
+        def _publish_request(self, spec):
+            """POST /v1/catalog/publish — subscribe-side entry of the
+            speculative tier: invalidates retracted cache entries and
+            queues idle-priority pre-solves for every affected retained
+            family.  Returns the enumeration/queueing accounting; the
+            pre-solves themselves run in the background."""
+            doc, err = self._read_json_body()
+            if err is not None:
+                return
+            delta = self._parse_delta(doc)
+            if delta is None:
+                return
+            try:
+                out = spec.publish(delta, max_steps=server.max_steps)
+            except Exception as e:  # same contract as /v1/resolve: a
+                # runtime failure is a visible 500, not a dropped
+                # connection.
+                server.metrics.observe_error()
+                self._send_json(500, {"error": f"internal error: {e}"})
+                return
+            self._send_json(200, {"publish": out})
+
+        def _preview_request(self, spec):
+            """POST /v1/resolve/preview — the what-if tier: resolve a
+            PROPOSED catalog change against the live index without
+            serving or caching it.  Body is a publish document plus an
+            optional ``limit`` (affected families previewed, most
+            recently served first)."""
+            doc, err = self._read_json_body()
+            if err is not None:
+                return
+            limit = None
+            if isinstance(doc, dict) and "limit" in doc:
+                if not isinstance(doc["limit"], int) \
+                        or isinstance(doc["limit"], bool) \
+                        or doc["limit"] < 0:
+                    server.metrics.observe_error()
+                    self._send_json(
+                        400, {"error": '"limit" must be a non-negative '
+                              'integer'})
+                    return
+                limit = doc["limit"]
+            delta = self._parse_delta(doc)
+            if delta is None:
+                return
+            try:
+                entries = spec.preview(delta, max_steps=server.max_steps,
+                                       limit=limit)
+            except Exception as e:
+                server.metrics.observe_error()
+                self._send_json(500, {"error": f"internal error: {e}"})
+                return
+            rendered = []
+            for entry in entries:
+                out = dict(entry)
+                if "result" in out:
+                    out["result"] = problem_io.result_to_dict(
+                        out["result"])
+                rendered.append(out)
+            self._send_json(200, {"preview": rendered})
 
         def _resolve_request(self):
             # Per-request trace context (ISSUE 4): honor an inbound W3C
@@ -794,30 +917,12 @@ def _api_handler(server: Server):
                     server.metrics.observe_error()
                     return self._send_json(
                         400, {"error": "invalid X-Deppy-Deadline-S header"})
-            try:
-                length = int(self.headers.get("Content-Length", 0))
-            except ValueError:
-                server.metrics.observe_error()
-                return self._send_json(400,
-                                       {"error": "invalid Content-Length"})
-            if length < 0:
-                server.metrics.observe_error()
-                return self._send_json(400,
-                                       {"error": "invalid Content-Length"})
-            if length > server.max_body_bytes:
-                # A client-controlled Content-Length must not be able to
-                # buffer unbounded memory on the service.
-                server.metrics.observe_error()
-                return self._send_json(
-                    413,
-                    {"error": f"body exceeds {server.max_body_bytes} bytes"},
-                )
-            try:
-                doc = json.loads(self.rfile.read(length) or b"null")
-            except (ValueError, json.JSONDecodeError) as e:
-                server.metrics.observe_error()
-                return self._send_json(400,
-                                       {"error": f"invalid JSON body: {e}"})
+            # A client-controlled Content-Length must not be able to
+            # buffer unbounded memory on the service (enforced inside
+            # the shared body reader).
+            doc, err = self._read_json_body()
+            if err is not None:
+                return err
             try:
                 status, resp = server.resolve_document(
                     doc, deadline_s=deadline_s, timings=timings,
@@ -882,6 +987,8 @@ def serve(
     incremental_index_size: Optional[int] = None,
     slo: Optional[str] = None,
     portfolio: Optional[str] = None,
+    speculate: Optional[str] = None,
+    speculate_max_backlog: Optional[int] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -898,7 +1005,8 @@ def serve(
                  mesh_devices=mesh_devices, incremental=incremental,
                  incremental_max_delta=incremental_max_delta,
                  incremental_index_size=incremental_index_size,
-                 slo=slo, portfolio=portfolio)
+                 slo=slo, portfolio=portfolio, speculate=speculate,
+                 speculate_max_backlog=speculate_max_backlog)
     srv.start()
     stop = threading.Event()
 

@@ -1,0 +1,36 @@
+"""Speculative pre-resolution (ISSUE 14).
+
+Production churn is push-shaped: one catalog publish fans out to
+thousands of dependent clients who all re-ask within minutes, and today
+the first asker per clause-set family eats the cold solve while the
+device sits mostly idle.  This subsystem converts that slack into
+pre-solved answers:
+
+  * :mod:`.manager` — :class:`PublishDelta` (the parsed
+    ``POST /v1/catalog/publish`` / ``deppy publish`` body: absolute
+    per-bundle constraint updates and withdrawals) and
+    :class:`SpeculationManager`, which retains recently served problem
+    families, enumerates the cached fingerprints a publish touches via
+    the :class:`deppy_tpu.incremental.ClauseSetIndex` per-row keys,
+    applies the delta to each retained family, and pre-solves the
+    results through the scheduler's **idle-priority speculative class**
+    — drained only when no live lane is queued, preempted by live
+    traffic at every flush boundary.  Results land in the exact result
+    cache and delta index like ordinary solves, so under sustained
+    publish+query load the churn p99 becomes pure cache lookup.
+  * The same machinery exposed read-only is the **what-if tier**
+    (``POST /v1/resolve/preview``): resolve a *proposed* catalog change
+    against the live index without serving or caching it —
+    upgrade-impact preview as an API.
+
+``DEPPY_TPU_SPECULATE=off`` constructs none of this: the scheduler's
+submit and dispatch paths are byte-identical to the pre-speculation
+tree, and the publish/preview endpoints 404 like any unknown path.
+See docs/serving.md (Speculative pre-resolution).
+"""
+
+from .manager import (  # noqa: F401
+    PublishDelta,
+    PublishFormatError,
+    SpeculationManager,
+)

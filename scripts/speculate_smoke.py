@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Speculative pre-resolution smoke test (`make speculate-smoke`, ISSUE 14).
+
+Boots TWO batch-resolution services on ephemeral ports — one with the
+speculative tier (the default), one with ``speculate="off"`` — and
+drives a publish burst against the live one:
+
+  * **warm-hit ratio** — after a catalog publish and a bounded drain
+    window, every dependent family's re-ask is served from the exact
+    cache (``deppy_cache_hits_total`` moves, no new scheduler dispatch
+    per re-ask; asserted ratio >= 0.9);
+  * **live-lane latency under load** — a live query issued while the
+    speculative backlog is still draining completes promptly (idle
+    priority: live lanes preempt at flush boundaries);
+  * **publish invalidation** — the pre-publish fingerprints leave the
+    exact cache, counted on ``deppy_cache_invalidations_total``;
+  * **preview is read-only** — ``POST /v1/resolve/preview`` answers the
+    proposed change without growing the cache;
+  * **off byte-identity** — the speculate-off service 404s both
+    endpoints and serves every post-publish query byte-identically to
+    the speculating one.
+
+Fast on purpose: host backend, no device compile — the full subsystem
+suite is ``make test-speculate`` (tests/test_speculate.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from http.client import HTTPConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_FAMILIES = 6
+N_BUNDLES = 4
+BSIZE = 7
+
+
+def request(port: int, method: str, path: str, body=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    headers = {"Content-Type": "application/json"} if body is not None else {}
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def metric(text: str, name: str):
+    total = None
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            total = (total or 0.0) + float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def scrape(port: int) -> str:
+    _, data = request(port, "GET", "/metrics")
+    return data.decode()
+
+
+def family_doc(family: int, published: dict) -> dict:
+    """One client family's catalog as a /v1/resolve document.  Families
+    share the vocabulary and differ in bundle-0 preference order;
+    ``published`` maps variable id -> its current published constraint
+    list (the client tracks catalog publishes)."""
+    variables = []
+    for b in range(N_BUNDLES):
+        for j in range(BSIZE):
+            vid = f"b{b}v{j}"
+            if vid in published:
+                cons = published[vid]
+            else:
+                cons = []
+                if j == 0:
+                    cons.append({"type": "mandatory"})
+                    cons.append({"type": "dependency", "ids": [f"b{b}v1"]})
+                elif j == 1 and b == 0:
+                    # Six distinct preference orders (3 rotations x 2
+                    # directions) — order is fingerprint-relevant, so
+                    # every family is a distinct cached state.
+                    pair = [f"b{b}v{2 + family % 3}",
+                            f"b{b}v{2 + (family + 1) % 3}"]
+                    if family >= 3:
+                        pair.reverse()
+                    cons.append({"type": "dependency", "ids": pair})
+                elif j < BSIZE - 2:
+                    cons.append({"type": "dependency",
+                                 "ids": [f"b{b}v{j + 1}",
+                                         f"b{b}v{min(j + 2, BSIZE - 1)}"]})
+            variables.append({"id": vid, "constraints": cons})
+    return {"variables": variables}
+
+
+def drain(port: int, timeout_s: float = 30.0) -> None:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if (metric(scrape(port), "deppy_speculate_backlog") or 0.0) == 0.0:
+            break
+        time.sleep(0.02)
+    time.sleep(0.3)  # the last dequeued flush may still be solving
+
+
+def main() -> int:
+    from deppy_tpu.service import Server
+
+    on = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                backend="host")
+    on.start()
+    off = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host", speculate="off")
+    off.start()
+    try:
+        published: dict = {}
+        for f in range(N_FAMILIES):
+            doc = family_doc(f, published)
+            for srv in (on, off):
+                status, _ = request(srv.api_port, "POST", "/v1/resolve",
+                                    doc)
+                assert status == 200, status
+
+        # ---- the publish burst ----------------------------------------
+        update = {"id": "b1v2",
+                  "constraints": [{"type": "dependency",
+                                   "ids": [f"b1v4", f"b1v5"]}]}
+        pre = scrape(on.api_port)
+        status, body = request(on.api_port, "POST", "/v1/catalog/publish",
+                               {"updates": [update]})
+        assert status == 200, (status, body)
+        acct = json.loads(body)["publish"]
+        assert acct["affected"] >= N_FAMILIES, acct
+        assert acct["invalidated"] >= N_FAMILIES, acct
+        assert acct["queued"] >= 1, acct
+        inv = (metric(scrape(on.api_port),
+                      "deppy_cache_invalidations_total") or 0) \
+            - (metric(pre, "deppy_cache_invalidations_total") or 0)
+        assert inv >= N_FAMILIES, \
+            f"publish must count evictions on the invalidation family ({inv})"
+
+        # Live lane under speculative load: issued immediately, before
+        # the backlog drains — must be served promptly (idle priority).
+        t0 = time.perf_counter()
+        status, _ = request(on.api_port, "POST", "/v1/resolve",
+                            family_doc(0, published))
+        live_s = time.perf_counter() - t0
+        assert status == 200
+        assert live_s < 5.0, f"live lane delayed {live_s:.3f}s under load"
+
+        drain(on.api_port)
+        published[update["id"]] = update["constraints"]
+
+        # ---- post-publish re-asks: warm/speculative hits ---------------
+        text0 = scrape(on.api_port)
+        hits0 = metric(text0, "deppy_cache_hits_total") or 0
+        disp0 = metric(text0, "deppy_sched_dispatches_total") or 0
+        for f in range(N_FAMILIES):
+            doc = family_doc(f, published)
+            s_on, b_on = request(on.api_port, "POST", "/v1/resolve", doc)
+            s_off, b_off = request(off.api_port, "POST", "/v1/resolve",
+                                   doc)
+            assert s_on == s_off == 200, (f, s_on, s_off)
+            assert b_on == b_off, (
+                f"family {f}: speculating response diverges from "
+                f"speculate-off\non:  {b_on!r}\noff: {b_off!r}")
+        text1 = scrape(on.api_port)
+        hits = (metric(text1, "deppy_cache_hits_total") or 0) - hits0
+        dispatches = (metric(text1, "deppy_sched_dispatches_total") or 0) \
+            - disp0
+        ratio = hits / N_FAMILIES
+        assert ratio >= 0.9, \
+            f"warm/speculative hit ratio {ratio} < 0.9 " \
+            f"({hits}/{N_FAMILIES} re-asks hit, {dispatches} dispatches)"
+        presolves = metric(text1, "deppy_speculate_presolves_total")
+        assert presolves and presolves >= 1, presolves
+
+        # ---- preview: read-only what-if --------------------------------
+        entries_before = metric(text1, "deppy_cache_entries")
+        status, body = request(
+            on.api_port, "POST", "/v1/resolve/preview",
+            {"updates": [{"id": "b2v2",
+                          "constraints": [{"type": "dependency",
+                                           "ids": ["b2v5", "b2v6"]}]}],
+             "limit": 3})
+        assert status == 200, (status, body)
+        preview = json.loads(body)["preview"]
+        assert preview and all(
+            e["result"]["status"] in ("sat", "unsat", "incomplete")
+            for e in preview), preview
+        entries_after = metric(scrape(on.api_port), "deppy_cache_entries")
+        assert entries_after == entries_before, \
+            f"preview grew the cache ({entries_before} -> {entries_after})"
+
+        # ---- speculate-off surface -------------------------------------
+        for path in ("/v1/catalog/publish", "/v1/resolve/preview"):
+            status, body = request(off.api_port, "POST", path,
+                                   {"updates": [update]})
+            assert status == 404, (path, status, body)
+        assert metric(scrape(off.api_port),
+                      "deppy_speculate_presolves_total") is None, \
+            "speculate-off service must register no speculate families"
+
+        print(f"speculate smoke OK: publish affected={acct['affected']} "
+              f"queued={acct['queued']} invalidated={acct['invalidated']}; "
+              f"re-ask hit ratio {ratio:.2f}; live lane {live_s * 1e3:.1f}ms "
+              f"under backlog; preview read-only; off 404 + byte-identical")
+        return 0
+    finally:
+        on.shutdown()
+        off.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,7 @@ CODE_SCOPE = [
     REPO / "deppy_tpu" / "hostpool",
     REPO / "deppy_tpu" / "parallel",
     REPO / "deppy_tpu" / "incremental",
+    REPO / "deppy_tpu" / "speculate",
     REPO / "deppy_tpu" / "profile",
     REPO / "deppy_tpu" / "service.py",
     REPO / "deppy_tpu" / "engine" / "driver.py",

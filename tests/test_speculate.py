@@ -1,0 +1,610 @@
+"""Speculative pre-resolution (ISSUE 14).
+
+The acceptance surface, from the issue:
+
+  * a catalog publish enumerates the affected cached fingerprints via
+    the clause-set index's per-row keys, evicts retracted exact-cache
+    entries (counted on the existing invalidation family), and
+    pre-solves the deltas at idle priority — the post-publish re-ask is
+    a pure cache hit, byte-identical to a cold solve;
+  * a sustained speculative backlog never delays a live lane past one
+    flush interval (live traffic preempts at flush boundaries);
+  * ``DEPPY_TPU_SPECULATE=off`` restores pre-change dispatch byte for
+    byte and 404s the publish/preview endpoints;
+  * ``POST /v1/resolve/preview`` resolves a PROPOSED change against the
+    live index without serving or caching it;
+  * the deferred background engine re-probe upgrades ``auto`` routing
+    after a breaker-open host drain without waiting for a restart.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.client import HTTPConnection
+
+import numpy as np
+import pytest
+
+from deppy_tpu import faults, telemetry
+from deppy_tpu import io as problem_io
+from deppy_tpu.incremental import ClauseSetIndex
+from deppy_tpu.sat.constraints import Prohibited
+from deppy_tpu.sat.encode import encode
+from deppy_tpu.sched import Scheduler, fingerprint
+from deppy_tpu.service import Server
+from deppy_tpu.speculate import PublishDelta, PublishFormatError
+
+pytestmark = pytest.mark.speculate
+
+
+@pytest.fixture(autouse=True)
+def fresh_fault_state():
+    """Isolate the process-global breaker, fault plan, and telemetry
+    registry per test (the sched suite's contract)."""
+    prev_breaker = faults.set_default_breaker(faults.CircuitBreaker())
+    prev_plan = faults.configure_plan(None)
+    prev_reg = telemetry.set_default_registry(telemetry.Registry())
+    yield
+    telemetry.set_default_registry(prev_reg)
+    faults.configure_plan(prev_plan)
+    faults.set_default_breaker(prev_breaker)
+
+
+def _catalog(prefix: str, state: int = 0, bundles: int = 3,
+             size: int = 5) -> list:
+    """A bundle-catalog family; ``state`` rotates bundle 1's mid-chain
+    dependency so consecutive states are one-row deltas."""
+    doc = []
+    for b in range(bundles):
+        for j in range(size):
+            cons = []
+            if j == 0:
+                cons.append({"type": "mandatory"})
+                cons.append({"type": "dependency",
+                             "ids": [f"{prefix}b{b}v1"]})
+            elif j < size - 2:
+                tgt = j + 1
+                if b == 1 and j == 1:
+                    tgt = min(j + 1 + state, size - 1)
+                cons.append({"type": "dependency",
+                             "ids": [f"{prefix}b{b}v{tgt}",
+                                     f"{prefix}b{b}v{min(j + 2, size - 1)}"]})
+            doc.append({"id": f"{prefix}b{b}v{j}", "constraints": cons})
+    return problem_io.problems_from_document({"variables": doc})[0]
+
+
+def _delta(prefix: str, state: int, size: int = 5) -> PublishDelta:
+    """The publish that moves ``_catalog`` from any state to
+    ``state`` (absolute replacement of bundle 1's v1 row)."""
+    tgt = min(2 + state, size - 1)
+    return PublishDelta.from_doc({"updates": [{
+        "id": f"{prefix}b1v1",
+        "constraints": [{"type": "dependency",
+                         "ids": [f"{prefix}b1v{tgt}",
+                                 f"{prefix}b1v{min(3, size - 1)}"]}]}]})
+
+
+def _drain(sched, timeout=20.0):
+    t0 = time.monotonic()
+    while sched.speculative_depth() and time.monotonic() - t0 < timeout:
+        time.sleep(0.005)
+    time.sleep(0.1)  # the last dequeued flush may still be solving
+    assert sched.speculative_depth() == 0
+
+
+# -------------------------------------------------- tentpole: pre-resolution
+
+
+class TestSpeculativePreResolution:
+    def test_publish_presolves_and_reask_is_pure_cache_hit(self):
+        sched = Scheduler(backend="host")
+        sched.start()
+        try:
+            base = _catalog("t1.")
+            sched.submit([base])
+            delta = _delta("t1.", 1)
+            out = sched.speculate.publish(delta)
+            assert out["affected"] >= 1 and out["queued"] >= 1
+            _drain(sched)
+            new_vars = delta.apply(base)
+            assert new_vars is not None
+            dispatches_before = sched._c_dispatches.value
+            stats: dict = {}
+            (res,) = sched.submit([new_vars], stats=stats)
+            # Pure cache lookup: zero engine steps, no new dispatch.
+            assert stats["steps"] == 0 and stats["report"] is None
+            assert sched._c_dispatches.value == dispatches_before
+            # Byte-identical to a fresh cold solve of the same problem.
+            cold = Scheduler(backend="host", cache_size=0,
+                             incremental="off", speculate="off")
+            (ref,) = cold.submit([new_vars])
+            assert problem_io.result_to_dict(res) \
+                == problem_io.result_to_dict(ref)
+        finally:
+            sched.stop()
+
+    def test_publish_invalidates_retracted_exact_entries(self):
+        sched = Scheduler(backend="host")
+        sched.start()
+        try:
+            base = _catalog("t2.")
+            sched.submit([base])
+            old_key = fingerprint(encode(base))
+            budget = 1 << 24
+            assert sched.cache.peek(old_key, budget)
+            inv_before = sched.cache._invalidations.value
+            out = sched.speculate.publish(_delta("t2.", 1))
+            assert out["invalidated"] >= 1
+            assert not sched.cache.peek(old_key, budget), \
+                "retracted entry must not be served stale"
+            assert sched.cache._invalidations.value \
+                == inv_before + out["invalidated"]
+        finally:
+            sched.stop()
+
+    def test_idempotent_republish_keeps_hot_entries(self):
+        """An at-least-once publish bus re-delivers: re-applying the
+        SAME publish must not evict the post-publish entries it
+        previously pre-solved (only states the delta actually changes
+        are stale)."""
+        sched = Scheduler(backend="host")
+        sched.start()
+        try:
+            base = _catalog("t16.")
+            sched.submit([base])
+            delta = _delta("t16.", 1)
+            sched.speculate.publish(delta)
+            _drain(sched)
+            new_vars = delta.apply(base)
+            sched.submit([new_vars])  # re-ask: retains post-publish state
+            new_key = fingerprint(encode(new_vars))
+            budget = 1 << 24
+            assert sched.cache.peek(new_key, budget)
+            out = sched.speculate.publish(delta)  # duplicate delivery
+            assert sched.cache.peek(new_key, budget), \
+                "re-publish evicted the still-valid post-publish entry"
+            assert out["unchanged"] >= 1
+            _drain(sched)
+            stats: dict = {}
+            sched.submit([new_vars], stats=stats)
+            assert stats["steps"] == 0, "re-ask after re-publish re-solved"
+        finally:
+            sched.stop()
+
+    def test_duplicate_publish_burst_dedupes_against_backlog(self):
+        """Queued/in-flight pre-solves dedupe a duplicate burst: the
+        second submission of the same fingerprints queues nothing and
+        drops nothing (the answers are already on their way)."""
+        sched = Scheduler(backend="host", max_fill=1)
+        sched.start()
+        try:
+            jobs = [_catalog(f"t17x{k}.", bundles=4, size=7)
+                    for k in range(8)]
+            q1, d1 = sched.submit_speculative(jobs)
+            assert q1 == len(jobs) and d1 == 0
+            q2, d2 = sched.submit_speculative(jobs)
+            assert (q2, d2) == (0, 0), \
+                "duplicate burst double-burned the backlog"
+            _drain(sched, timeout=60.0)
+        finally:
+            sched.stop()
+
+    def test_back_to_back_publishes_compose(self):
+        """Two publishes touching different bundles with NO client
+        re-ask between them: the second must apply on top of the
+        first's post-publish state (the retained store retires
+        superseded states and retains queued pre-solves), so the
+        client's doubly-updated re-ask is still a pure hit."""
+        sched = Scheduler(backend="host")
+        sched.start()
+        try:
+            base = _catalog("t19.", bundles=3)
+            sched.submit([base])
+            d1 = _delta("t19.", 1)
+            d2 = PublishDelta.from_doc({"updates": [{
+                "id": "t19.b2v1",
+                "constraints": [{"type": "dependency",
+                                 "ids": ["t19.b2v3", "t19.b2v2"]}]}]})
+            sched.speculate.publish(d1)
+            _drain(sched)
+            sched.speculate.publish(d2)
+            _drain(sched)
+            final = d2.apply(d1.apply(base))
+            assert final is not None
+            stats: dict = {}
+            (res,) = sched.submit([list(final)], stats=stats)
+            assert isinstance(res, dict)
+            assert stats["steps"] == 0, \
+                "second publish did not compose on the first's state"
+        finally:
+            sched.stop()
+
+    def test_removed_bundle_applies_as_prohibited(self):
+        base = _catalog("t3.")
+        delta = PublishDelta.from_doc({"removed": ["t3.b2v4"]})
+        applied = delta.apply(base)
+        assert applied is not None
+        (changed,) = [v for v in applied if v.identifier == "t3.b2v4"]
+        assert changed.constraints == (Prohibited(),)
+        # Unmentioned families are untouched.
+        assert PublishDelta.from_doc(
+            {"removed": ["nope"]}).apply(base) is None
+
+    def test_publish_rejects_malformed_documents(self):
+        for doc in (None, [], {"updates": "x"},
+                    {"updates": [{"id": 3}]},
+                    {"updates": [], "removed": []},
+                    {"updates": [{"id": "a",
+                                  "constraints": [{"type": "wat"}]}]}):
+            with pytest.raises(PublishFormatError):
+                PublishDelta.from_doc(doc)
+
+    def test_backlog_cap_drops_and_counts(self):
+        sched = Scheduler(backend="host", speculate_max_backlog=2)
+        sched.start()
+        try:
+            mgr = sched.speculate
+            jobs = [_catalog(f"t4{k}.") for k in range(4)]
+            queued, dropped = sched.submit_speculative(jobs)
+            assert queued <= 2 and queued + dropped == len(jobs)
+            assert dropped >= 2
+        finally:
+            sched.stop()
+        assert mgr is not None
+
+
+# ------------------------------------------------ idle class / preemption
+
+
+class TestIdlePriority:
+    def test_live_lane_preempts_sustained_speculative_backlog(self):
+        """A live submit completes within ~one flush interval while a
+        speculative backlog is still queued — the backlog never
+        starves live traffic, and live traffic never drains behind
+        the whole backlog."""
+        sched = Scheduler(backend="host", max_fill=2, max_wait_ms=1.0)
+        sched.start()
+        try:
+            # A backlog of distinct cold families, flushed 2 lanes at a
+            # time (max_fill) so preemption boundaries are frequent.
+            jobs = [_catalog("t5.", state=s, bundles=4, size=7)
+                    for s in range(1, 4)] + \
+                   [_catalog(f"t5x{k}.", bundles=4, size=7)
+                    for k in range(12)]
+            queued, _ = sched.submit_speculative(jobs)
+            assert queued == len(jobs)
+            t0 = time.perf_counter()
+            (res,) = sched.submit([_catalog("t5live.")])
+            live_s = time.perf_counter() - t0
+            remaining = sched.speculative_depth()
+            assert isinstance(res, dict)
+            # The backlog must NOT have fully drained ahead of the live
+            # lane (idle priority would be meaningless otherwise)...
+            assert remaining > 0, \
+                "speculative backlog drained before the live lane ran"
+            # ...and the live lane waited at most ~one speculative
+            # flush, not the whole backlog (generous wall-clock bound:
+            # the backlog is >10 flushes of real solves).
+            assert live_s < 5.0
+            _drain(sched, timeout=60.0)
+        finally:
+            sched.stop()
+
+    def test_spec_flush_reason_counted(self):
+        sched = Scheduler(backend="host")
+        sched.start()
+        try:
+            sched.submit_speculative([_catalog("t6.")])
+            _drain(sched)
+            assert sched._c_flushes.value.get("spec", 0) >= 1
+        finally:
+            sched.stop()
+
+    def test_shutdown_discards_backlog_without_blocking(self):
+        sched = Scheduler(backend="host", max_fill=1)
+        sched.start()
+        jobs = [_catalog(f"t7x{k}.", bundles=4, size=7)
+                for k in range(10)]
+        sched.submit_speculative(jobs)
+        t0 = time.perf_counter()
+        sched.stop()
+        assert time.perf_counter() - t0 < 10.0
+        assert sched.speculative_depth() == 0
+
+
+# ------------------------------------------------------- off byte-identity
+
+
+class TestSpeculateOff:
+    def test_off_matches_on_responses_and_builds_no_tier(self):
+        on = Scheduler(backend="host")
+        off = Scheduler(backend="host", speculate="off")
+        assert off.speculate is None
+        assert off._g_spec_depth is None
+        on.start()
+        off.start()
+        try:
+            docs = [_catalog("t8.", state=s) for s in (0, 1, 0, 2)]
+            for vs in docs:
+                (a,) = on.submit([vs])
+                (b,) = off.submit([vs])
+                assert problem_io.result_to_dict(a) \
+                    == problem_io.result_to_dict(b)
+            # submit_speculative is a guaranteed no-op when off.
+            assert off.submit_speculative([docs[0]]) == (0, 1)
+        finally:
+            on.stop()
+            off.stop()
+
+    def test_off_env_spelling(self, monkeypatch):
+        monkeypatch.setenv("DEPPY_TPU_SPECULATE", "off")
+        sched = Scheduler(backend="host")
+        assert sched.speculate is None
+
+    def test_endpoints_404_when_off(self):
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host",
+                     speculate="off")
+        srv.start()
+        try:
+            for path in ("/v1/catalog/publish", "/v1/resolve/preview"):
+                status, body = _request(srv.api_port, "POST", path,
+                                        {"updates": []})
+                assert status == 404
+                assert json.loads(body) == {"error": "not found"}
+        finally:
+            srv.shutdown()
+
+
+# ------------------------------------------------------------- what-if tier
+
+
+class TestPreview:
+    def test_preview_resolves_without_serving_or_caching(self):
+        sched = Scheduler(backend="host")
+        sched.start()
+        try:
+            base = _catalog("t9.")
+            sched.submit([base])
+            _drain(sched)
+            cache_len = len(sched.cache)
+            index_len = len(sched.incremental)
+            delta = _delta("t9.", 2)
+            entries = sched.speculate.preview(delta)
+            assert len(entries) >= 1
+            assert len(sched.cache) == cache_len, \
+                "preview must not cache"
+            assert len(sched.incremental) == index_len, \
+                "preview must not index"
+            # The previewed result equals actually publishing + asking.
+            new_vars = delta.apply(base)
+            (served,) = sched.submit([new_vars])
+            previewed = [e["result"] for e in entries
+                         if isinstance(e.get("result"), dict)]
+            assert problem_io.result_to_dict(served) \
+                in [problem_io.result_to_dict(r) for r in previewed]
+        finally:
+            sched.stop()
+
+    def test_preview_limit(self):
+        sched = Scheduler(backend="host")
+        sched.start()
+        try:
+            for s in range(3):
+                sched.submit([_catalog("t10.", state=s)])
+            entries = sched.speculate.preview(_delta("t10.", 4), limit=1)
+            assert len(entries) == 1
+        finally:
+            sched.stop()
+
+
+# ------------------------------------------------- affected enumeration
+
+
+class TestAffectedKeys:
+    def test_rows_touching_changed_identifiers_enumerate(self):
+        index = ClauseSetIndex(registry=telemetry.Registry())
+        p1 = encode(_catalog("t11."))
+        p2 = encode(_catalog("t11.", state=1))
+        for p in (p1, p2):
+            model = np.zeros(p.n_vars, dtype=bool)
+            index.store(fingerprint(p), p, model, steps=10, backtracks=0)
+        hits = index.affected_keys({"t11.b1v1"})
+        assert set(hits) == {fingerprint(p1), fingerprint(p2)}
+        assert index.affected_keys({"no-such-bundle"}) == []
+        assert index.affected_keys(set()) == []
+        # Most recently stored first.
+        assert hits[0] == fingerprint(p2)
+
+    def test_vocab_member_without_rows_does_not_enumerate(self):
+        """Row-based semantics: an identifier carried in the vocabulary
+        but touched by NO structural row cannot affect the solve (the
+        manager's membership check still covers constraint additions
+        to such variables)."""
+        index = ClauseSetIndex(registry=telemetry.Registry())
+        p = encode(problem_io.problems_from_document({"variables": [
+            {"id": "a", "constraints": [{"type": "mandatory"}]},
+            {"id": "loner"}]})[0])
+        index.store(fingerprint(p), p, np.zeros(p.n_vars, dtype=bool),
+                    steps=1, backtracks=0)
+        assert index.affected_keys({"a"}) == [fingerprint(p)]
+        assert index.affected_keys({"loner"}) == []
+
+
+# --------------------------------------------------------- service surface
+
+
+def _request(port, method, path, body=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    headers = {"Content-Type": "application/json"} \
+        if body is not None else {}
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+class TestServiceEndpoints:
+    def test_publish_then_reask_matches_off_service_byte_for_byte(self):
+        on = Server(bind_address="127.0.0.1:0",
+                    probe_address="127.0.0.1:0", backend="host")
+        off = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host",
+                     speculate="off")
+        on.start()
+        off.start()
+        try:
+            base = _doc_of(_catalog("t12."))
+            for srv in (on, off):
+                status, _ = _request(srv.api_port, "POST", "/v1/resolve",
+                                     base)
+                assert status == 200
+            pub = {"updates": [{
+                "id": "t12.b1v1",
+                "constraints": [{"type": "dependency",
+                                 "ids": ["t12.b1v4", "t12.b1v3"]}]}]}
+            status, body = _request(on.api_port, "POST",
+                                    "/v1/catalog/publish", pub)
+            assert status == 200
+            acct = json.loads(body)["publish"]
+            assert acct["affected"] >= 1
+            sched = on.scheduler
+            _drain(sched)
+            new_doc = _doc_of(PublishDelta.from_doc(pub).apply(
+                _catalog("t12.")))
+            s_on, b_on = _request(on.api_port, "POST", "/v1/resolve",
+                                  new_doc)
+            s_off, b_off = _request(off.api_port, "POST", "/v1/resolve",
+                                    new_doc)
+            assert (s_on, b_on) == (s_off, b_off)
+        finally:
+            on.shutdown()
+            off.shutdown()
+
+    def test_preview_endpoint_and_validation(self):
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host")
+        srv.start()
+        try:
+            _request(srv.api_port, "POST", "/v1/resolve",
+                     _doc_of(_catalog("t13.")))
+            pub = {"updates": [{
+                "id": "t13.b1v1",
+                "constraints": [{"type": "dependency",
+                                 "ids": ["t13.b1v3", "t13.b1v2"]}]}],
+                "limit": 4}
+            status, body = _request(srv.api_port, "POST",
+                                    "/v1/resolve/preview", pub)
+            assert status == 200
+            entries = json.loads(body)["preview"]
+            assert entries and entries[0]["result"]["status"] == "sat"
+            status, _ = _request(srv.api_port, "POST",
+                                 "/v1/resolve/preview",
+                                 dict(pub, limit=-1))
+            assert status == 400
+            status, _ = _request(srv.api_port, "POST",
+                                 "/v1/catalog/publish", {"updates": []})
+            assert status == 400
+        finally:
+            srv.shutdown()
+
+
+def _doc_of(variables):
+    return {"variables": [problem_io.variable_to_dict(v)
+                          for v in variables]}
+
+
+# ------------------------------------------- deferred re-probe (satellite)
+
+
+class TestDeferredReprobe:
+    def test_breaker_open_host_drain_kicks_background_upgrade(
+            self, monkeypatch):
+        from deppy_tpu.sat import solver as sat_solver
+
+        probed = threading.Event()
+
+        def fake_reprobe():
+            probed.set()
+            faults.default_breaker().reset()
+            return True
+
+        monkeypatch.setattr(sat_solver, "reprobe_engine", fake_reprobe)
+        sched = Scheduler(backend="auto")
+        sched._reprobe_s = 0.05
+        # Short cooldown: the loop's first wake deliberately waits out
+        # the breaker cooldown before probing.
+        faults.set_default_breaker(
+            faults.CircuitBreaker(reset_after_s=0.2))
+        breaker = faults.default_breaker()
+        for _ in range(breaker.failure_threshold):
+            breaker.record_failure()
+        assert breaker.blocks_device()
+        sched.start()
+        try:
+            (res,) = sched.submit([_catalog("t14.")])
+            assert isinstance(res, dict)  # host drain served the lane
+            assert probed.wait(10.0), \
+                "breaker-open host drain must kick the deferred re-probe"
+            t = sched._reprobe_thread
+            if t is not None:
+                t.join(10.0)
+            assert not faults.default_breaker().blocks_device()
+        finally:
+            sched.stop()
+
+    def test_probes_half_open_breaker_at_default_interval(
+            self, monkeypatch):
+        """Default-config shape (breaker cooldown << DEPPY_TPU_REPROBE):
+        the loop's first wake lands AFTER the cooldown, when the
+        breaker reads half-open — it must still probe off the serving
+        path rather than exit, or the satellite is a no-op at
+        defaults."""
+        from deppy_tpu.sat import solver as sat_solver
+
+        probed = threading.Event()
+
+        def fake_reprobe():
+            probed.set()
+            faults.default_breaker().reset()
+            return True
+
+        monkeypatch.setattr(sat_solver, "reprobe_engine", fake_reprobe)
+        faults.set_default_breaker(
+            faults.CircuitBreaker(reset_after_s=0.2))
+        breaker = faults.default_breaker()
+        for _ in range(breaker.failure_threshold):
+            breaker.record_failure()
+        sched = Scheduler(backend="auto")
+        assert sched._reprobe_s >= 60.0  # the default-config shape
+        sched.start()
+        try:
+            sched.submit([_catalog("t18.")])
+            assert probed.wait(10.0), \
+                "half-open breaker must still be probed off-path"
+            assert faults.default_breaker().state() == "closed"
+        finally:
+            sched.stop()
+
+    def test_explicit_host_backend_never_probes(self, monkeypatch):
+        from deppy_tpu.sat import solver as sat_solver
+
+        probed = threading.Event()
+        monkeypatch.setattr(sat_solver, "reprobe_engine",
+                            lambda: probed.set() or True)
+        sched = Scheduler(backend="host")
+        sched._reprobe_s = 0.01
+        breaker = faults.default_breaker()
+        for _ in range(breaker.failure_threshold):
+            breaker.record_failure()
+        sched.start()
+        try:
+            sched.submit([_catalog("t15.")])
+            assert not probed.wait(0.3)
+        finally:
+            sched.stop()
