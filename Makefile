@@ -124,6 +124,14 @@ speculate-smoke: ## Speculative pre-resolution end to end: publish burst against
 test-speculate: ## Speculative pre-resolution subsystem tests only (the `speculate` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m speculate
 
+.PHONY: fleet-smoke
+fleet-smoke: ## Replica fleet end to end: 3 local replicas + affinity router, mixed-tenant churn byte-identity + warm-hit ratio, publish fan-out, replica-kill retry, drain handoff, noisy-tenant fairness (ISSUE 15 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/fleet_smoke.py
+
+.PHONY: test-fleet
+test-fleet: ## Replica-fleet subsystem tests only (the `fleet` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m fleet
+
 .PHONY: lint
 lint: ## Static analysis: the six deppy-lint checkers vs analysis/baseline.json (ISSUE 7/8 acceptance; docs/analysis.md).
 	$(PYTHON) -m deppy_tpu lint

@@ -495,7 +495,54 @@ def _run_publish(timeout_s: int) -> dict | None:
     return None
 
 
+def _run_fleet(timeout_s: int) -> dict | None:
+    """Run the fleet-routing workload (ISSUE 15) on the forced-CPU
+    platform: 3 in-process replicas behind the affinity router vs the
+    round-robin baseline — warm state, not raw device speed, is what
+    this workload measures, so the host backend is the honest
+    substrate."""
+    from deppy_tpu.utils.platform_env import run_captured
+
+    cmd = [sys.executable, "-m", "deppy_tpu.benchmarks.fleet",
+           "--out", os.path.join(REPO, "benchmarks", "results",
+                                 "fleet_r15.json")]
+    try:
+        rc, stdout, stderr = run_captured(
+            cmd, timeout_s=timeout_s, cwd=REPO, env=_cpu_env())
+    except subprocess.TimeoutExpired:
+        _log(f"fleet workload timed out after {timeout_s}s")
+        return None
+    if stderr:
+        print(stderr, file=sys.stderr, end="", flush=True)
+    if rc != 0:
+        _log(f"fleet workload failed rc={rc}")
+        return None
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return rec
+    return None
+
+
 def main(workload: str = "headline") -> int:
+    if workload == "fleet":
+        rec = _run_fleet(RUN_TIMEOUT_S)
+        if rec is None:
+            rec = {
+                "metric": ("fleet churn query p99 ms "
+                           "(affinity routing vs round-robin)"),
+                "value": 0.0,
+                "unit": "ms",
+                "vs_baseline": 0.0,
+                "workload": "fleet",
+                "backend": "none",
+                "error": "fleet workload produced no record",
+            }
+        print(json.dumps(rec), flush=True)
+        return 0
     if workload == "publish":
         rec = _run_publish(RUN_TIMEOUT_S)
         if rec is None:
@@ -626,14 +673,17 @@ if __name__ == "__main__":
 
     _ap = argparse.ArgumentParser()
     _ap.add_argument("--workload",
-                     choices=["headline", "churn", "hard", "publish"],
+                     choices=["headline", "churn", "hard", "publish",
+                              "fleet"],
                      default="headline",
                      help="headline = batched device vs serial host; "
                      "churn = warm-start vs cold re-resolution replay "
                      "(ISSUE 10); hard = deep-implication-chain "
                      "portfolio racing vs fixed backends (ISSUE 13); "
                      "publish = sustained publish+query load, "
-                     "speculative pre-resolution on vs off (ISSUE 14)")
+                     "speculative pre-resolution on vs off (ISSUE 14); "
+                     "fleet = 3-replica affinity routing vs "
+                     "round-robin, warm-hit + p99 (ISSUE 15)")
     _args = _ap.parse_args()
     try:
         rc = main(workload=_args.workload)

@@ -1,0 +1,268 @@
+"""Fleet-routing benchmark (ISSUE 15): affinity vs round-robin.
+
+The fleet's whole claim is that warm state is worth preserving across
+replicas: PR 9/14 made the in-process warm tier worth 3.9-6.7x, and a
+load balancer that ignores it re-cold-solves every churn delta on
+whichever replica it happens to pick.  This workload measures exactly
+that: a 3-replica in-process fleet behind the router, a sustained
+mixed-family churn replay (every round mutates ONE bundle of each
+family — the one-row delta shape the incremental tier warm-serves),
+run twice — once with the affinity ring, once with the round-robin
+baseline policy — and reports per-pass p99, throughput, and the
+fleet-wide warm-hit ratio (exact-cache hits + incremental warm serves
+over total asks, scraped from every replica's ``/metrics``).
+
+Under affinity each family's stream stays on one replica, so every
+ask after the first is a warm serve (ratio → (rounds-1)/rounds).
+Under round-robin a replica sees a family every Nth round, by which
+time N bundles have churned — past the warm-cone cutoff — so nearly
+every ask cold-solves.  Responses are asserted identical between the
+passes (fresh replicas per pass; same documents, same answers).
+
+Emits one JSON record in the bench.py contract: ``value`` the affinity
+pass's query p99 in ms, ``vs_baseline`` the round-robin/affinity p99
+ratio, plus both warm-hit ratios and the identity verdict.
+``--out`` writes the full artifact (benchmarks/results/fleet_r15.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Dict, List, Optional
+
+from .harness import log
+
+
+def _family_doc(name: str, tgts: Dict[int, int], bundles: int,
+                size: int) -> dict:
+    """One family's current catalog state: ``bundles`` disconnected
+    dependency chains; ``tgts[b]`` is bundle ``b``'s churned mid-chain
+    dependency target."""
+    variables = []
+    for b in range(bundles):
+        for j in range(size):
+            cons = []
+            if j == 0:
+                cons.append({"type": "mandatory"})
+                cons.append({"type": "dependency",
+                             "ids": [f"{name}b{b}v1"]})
+            elif j == 1:
+                cons.append({"type": "dependency",
+                             "ids": [f"{name}b{b}v{tgts.get(b, 2)}"]})
+            elif j < size - 1:
+                cons.append({"type": "dependency",
+                             "ids": [f"{name}b{b}v{j + 1}"]})
+            variables.append({"id": f"{name}b{b}v{j}",
+                              "constraints": cons})
+    return {"variables": variables}
+
+
+def _mutate(tgts: Dict[int, int], rnd: int, bundles: int,
+            size: int) -> None:
+    """Round ``rnd``'s churn: rotate ONE bundle's dependency target —
+    a one-row delta whose touched cone is that bundle alone."""
+    b = rnd % bundles
+    tgts[b] = 2 + (tgts.get(b, 2) - 2 + 1) % (size - 2)
+
+
+def _request(port: int, method: str, path: str, body=None):
+    from http.client import HTTPConnection
+
+    conn = HTTPConnection("127.0.0.1", port, timeout=60)
+    headers = {"Content-Type": "application/json"} \
+        if body is not None else {}
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _metric(text: str, name: str) -> float:
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def replay(tag: str, n_families: int, rounds: int, bundles: int,
+           size: int, policy: str = "affinity") -> dict:
+    """One full pass: fresh 3-replica fleet + router under ``policy``,
+    churn replay, fleet-wide warm accounting.  ``tag`` prefixes every
+    identifier so repeat passes stay fingerprint-disjoint."""
+    from ..fleet import Router
+    from ..service import Server
+    from ..telemetry import percentile
+
+    replicas = [Server(bind_address="127.0.0.1:0",
+                       probe_address="127.0.0.1:0", backend="host",
+                       replica=f"{tag}{i}")
+                for i in range(3)]
+    for srv in replicas:
+        srv.start()
+    router = Router(
+        bind_address="127.0.0.1:0",
+        replicas=[f"127.0.0.1:{s.api_port}" for s in replicas],
+        policy=policy)
+    router.start()
+    try:
+        states: List[Dict[int, int]] = [dict() for _ in range(n_families)]
+        latencies: List[float] = []
+        rendered: List = []
+        t_pass = time.perf_counter()
+        for rnd in range(rounds):
+            for f in range(n_families):
+                if rnd:
+                    _mutate(states[f], rnd - 1, bundles, size)
+                doc = _family_doc(f"{tag}.f{f}.", states[f],
+                                  bundles, size)
+                t0 = time.perf_counter()
+                status, body = _request(router.api_port, "POST",
+                                        "/v1/resolve", doc)
+                latencies.append(time.perf_counter() - t0)
+                if status != 200:
+                    raise RuntimeError(
+                        f"{policy} pass: HTTP {status}: {body[:200]!r}")
+                rendered.append(json.loads(body)["results"])
+        wall = time.perf_counter() - t_pass
+        warm = asks = 0.0
+        for srv in replicas:
+            _, m = _request(srv.api_port, "GET", "/metrics")
+            text = m.decode()
+            warm += _metric(text, "deppy_cache_hits_total") \
+                + _metric(text, "deppy_incremental_hits_total")
+            asks += _metric(text, "deppy_cache_hits_total") \
+                + _metric(text, "deppy_cache_misses_total")
+        lat = sorted(latencies)
+        return {
+            "policy": policy,
+            "queries": len(latencies),
+            "p50_ms": round(percentile(lat, 50) * 1e3, 3),
+            "p99_ms": round(percentile(lat, 99) * 1e3, 3),
+            "wall_s": round(wall, 3),
+            "rate": round(len(latencies) / max(wall, 1e-9), 1),
+            "warm_hit_ratio": round(warm / max(asks, 1.0), 4),
+            "rendered": rendered,
+        }
+    finally:
+        router.shutdown()
+        for srv in replicas:
+            srv.shutdown()
+
+
+def _normalize(rendered, policy: str) -> str:
+    return json.dumps(rendered, sort_keys=True).replace(
+        f"{policy}.", "")
+
+
+def run(n_families: int = 7, rounds: int = 12, bundles: int = 6,
+        size: int = 6, passes: int = 2,
+        out_path: Optional[str] = None) -> dict:
+    if n_families % 3 == 0:
+        # A family count divisible by the replica count DEGENERATES
+        # round-robin into accidental perfect affinity (family f's
+        # global ask counter is always ≡ f mod 3), which would report
+        # the baseline as warm and the comparison as noise.  No silent
+        # caps: say so and fix it.
+        log(f"bumping --n-families {n_families} -> {n_families + 1} "
+            f"(multiples of the 3-replica fleet alias round-robin "
+            f"onto affinity)")
+        n_families += 1
+    log(f"fleet workload: {n_families} families x {rounds} churn "
+        f"rounds over a {bundles}x{size} bundle catalog, 3 replicas, "
+        f"affinity vs round-robin, {passes} passes (min-p99 kept)")
+    results = {}
+    for policy in ("affinity", "roundrobin"):
+        best = None
+        for p in range(passes):
+            tag = f"p{p}.{policy}"  # per-pass prefixes: fresh servers
+            #                          per pass, but keep passes
+            #                          fingerprint-disjoint anyway
+            r = replay(tag, n_families, rounds, bundles, size,
+                       policy=policy)
+            r["normalized"] = _normalize(r.pop("rendered"), tag)
+            log(f"  {policy} pass {p}: p99 {r['p99_ms']}ms  warm-hit "
+                f"{r['warm_hit_ratio']}  rate {r['rate']}/s")
+            if best is None or r["p99_ms"] < best["p99_ms"]:
+                best = r
+        results[policy] = best
+    identical = (results["affinity"]["normalized"]
+                 == results["roundrobin"]["normalized"])
+    for r in results.values():
+        r.pop("normalized")
+    aff, rr = results["affinity"], results["roundrobin"]
+    record = {
+        "metric": ("fleet churn query p99 ms "
+                   "(affinity routing vs round-robin)"),
+        "value": aff["p99_ms"],
+        "unit": "ms",
+        "vs_baseline": round(rr["p99_ms"] / max(aff["p99_ms"], 1e-9),
+                             2),
+        "workload": "fleet",
+        "n_replicas": 3,
+        "queries_per_pass": aff["queries"],
+        "warm_hit_ratio_affinity": aff["warm_hit_ratio"],
+        "warm_hit_ratio_roundrobin": rr["warm_hit_ratio"],
+        "responses_identical": identical,
+        "affinity": aff,
+        "roundrobin": rr,
+        "backend": "host",
+    }
+    if out_path:
+        import os
+        import platform
+
+        full = {
+            "issue": 15,
+            "record": "fleet_r15",
+            "platform": {
+                "python": platform.python_version(),
+                "machine": platform.machine(),
+                "cpus": os.cpu_count(),
+                "jax_platforms": (os.environ.get("JAX_PLATFORMS")
+                                  or "(default)"),
+            },
+            "note": ("3 in-process replicas behind the fleet router, "
+                     "sustained one-row-delta churn over disconnected-"
+                     "bundle families; warm_hit_ratio = fleet-wide "
+                     "(exact cache hits + incremental warm serves) / "
+                     "asks scraped from every replica.  The affinity "
+                     "acceptance is warm-hit >= 0.9 with round-robin "
+                     "materially lower; absolute p99s on this box are "
+                     "host-engine CPU numbers."),
+            "result": record,
+        }
+        d = os.path.dirname(out_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(full, fh, indent=1)
+            fh.write("\n")
+        log(f"wrote {out_path}")
+    return record
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n-families", type=int, default=7)
+    ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument("--bundles", type=int, default=6)
+    ap.add_argument("--size", type=int, default=6)
+    ap.add_argument("--out", default=None,
+                    help="write the full artifact JSON here "
+                    "(benchmarks/results/fleet_r15.json)")
+    args = ap.parse_args()
+    record = run(n_families=args.n_families, rounds=args.rounds,
+                 bundles=args.bundles, size=args.size,
+                 out_path=args.out)
+    print(json.dumps(record), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

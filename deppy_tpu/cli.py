@@ -274,6 +274,29 @@ def _build_parser() -> argparse.ArgumentParser:
         "bounds the armed overhead",
     )
     p_serve.add_argument(
+        "--replica", default=None, metavar="ID",
+        help="this replica's serving identity in a fleet (also via "
+        "DEPPY_TPU_REPLICA): labels the per-tenant SLO families, "
+        "/debug/slo, and the request root span so burn rate is "
+        "attributable per tenant per replica",
+    )
+    p_serve.add_argument(
+        "--sched-fair", choices=["on", "off"], default=None,
+        help="weighted-fair per-tenant admission + priority lanes "
+        "(default on; also via DEPPY_TPU_SCHED_FAIR).  'on' sheds "
+        "each tenant at its weighted share of the queue instead of "
+        "the global-depth 503 — one noisy tenant can no longer "
+        "starve the rest at the door; 'off' restores the global "
+        "gate byte for byte",
+    )
+    p_serve.add_argument(
+        "--sched-tenant-weights", default=None, metavar="SPEC",
+        help="tenant weights/priorities for the fair gate: inline "
+        "JSON, @FILE, or a path mapping tenant -> weight number or "
+        "{weight, priority} ('default' covers unlisted tenants; also "
+        "via DEPPY_TPU_SCHED_TENANT_WEIGHTS)",
+    )
+    p_serve.add_argument(
         "--mesh-devices", type=_mesh_devices_arg, default=None,
         metavar="N|all",
         help="shard each coalesced micro-batch across N accelerator "
@@ -281,6 +304,61 @@ def _build_parser() -> argparse.ArgumentParser:
         "single-device dispatch; also via DEPPY_TPU_MESH_DEVICES).  "
         "Each device gets its own fault domain and "
         "deppy_breaker_state{device=...} breaker",
+    )
+
+    p_route = sub.add_parser(
+        "route",
+        help="run the replica-fleet affinity router (ISSUE 15): a "
+        "front-end speaking the /v1/resolve surface that routes each "
+        "problem's family onto the consistent-hash ring so churn "
+        "concentrates on the replica holding its warm seeds, health-"
+        "probes replicas (a dead replica's arc reassigns, in-flight "
+        "requests retry once on the successor), fans catalog "
+        "publishes out fleet-wide, and orchestrates warm-state drain "
+        "handoffs (POST /fleet/drain)",
+    )
+    p_route.add_argument(
+        "--bind-address", default=":8079",
+        help="router listen address (default :8079)",
+    )
+    p_route.add_argument(
+        "--replicas", default=None, metavar="HOST:PORT[,...]",
+        help="replica API addresses to front, comma-separated (also "
+        "via DEPPY_TPU_FLEET_REPLICAS)",
+    )
+    p_route.add_argument(
+        "--vnodes", type=int, default=None, metavar="N",
+        help="virtual nodes per replica on the hash ring (default 64; "
+        "also via DEPPY_TPU_FLEET_VNODES)",
+    )
+    p_route.add_argument(
+        "--probe-interval", type=float, default=None, metavar="SECONDS",
+        help="seconds between per-replica health probes (default 2; "
+        "also via DEPPY_TPU_FLEET_PROBE_INTERVAL_S)",
+    )
+    p_route.add_argument(
+        "--probe-failures", type=int, default=None, metavar="N",
+        help="consecutive transport failures that mark a replica dead "
+        "and reassign its arcs (default 3; also via "
+        "DEPPY_TPU_FLEET_PROBE_FAILURES)",
+    )
+    p_route.add_argument(
+        "--policy", choices=["affinity", "roundrobin"],
+        default="affinity",
+        help="routing policy (default affinity; roundrobin exists as "
+        "the warm-state-destroying baseline for bench.py --workload "
+        "fleet)",
+    )
+    p_route.add_argument(
+        "--telemetry-file", default=None, metavar="FILE",
+        help="append router spans and fleet fault events as JSONL to "
+        "FILE (also via DEPPY_TPU_TELEMETRY_FILE)",
+    )
+    p_route.add_argument(
+        "--fault-plan", default=None, metavar="SPEC",
+        help="arm the fault-injection harness for the router (the "
+        "fleet.forward point; inline JSON, @FILE, or a path; also via "
+        "DEPPY_TPU_FAULT_PLAN)",
     )
 
     p_publish = sub.add_parser(
@@ -491,6 +569,9 @@ _CONFIG_KEYS = {
     "profile": ("profile", str),
     "profileSample": ("profile_sample", float),
     "bcp": ("bcp", str),
+    "replica": ("replica", str),
+    "schedFair": ("fair", str),
+    "schedTenantWeights": ("tenant_weights", str),
 }
 
 
@@ -605,6 +686,29 @@ def _cmd_resolve(args) -> int:
         else:
             print(f"{prefix}resolution incomplete: {r['error']}")
     return rc
+
+
+def _cmd_route(args) -> int:
+    """Run the replica-fleet affinity router (ISSUE 15)."""
+    if args.telemetry_file:
+        from .telemetry import configure_sink
+
+        configure_sink(args.telemetry_file)
+    if _arm_fault_plan(args.fault_plan):
+        return 2
+    from .fleet.router import serve_router
+
+    try:
+        serve_router(bind_address=args.bind_address,
+                     replicas=args.replicas,
+                     vnodes=args.vnodes,
+                     probe_interval_s=args.probe_interval,
+                     probe_failures=args.probe_failures,
+                     policy=args.policy)
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return 0
 
 
 def _cmd_publish(args) -> int:
@@ -1204,6 +1308,9 @@ def _cmd_serve(args) -> int:
         "profile": None,
         "profile_sample": None,
         "bcp": None,
+        "replica": None,
+        "fair": None,
+        "tenant_weights": None,
     }
     try:
         if args.config:
@@ -1230,6 +1337,9 @@ def _cmd_serve(args) -> int:
             ("profile", args.profile),
             ("profile_sample", args.profile_sample),
             ("bcp", args.bcp),
+            ("replica", args.replica),
+            ("fair", args.sched_fair),
+            ("tenant_weights", args.sched_tenant_weights),
         ):
             if val is not None:
                 kwargs[key] = val
@@ -1282,6 +1392,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_bench(args)
     if args.command == "serve":
         return _cmd_serve(args)
+    if args.command == "route":
+        return _cmd_route(args)
     if args.command == "publish":
         return _cmd_publish(args)
     if args.command == "stats":

@@ -198,6 +198,49 @@ _DECLARATIONS: List[EnvVar] = [
        "disables the tier; also --incremental-index-size).",
        flag="--incremental-index-size",
        config_key="incrementalIndexSize"),
+    # --- fleet (ISSUE 15) ------------------------------------------------
+    _v("DEPPY_TPU_FLEET_REPLICAS", "str", None, "deppy_tpu.fleet.router",
+       "Replica addresses the affinity router fronts, comma-separated "
+       "host:port (also --replicas on `deppy route`).",
+       flag="--replicas"),
+    _v("DEPPY_TPU_FLEET_VNODES", "int", 64, "deppy_tpu.fleet.router",
+       "Virtual nodes per replica on the consistent-hash ring (also "
+       "--vnodes); more vnodes = smoother arc split on membership "
+       "churn.",
+       flag="--vnodes"),
+    _v("DEPPY_TPU_FLEET_PROBE_INTERVAL_S", "float", 2.0,
+       "deppy_tpu.fleet.router",
+       "Seconds between router health probes per replica (also "
+       "--probe-interval; 0 disables probing — forwards still charge "
+       "the breaker).",
+       flag="--probe-interval"),
+    _v("DEPPY_TPU_FLEET_PROBE_FAILURES", "int", 3,
+       "deppy_tpu.fleet.router",
+       "Consecutive transport failures (probe or live forward) that "
+       "mark a replica dead and reassign its ring arcs (also "
+       "--probe-failures); a later successful probe revives it.",
+       flag="--probe-failures"),
+    _v("DEPPY_TPU_REPLICA", "str", None, "deppy_tpu.service",
+       "This replica's serving identity in a fleet (also --replica): "
+       "labels the per-tenant SLO families, /debug/slo, and the "
+       "service.request span so burn rate is attributable per tenant "
+       "per replica; unset keeps single-process surfaces unchanged.",
+       flag="--replica", config_key="replica"),
+    # --- scheduler fairness (ISSUE 15) -----------------------------------
+    _v("DEPPY_TPU_SCHED_FAIR", "str", "on", "deppy_tpu.sched.scheduler",
+       "Weighted-fair per-tenant admission + priority lanes: 'on' "
+       "sheds each tenant at its weighted share of the queue and "
+       "orders flush heads by tenant priority class; 'off' restores "
+       "the global-depth 503 and strict FIFO byte for byte (also "
+       "--sched-fair).",
+       flag="--sched-fair", config_key="schedFair"),
+    _v("DEPPY_TPU_SCHED_TENANT_WEIGHTS", "str", None,
+       "deppy_tpu.sched.scheduler",
+       "Declarative tenant weights/priorities for the fair gate: "
+       "inline JSON, @FILE, or a path mapping tenant -> weight number "
+       "or {weight, priority} ('default' covers unlisted tenants; "
+       "also --sched-tenant-weights).",
+       flag="--sched-tenant-weights", config_key="schedTenantWeights"),
     # --- service ---------------------------------------------------------
     _v("DEPPY_TPU_REQUEST_DEADLINE_S", "float", None, "deppy_tpu.service",
        "Default wall-clock budget per /v1/resolve request (clients "

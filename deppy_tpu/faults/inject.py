@@ -86,6 +86,7 @@ KNOWN_POINTS = (
     "sched.race.*",
     "hostpool.dispatch",
     "hostpool.worker_crash",
+    "fleet.forward",
 )
 
 

@@ -1,0 +1,34 @@
+"""Replica fleet with warm-state affinity routing (ISSUE 15 tentpole).
+
+Every serving-side lever so far scales ONE process, and PR 9/14 made
+in-process warm state (the clause-set index + exact-cache seeds) worth
+3.9-6.7x — so naive load balancing across N replicas throws the warm
+tier away.  This package makes N server processes behave like one warm
+process:
+
+  * :mod:`.ring` — a consistent-hash ring over replica addresses,
+    keyed by the request's FAMILY affinity (the decode-vocabulary
+    identifiers, which churn deltas of one family share even though
+    their exact fingerprints differ), so a family's whole churn stream
+    concentrates on the replica holding its warm seeds;
+  * :mod:`.router` — the ``deppy route`` front-end: speaks the
+    existing HTTP surface, routes ``/v1/resolve`` per problem over the
+    ring, health-probes every replica (a dead replica's arc reassigns
+    and an in-flight request retries once on the ring successor),
+    fans catalog publishes out to every replica's speculative tier,
+    and orchestrates the drain handoff;
+  * :mod:`.snapshot` — versioned, integrity-checked serialization of a
+    replica's warm state (clause-set index entries + exact-cache SAT
+    seeds), so a draining replica bequeaths its warm tier to the
+    replicas inheriting its ring arcs instead of forcing the fleet
+    cold.
+
+The scheduler side of the fleet story — per-tenant weighted-fair
+admission and priority lanes replacing the global-depth 503 — lives in
+:mod:`deppy_tpu.sched.scheduler` (``DEPPY_TPU_SCHED_FAIR``).
+"""
+
+from .ring import HashRing, affinity_key, doc_affinity_keys  # noqa: F401
+from .router import Router  # noqa: F401
+from .snapshot import (SNAPSHOT_VERSION, SnapshotFormatError,  # noqa: F401
+                       export_warm_state, import_warm_state)

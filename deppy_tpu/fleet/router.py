@@ -1,0 +1,709 @@
+"""The affinity router front-end (ISSUE 15, piece 2): ``deppy route``.
+
+A standalone process speaking the EXISTING HTTP surface — clients point
+at the router instead of a replica and change nothing else:
+
+  * ``POST /v1/resolve`` routes per problem over the consistent-hash
+    ring (:mod:`.ring`), so a family's churn stream always lands on the
+    replica holding its warm seeds.  A request whose problems map to
+    one replica forwards byte-for-byte; a mixed batch splits into
+    per-replica sub-batches and the results merge back in input order
+    — either way the body equals what a single replica would serve.
+  * ``POST /v1/catalog/publish`` fans out to EVERY live replica: each
+    replica's speculative tier must see the catalog delta or its warm
+    families go stale (``deppy_fleet_publish_fanout_total``).
+  * ``POST /v1/resolve/preview`` fans out too and concatenates the
+    per-replica previews — retained families are partitioned by
+    affinity, so the union is the fleet's answer.
+  * ``GET /metrics`` / ``GET /fleet/replicas`` expose routing counts,
+    per-replica health, and breaker state.
+  * ``POST /fleet/drain`` runs the warm-state handoff: fetch the
+    draining replica's snapshot (``GET /debug/warmstate``), split it by
+    each entry's family affinity across the replicas inheriting its
+    ring arcs, and POST each shard to its inheritor — then retire the
+    replica from routing.  The operator SIGTERMs it afterwards.
+
+**Health.**  A background prober hits every replica on an interval;
+``probe_failures`` consecutive transport failures open that replica's
+breaker (dead: its arcs reassign on the ring), and a later successful
+probe closes it (the arcs return — warm state it accumulated before
+dying is still there).  A transport failure on a live forward charges
+the same breaker and the request retries ONCE on the key's ring
+successor, so a replica crash degrades only its in-flight requests by
+one retry, never to client-visible errors.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List, Optional, Tuple
+
+from .. import config, faults, telemetry
+from .ring import DEFAULT_VNODES, HashRing, doc_affinity_keys
+from .snapshot import SnapshotFormatError, split_snapshot, verify_snapshot
+
+DEFAULT_PROBE_INTERVAL_S = 2.0
+DEFAULT_PROBE_FAILURES = 3
+# Forwarded solves can legitimately take minutes (budget escalation on
+# a cold device path); transport-level hangs are the prober's job.
+FORWARD_TIMEOUT_S = 600.0
+PROBE_TIMEOUT_S = 2.0
+
+# Request headers forwarded to replicas (ISSUE 15 satellite: trace
+# identity must survive the hop so a fleet-routed request reconstructs
+# as ONE tree in `deppy trace`), and response headers echoed back.
+FORWARD_HEADERS = ("Content-Type", "traceparent", "X-Deppy-Request-Id",
+                   "X-Deppy-Tenant", "X-Deppy-Deadline-S",
+                   "X-Deppy-Timings")
+ECHO_HEADERS = ("X-Deppy-Request-Id", "traceparent", "Retry-After")
+
+
+class _Replica:
+    """One replica's health/breaker state (guarded by Router._lock)."""
+
+    __slots__ = ("address", "failures", "dead", "drained")
+
+    def __init__(self, address: str):
+        self.address = address
+        self.failures = 0
+        self.dead = False
+        self.drained = False
+
+
+def _parse_replicas(spec) -> List[str]:
+    if isinstance(spec, str):
+        spec = [s for s in (t.strip() for t in spec.split(",")) if s]
+    out = list(dict.fromkeys(spec or []))
+    if not out:
+        raise ValueError(
+            "fleet router requires at least one replica address "
+            "(--replicas host:port[,host:port...] / "
+            "DEPPY_TPU_FLEET_REPLICAS)")
+    return out
+
+
+def _split_host_port(address: str) -> Tuple[str, int]:
+    host, _, port = address.rpartition(":")
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    return host or "127.0.0.1", int(port)
+
+
+class Router:
+    """The replica-fleet affinity router."""
+
+    def __init__(
+        self,
+        bind_address: str = ":8079",
+        replicas=None,
+        vnodes: Optional[int] = None,
+        probe_interval_s: Optional[float] = None,
+        probe_failures: Optional[int] = None,
+        policy: str = "affinity",
+        max_body_bytes: int = 8 * 1024 * 1024,
+    ):
+        from ..analysis import lockdep
+
+        if replicas is None:
+            replicas = config.env_str("DEPPY_TPU_FLEET_REPLICAS")
+        addresses = _parse_replicas(replicas)
+        if vnodes is None:
+            vnodes = config.env_int("DEPPY_TPU_FLEET_VNODES",
+                                    DEFAULT_VNODES, strict=False)
+        if probe_interval_s is None:
+            probe_interval_s = faults.env_float(
+                "DEPPY_TPU_FLEET_PROBE_INTERVAL_S",
+                DEFAULT_PROBE_INTERVAL_S, warn=True)
+        if probe_failures is None:
+            probe_failures = config.env_int(
+                "DEPPY_TPU_FLEET_PROBE_FAILURES",
+                DEFAULT_PROBE_FAILURES, strict=False)
+        if policy not in ("affinity", "roundrobin"):
+            raise ValueError(
+                f"unknown routing policy {policy!r} "
+                "(want 'affinity' or 'roundrobin')")
+        # ``roundrobin`` exists for the bench artifact only: it is the
+        # warm-state-destroying baseline the affinity ring is measured
+        # against (bench.py --workload fleet).
+        self.policy = policy
+        self.ring = HashRing(addresses, vnodes=vnodes)
+        self.probe_interval_s = max(float(probe_interval_s or 0.0), 0.0)
+        self.probe_failures = max(int(probe_failures), 1)
+        self.max_body_bytes = max_body_bytes
+        self._lock = lockdep.make_lock("fleet.router")
+        self._replicas: Dict[str, _Replica] = {
+            a: _Replica(a) for a in addresses}
+        self._rr_next = 0
+        self.registry = telemetry.Registry()
+        r = self.registry
+        self._c_routed = r.counter(
+            "deppy_fleet_routed_total",
+            "Problems routed, by replica.", labelname="replica")
+        self._c_requests = r.counter(
+            "deppy_fleet_requests_total",
+            "Requests handled by the router, by endpoint.",
+            labelname="endpoint")
+        self._c_retries = r.counter(
+            "deppy_fleet_retries_total",
+            "Forwards retried on the ring successor after a replica "
+            "transport failure.")
+        self._c_probe_failures = r.counter(
+            "deppy_fleet_probe_failures_total",
+            "Health-probe transport failures, by replica.",
+            labelname="replica")
+        self._c_transitions = r.counter(
+            "deppy_fleet_replica_transitions_total",
+            "Replica breaker transitions (up->down and down->up).",
+            labelname="transition").preset("down", "up")
+        self._c_fanout = r.counter(
+            "deppy_fleet_publish_fanout_total",
+            "Per-replica publish/preview fan-out forwards.")
+        self._c_drains = r.counter(
+            "deppy_fleet_drains_total",
+            "Drain handoffs orchestrated (POST /fleet/drain).")
+        self._c_handoff = r.counter(
+            "deppy_fleet_handoff_entries_total",
+            "Warm-state entries (index entries + cache seeds) handed "
+            "off to arc inheritors during drains.")
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        from ..service import _make_http_server, _parse_addr
+
+        self._api = _make_http_server(_parse_addr(bind_address),
+                                      _router_handler(self))
+        self._threads: list = []
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def api_port(self) -> int:
+        return self._api.server_address[1]
+
+    def _unroutable_locked(self) -> frozenset:
+        return frozenset(a for a, st in self._replicas.items()
+                         if st.dead or st.drained)
+
+    def live_replicas(self) -> List[str]:
+        with self._lock:
+            dead = self._unroutable_locked()
+        return [a for a in self.ring.replicas if a not in dead]
+
+    def target_for(self, key: Optional[str],
+                   exclude=()) -> Optional[str]:
+        """The replica serving ``key`` right now (health- and
+        drain-aware).  Round-robin mode ignores the key — that is the
+        point of the baseline."""
+        with self._lock:
+            dead = self._unroutable_locked() | frozenset(exclude)
+            if self.policy == "roundrobin":
+                live = [a for a in self.ring.replicas if a not in dead]
+                if not live:
+                    return None
+                target = live[self._rr_next % len(live)]
+                self._rr_next += 1
+                return target
+        return self.ring.route(key, exclude=dead)
+
+    def note_transport_failure(self, address: str) -> None:
+        """A probe or live forward could not reach ``address``: charge
+        its breaker; at the threshold the replica goes dead and its
+        arcs reassign."""
+        self._c_probe_failures.inc(label=address)
+        with self._lock:
+            st = self._replicas.get(address)
+            if st is None or st.drained:
+                return
+            st.failures += 1
+            if st.failures < self.probe_failures or st.dead:
+                return
+            st.dead = True
+        self._c_transitions.inc(label="down")
+        telemetry.default_registry().event(
+            "fault", fault="fleet_replica_down", replica=address)
+
+    def note_transport_success(self, address: str) -> None:
+        with self._lock:
+            st = self._replicas.get(address)
+            if st is None:
+                return
+            st.failures = 0
+            was_dead, st.dead = st.dead, False
+        if was_dead:
+            self._c_transitions.inc(label="up")
+            telemetry.default_registry().event(
+                "fault", fault="fleet_replica_up", replica=address)
+
+    def replica_states(self) -> List[dict]:
+        with self._lock:
+            return [{"replica": st.address,
+                     "dead": st.dead,
+                     "drained": st.drained,
+                     "consecutive_failures": st.failures}
+                    for st in self._replicas.values()]
+
+    # --------------------------------------------------------- transport
+
+    def forward(self, address: str, method: str, path: str,
+                body: Optional[bytes], headers: Optional[dict] = None,
+                timeout: float = FORWARD_TIMEOUT_S):
+        """One HTTP exchange with a replica; returns ``(status, body,
+        headers)``.  Transport errors raise ``OSError`` AFTER charging
+        the replica's breaker; HTTP error statuses are the replica's
+        answer and pass through untouched."""
+        faults.inject("fleet.forward")
+        host, port = _split_host_port(address)
+        try:
+            conn = HTTPConnection(host, port, timeout=timeout)
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            hdrs = {k: v for k, v in resp.getheaders()}
+            status = resp.status
+            conn.close()
+        except OSError:
+            self.note_transport_failure(address)
+            raise
+        self.note_transport_success(address)
+        return status, data, hdrs
+
+    # ----------------------------------------------------------- probing
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            with self._lock:
+                targets = [st.address for st in self._replicas.values()
+                           if not st.drained]
+            for address in targets:
+                if self._stop.is_set():
+                    return
+                host, port = _split_host_port(address)
+                try:
+                    conn = HTTPConnection(host, port,
+                                          timeout=PROBE_TIMEOUT_S)
+                    # Any HTTP response — the path 404s on the API port
+                    # — proves the process serves; readiness semantics
+                    # stay with the replica's own probe listener.
+                    conn.request("GET", "/healthz")
+                    conn.getresponse().read()
+                    conn.close()
+                except OSError:
+                    self.note_transport_failure(address)
+                else:
+                    self.note_transport_success(address)
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._api.serve_forever,
+                             name="deppy-route", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.probe_interval_s > 0:
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="deppy-route-probe",
+                daemon=True)
+            self._probe_thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._threads:
+            self._api.shutdown()
+        self._api.server_close()
+        self._threads = []
+        t = self._probe_thread
+        if t is not None:
+            t.join(PROBE_TIMEOUT_S + self.probe_interval_s + 1.0)
+            self._probe_thread = None
+
+    # ------------------------------------------------------------- drain
+
+    def drain(self, address: str) -> dict:
+        """The warm-state handoff: snapshot the draining replica, split
+        by family affinity across the surviving ring, deliver each
+        shard, retire the replica from routing.  Raises ``ValueError``
+        on an unknown replica, ``OSError``/:class:`SnapshotFormatError`
+        when the snapshot cannot be fetched or verified (the replica
+        stays routable — a failed drain must not silently blackhole an
+        arc)."""
+        with self._lock:
+            st = self._replicas.get(address)
+            if st is None:
+                raise ValueError(f"unknown replica {address!r}")
+            exclude = self._unroutable_locked() | {address}
+        status, body, _ = self.forward(address, "GET", "/debug/warmstate",
+                                       None)
+        if status != 200:
+            raise OSError(
+                f"replica {address} warm-state export failed "
+                f"(HTTP {status})")
+        snapshot = verify_snapshot(json.loads(body))
+        shards = split_snapshot(
+            snapshot,
+            lambda aff: self.ring.route(aff, exclude=exclude))
+        delivered: Dict[str, dict] = {}
+        entries = 0
+        for owner, shard in shards.items():
+            payload = json.dumps(shard).encode()
+            s2, b2, _ = self.forward(
+                owner, "POST", "/debug/warmstate", payload,
+                {"Content-Type": "application/json"})
+            if s2 != 200:
+                raise OSError(
+                    f"inheritor {owner} rejected warm-state shard "
+                    f"(HTTP {s2}): {b2[:200]!r}")
+            delivered[owner] = json.loads(b2).get("imported", {})
+            entries += len(shard["index"]) + len(shard["cache"])
+        with self._lock:
+            st.drained = True
+        self._c_drains.inc()
+        self._c_handoff.inc(entries)
+        telemetry.default_registry().event(
+            "fault", fault="fleet_drain_handoff", replica=address,
+            entries=entries, recipients=sorted(delivered))
+        return {"replica": address,
+                "index_entries": len(snapshot["index"]),
+                "cache_seeds": len(snapshot["cache"]),
+                "handed_off": entries,
+                "recipients": delivered}
+
+    # ------------------------------------------------------------ metrics
+
+    def render_metrics(self) -> str:
+        lines = self.registry.render_lines()
+        states = self.replica_states()
+        lines.append("# HELP deppy_fleet_replica_up Replica breaker "
+                     "verdict: 1 = routable, 0 = dead or drained.")
+        lines.append("# TYPE deppy_fleet_replica_up gauge")
+        for st in states:
+            up = 0 if (st["dead"] or st["drained"]) else 1
+            lines.append(
+                f'deppy_fleet_replica_up{{replica="{st["replica"]}"}} '
+                f"{up}")
+        return "\n".join(lines) + "\n"
+
+
+def _router_handler(router: Router):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        # ------------------------------------------------------ plumbing
+
+        def _send(self, status: int, body: bytes,
+                  ctype: str = "application/json",
+                  extra: Optional[dict] = None) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, status: int, doc: dict) -> None:
+            self._send(status, json.dumps(doc).encode())
+
+        def _read_body(self) -> Optional[bytes]:
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                length = -1
+            if length < 0 or length > router.max_body_bytes:
+                self._send_json(413 if length > 0 else 400,
+                                {"error": "invalid or oversized body"})
+                return None
+            return self.rfile.read(length)
+
+        def _fwd_headers(self) -> dict:
+            return {k: self.headers[k] for k in FORWARD_HEADERS
+                    if self.headers.get(k) is not None}
+
+        def _relay(self, status: int, body: bytes, hdrs: dict) -> None:
+            self._send(status, body,
+                       hdrs.get("Content-Type", "application/json"),
+                       {k: hdrs[k] for k in ECHO_HEADERS if k in hdrs})
+
+        def _forward_with_retry(self, key, path: str, body: bytes):
+            """Route ``key``, forward, and on a TRANSPORT failure retry
+            once on the ring successor (the replica that inherits the
+            key's arc).  Returns the relayed (status, body, headers)
+            plus the serving replica, or None after sending the
+            no-replica 503."""
+            headers = self._fwd_headers()
+            target = router.target_for(key)
+            tried: List[str] = []
+            while target is not None:
+                try:
+                    out = router.forward(target, "POST", path, body,
+                                         headers)
+                except OSError:
+                    tried.append(target)
+                    if len(tried) > 1:
+                        break
+                    router._c_retries.inc()
+                    target = router.target_for(key, exclude=tried)
+                    continue
+                return out + (target,)
+            self._send_json(503, {
+                "error": "fleet: no replica reachable",
+                "retry_after_s": max(router.probe_interval_s, 1.0)})
+            return None
+
+        # ------------------------------------------------------ endpoints
+
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                self._send(200, router.render_metrics().encode(),
+                           "text/plain; version=0.0.4")
+            elif path == "/fleet/replicas":
+                self._send_json(200, {
+                    "policy": router.policy,
+                    "vnodes": router.ring.vnodes,
+                    "replicas": router.replica_states()})
+            else:
+                self._send_json(404, {"error": "not found"})
+
+        def do_POST(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/v1/resolve":
+                self._resolve()
+            elif path in ("/v1/catalog/publish", "/v1/resolve/preview"):
+                self._fan_out(path)
+            elif path == "/fleet/drain":
+                self._drain()
+            else:
+                self._send_json(404, {"error": "not found"})
+
+        def _resolve(self):
+            router._c_requests.inc(label="resolve")
+            raw = self._read_body()
+            if raw is None:
+                return
+            try:
+                doc = json.loads(raw or b"null")
+                keys = doc_affinity_keys(doc)
+            except (ValueError, json.JSONDecodeError):
+                # Unparseable bodies forward untouched: the replica
+                # renders the same 400 a single server would, so the
+                # router adds no second validation surface.
+                keys = [None]
+            by_target: Dict[Optional[str], List[int]] = {}
+            for i, key in enumerate(keys):
+                by_target.setdefault(
+                    router.target_for(key), []).append(i)
+            if len(by_target) == 1:
+                # One owner: forward the ORIGINAL bytes — byte-identity
+                # with a single replica is structural, not re-rendered.
+                out = self._forward_with_retry(keys[0], "/v1/resolve",
+                                               raw)
+                if out is None:
+                    return
+                status, body, hdrs, target = out
+                if status == 200:
+                    router._c_routed.inc(len(keys), label=target)
+                self._relay(status, body, hdrs)
+                return
+            self._resolve_split(doc, keys, by_target)
+
+        def _resolve_split(self, doc, keys, groups) -> None:
+            """A batch spanning replicas: per-replica sub-batches
+            (``groups``: the routing pass _resolve already computed —
+            recomputing would double the ring walks, and in roundrobin
+            mode re-advance the rotation off the assignment actually
+            measured) forwarded concurrently, results merged back in
+            input order.  Any non-200 sub-response wins (lowest
+            problem index first — deterministic), mirroring the
+            all-or-nothing semantics of a single server's
+            request-level errors."""
+            problems = doc["problems"]
+            results: List[Optional[dict]] = [None] * len(problems)
+            failures: List[tuple] = []
+            lock = threading.Lock()
+
+            def one(target: Optional[str], idxs: List[int]) -> None:
+                sub = json.dumps(
+                    {"problems": [problems[i] for i in idxs]}).encode()
+                out = None
+                if target is not None:
+                    first = self._fwd_headers()
+                    tried = [target]
+                    while True:
+                        try:
+                            out = router.forward(target, "POST",
+                                                 "/v1/resolve", sub,
+                                                 first)
+                            break
+                        except OSError:
+                            if len(tried) > 1:
+                                out = None
+                                break
+                            router._c_retries.inc()
+                            target = router.target_for(
+                                keys[idxs[0]], exclude=tried)
+                            if target is None:
+                                break
+                            tried.append(target)
+                with lock:
+                    if out is None:
+                        failures.append((idxs[0], 503, json.dumps({
+                            "error": "fleet: no replica reachable",
+                            "retry_after_s": max(
+                                router.probe_interval_s, 1.0),
+                        }).encode(), {}))
+                        return
+                    status, body, hdrs = out
+                    if status != 200:
+                        failures.append((idxs[0], status, body, hdrs))
+                        return
+                    router._c_routed.inc(len(idxs), label=target)
+                    for i, res in zip(idxs,
+                                      json.loads(body)["results"]):
+                        results[i] = res
+
+            threads = [threading.Thread(target=one, args=(t, idxs),
+                                        daemon=True)
+                       for t, idxs in groups.items()]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if failures:
+                _, status, body, hdrs = min(failures)
+                self._relay(status, body, hdrs)
+                return
+            self._send(200, json.dumps({"results": results}).encode())
+
+        def _fan_out(self, path: str) -> None:
+            """Publish / preview fan-out to every live replica."""
+            endpoint = ("publish" if path.endswith("publish")
+                        else "preview")
+            router._c_requests.inc(label=endpoint)
+            raw = self._read_body()
+            if raw is None:
+                return
+            headers = self._fwd_headers()
+            live = router.live_replicas()
+            if not live:
+                self._send_json(503, {
+                    "error": "fleet: no replica reachable",
+                    "retry_after_s": max(router.probe_interval_s, 1.0)})
+                return
+            merged: Dict[str, float] = {}
+            previews: List = []
+            errors = 0
+            first_error = None
+            for address in live:
+                try:
+                    status, body, _ = router.forward(
+                        address, "POST", path, raw, headers)
+                except OSError:
+                    errors += 1
+                    continue
+                router._c_fanout.inc()
+                if status != 200:
+                    errors += 1
+                    if first_error is None:
+                        first_error = (status, body)
+                    continue
+                payload = json.loads(body)
+                if endpoint == "publish":
+                    for k, v in (payload.get("publish") or {}).items():
+                        if isinstance(v, (int, float)):
+                            merged[k] = merged.get(k, 0) + v
+                else:
+                    previews.extend(payload.get("preview") or [])
+            if errors == len(live):
+                if first_error is not None:
+                    # Every replica answered the same rejection (e.g. a
+                    # malformed publish, or the tier off fleet-wide):
+                    # relay it rather than masking as a router error.
+                    self._send(first_error[0], first_error[1])
+                else:
+                    # Every forward failed at the TRANSPORT level (all
+                    # replicas died between probe cycles): a 200 with
+                    # zero recipients would read as "delta propagated"
+                    # / "preview empty" when nothing was reached.
+                    self._send_json(503, {
+                        "error": "fleet: no replica reachable",
+                        "retry_after_s": max(
+                            router.probe_interval_s, 1.0)})
+                return
+            if endpoint == "publish":
+                merged["replicas"] = len(live) - errors
+                merged["errors"] = errors
+                self._send_json(200, {"publish": merged})
+            else:
+                self._send_json(200, {"preview": previews})
+
+        def _drain(self):
+            router._c_requests.inc(label="drain")
+            raw = self._read_body()
+            if raw is None:
+                return
+            try:
+                doc = json.loads(raw or b"null")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send_json(400,
+                                {"error": f"invalid JSON body: {e}"})
+                return
+            if not isinstance(doc, dict) \
+                    or not isinstance(doc.get("replica"), str):
+                self._send_json(
+                    400, {"error": 'drain requires {"replica": '
+                          '"host:port"}'})
+                return
+            try:
+                out = router.drain(doc["replica"])
+            except ValueError as e:
+                self._send_json(400, {"error": str(e)})
+                return
+            except (OSError, SnapshotFormatError,
+                    json.JSONDecodeError) as e:
+                self._send_json(502, {"error": f"drain failed: {e}"})
+                return
+            self._send_json(200, {"drain": out})
+
+    return Handler
+
+
+def serve_router(bind_address: str = ":8079", replicas=None,
+                 vnodes: Optional[int] = None,
+                 probe_interval_s: Optional[float] = None,
+                 probe_failures: Optional[int] = None,
+                 policy: str = "affinity") -> None:
+    """Blocking entry point for ``deppy route`` — the router analog of
+    ``service.serve`` (SIGTERM/Ctrl-C stop it cleanly)."""
+    import signal
+
+    router = Router(bind_address=bind_address, replicas=replicas,
+                    vnodes=vnodes, probe_interval_s=probe_interval_s,
+                    probe_failures=probe_failures, policy=policy)
+    router.start()
+    stop = threading.Event()
+
+    def _on_sigterm(signum, frame):
+        stop.set()
+
+    prev = signal.signal(signal.SIGTERM, _on_sigterm)
+    print(f"deppy fleet router listening on :{router.api_port} "
+          f"({len(router.ring.replicas)} replicas, policy "
+          f"{router.policy})", flush=True)
+    try:
+        while not stop.is_set():
+            stop.wait(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        router.shutdown()
+
+
+# For the smoke/bench harnesses: how long a router takes to notice a
+# dead replica (probe interval x failure threshold) — chaos assertions
+# derive their settle windows from this instead of hardcoding sleeps.
+def detection_window_s(router: Router) -> float:
+    return router.probe_interval_s * router.probe_failures

@@ -1,0 +1,171 @@
+"""Warm-state snapshot / handoff serialization (ISSUE 15, piece 3).
+
+A replica's warm tier is two in-process stores: the clause-set index
+(zero-backtrack SAT models keyed by clause-set fingerprint — the
+warm-start seeds) and the exact result cache.  A drain without handoff
+throws both away and the inheriting replicas cold-solve every family
+the drained replica owned; this module serializes them into one
+versioned, integrity-checked JSON document:
+
+  * **index entries** round-trip at full fidelity (per-row multiset,
+    vocabulary, model, cold-equivalent steps) — an imported entry plans
+    warm starts exactly like the original;
+  * **exact-cache seeds** carry definitive SAT solution dicts only.
+    UNSAT cores hold live constraint objects (not worth a codec for a
+    rare, cheap-to-recompute case) and Incomplete entries are
+    budget-relative; both re-solve cold once and re-enter the cache.
+
+Every entry carries its family ``affinity`` key so the router can
+split a draining replica's snapshot across the replicas inheriting its
+ring arcs (:meth:`deppy_tpu.fleet.router.Router` ``POST /fleet/drain``).
+The checksum is over the canonical JSON of the payload — a truncated
+or bit-flipped handoff is rejected loudly (:class:`SnapshotFormatError`)
+rather than silently poisoning the inheritor's warm tier.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Callable, Dict, List, Optional
+
+from .ring import affinity_key
+
+SNAPSHOT_VERSION = 1
+
+
+class SnapshotFormatError(ValueError):
+    """Malformed, version-skewed, or integrity-failed snapshot."""
+
+
+def _checksum(payload: dict) -> str:
+    canon = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode()).hexdigest()
+
+
+def _seal(index_entries: List[dict], cache_seeds: List[dict]) -> dict:
+    payload = {"version": SNAPSHOT_VERSION, "index": index_entries,
+               "cache": cache_seeds}
+    return {**payload, "checksum": _checksum(payload)}
+
+
+def export_warm_state(scheduler) -> dict:
+    """Serialize one scheduler's warm tier.  Works with either store
+    absent (tier off): the corresponding section is just empty."""
+    index_entries: List[dict] = []
+    index = getattr(scheduler, "incremental", None)
+    if index is not None:
+        for entry in index.export_entries():
+            index_entries.append({
+                "key": entry.key,
+                "vocab_n": entry.vocab[0],
+                "vocab_ids": list(entry.vocab[1]),
+                "rows": [[list(k), n] for k, n in entry.rows.items()],
+                "model": [int(b) for b in entry.model],
+                "steps": entry.steps,
+                "backtracks": entry.backtracks,
+                "affinity": affinity_key(entry.vocab[1]),
+            })
+    cache_seeds: List[dict] = []
+    cache = getattr(scheduler, "cache", None)
+    if cache is not None:
+        for key, budget, solution in cache.export_seeds():
+            cache_seeds.append({
+                "key": key,
+                "budget": budget,
+                "solution": solution,
+                "affinity": affinity_key(solution.keys()),
+            })
+    return _seal(index_entries, cache_seeds)
+
+
+def verify_snapshot(doc) -> dict:
+    """Validate shape, version, and checksum; returns ``doc``."""
+    if not isinstance(doc, dict):
+        raise SnapshotFormatError(
+            f"snapshot must be an object, got {type(doc).__name__}")
+    if doc.get("version") != SNAPSHOT_VERSION:
+        raise SnapshotFormatError(
+            f"unsupported snapshot version {doc.get('version')!r} "
+            f"(this build speaks {SNAPSHOT_VERSION})")
+    if not isinstance(doc.get("index"), list) \
+            or not isinstance(doc.get("cache"), list):
+        raise SnapshotFormatError(
+            'snapshot requires "index" and "cache" lists')
+    payload = {"version": doc["version"], "index": doc["index"],
+               "cache": doc["cache"]}
+    if doc.get("checksum") != _checksum(payload):
+        raise SnapshotFormatError(
+            "snapshot integrity check failed (checksum mismatch)")
+    return doc
+
+
+def import_warm_state(scheduler, doc) -> dict:
+    """Merge a verified snapshot into ``scheduler``'s warm tier.
+
+    Live state wins: an index key already present keeps its (at least
+    as fresh) local entry, and the exact cache's own supersede rules
+    apply to seeds.  Returns the merge accounting the endpoint
+    renders."""
+    import numpy as np
+
+    verify_snapshot(doc)
+    index = getattr(scheduler, "incremental", None)
+    idx_in = idx_skip = 0
+    for raw in doc["index"]:
+        if index is None:
+            break
+        try:
+            from collections import Counter
+
+            rows = Counter({tuple(k): int(n) for k, n in raw["rows"]})
+            vocab = (int(raw["vocab_n"]),
+                     tuple(str(i) for i in raw["vocab_ids"]))
+            model = np.asarray(raw["model"], dtype=bool)
+            ok = index.import_entry(
+                str(raw["key"]), rows, vocab, model,
+                int(raw["steps"]), int(raw["backtracks"]))
+        except (KeyError, TypeError, ValueError) as e:
+            raise SnapshotFormatError(
+                f"malformed snapshot index entry: {e}") from e
+        if ok:
+            idx_in += 1
+        else:
+            idx_skip += 1
+    cache = getattr(scheduler, "cache", None)
+    seeds = 0
+    for raw in doc["cache"]:
+        if cache is None:
+            break
+        try:
+            sol = raw["solution"]
+            if not isinstance(sol, dict):
+                raise TypeError('"solution" must be an object')
+            cache.store(str(raw["key"]), int(raw["budget"]),
+                        {str(k): bool(v) for k, v in sol.items()})
+        except (KeyError, TypeError, ValueError) as e:
+            raise SnapshotFormatError(
+                f"malformed snapshot cache seed: {e}") from e
+        seeds += 1
+    return {"index_imported": idx_in, "index_skipped": idx_skip,
+            "cache_seeds": seeds}
+
+
+def split_snapshot(doc, assign: Callable[[str], Optional[str]]
+                   ) -> Dict[str, dict]:
+    """Partition a verified snapshot by each entry's family owner
+    (``assign(affinity) -> replica-or-None``); each shard is re-sealed
+    so recipients verify integrity end to end.  Entries assigned None
+    (no surviving owner) are dropped."""
+    verify_snapshot(doc)
+    shards: Dict[str, Dict[str, List[dict]]] = {}
+    for section in ("index", "cache"):
+        for entry in doc[section]:
+            owner = assign(entry.get("affinity"))
+            if owner is None:
+                continue
+            shard = shards.setdefault(owner,
+                                      {"index": [], "cache": []})
+            shard[section].append(entry)
+    return {owner: _seal(s["index"], s["cache"])
+            for owner, s in shards.items()}

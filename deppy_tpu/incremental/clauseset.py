@@ -274,17 +274,57 @@ class ClauseSetIndex:
                 if bucket is not None and key in bucket:
                     bucket.move_to_end(key)
                 return
-            self._entries[key] = _Entry(key, rows, vocab, model,
-                                        steps, backtracks)
-            bucket = self._by_vocab.setdefault(vocab, OrderedDict())
-            bucket[key] = None
-            while len(self._entries) > self.capacity:
-                old_key, old = self._entries.popitem(last=False)
-                ob = self._by_vocab.get(old.vocab)
-                if ob is not None:
-                    ob.pop(old_key, None)
-                    if not ob:
-                        del self._by_vocab[old.vocab]
+            self._admit_locked(_Entry(key, rows, vocab, model,
+                                      steps, backtracks))
+
+    def _admit_locked(self, entry: _Entry) -> None:
+        """Insert a NEW entry (caller holds the lock; ``entry.key``
+        not resident) and evict past capacity, keeping ``_entries``
+        and ``_by_vocab`` in sync — the one copy of the eviction
+        invariant, shared by ``store`` and ``import_entry``."""
+        self._entries[entry.key] = entry
+        bucket = self._by_vocab.setdefault(entry.vocab, OrderedDict())
+        bucket[entry.key] = None
+        while len(self._entries) > self.capacity:
+            old_key, old = self._entries.popitem(last=False)
+            ob = self._by_vocab.get(old.vocab)
+            if ob is not None:
+                ob.pop(old_key, None)
+                if not ob:
+                    del self._by_vocab[old.vocab]
+
+    def export_entries(self) -> List[_Entry]:
+        """Every resident entry, least recently used first (so an
+        importer replaying the list reproduces this index's recency
+        order) — the fleet snapshot/handoff surface (ISSUE 15)."""
+        with self._lock:
+            return list(self._entries.values())
+
+    def import_entry(self, key: str, rows: "Counter[tuple]", vocab,
+                     model: np.ndarray, steps: int,
+                     backtracks: int) -> bool:
+        """Admit one deserialized entry (the snapshot handoff path).
+        Returns False without touching anything when ``key`` is already
+        resident — the live entry is at least as fresh as the handed-off
+        copy — or when the entry is not a certified warm seed (the
+        store() gate: only zero-backtrack SAT models may seed warm
+        starts, and a tampered snapshot must not widen that).  Raises
+        ``ValueError`` when the model is not index-aligned with the
+        entry's vocabulary: admitting a misaligned entry would plant a
+        crash on the live warm path for that family's next delta."""
+        if self.capacity == 0 or int(backtracks) != 0:
+            return False
+        model = np.asarray(model, dtype=bool).copy()
+        if model.shape != (int(vocab[0]),):
+            raise ValueError(
+                f"model length {model.size} does not match the entry "
+                f"vocabulary ({vocab[0]} variables)")
+        with self._lock:
+            if key in self._entries:
+                return False
+            self._admit_locked(_Entry(key, rows, vocab, model,
+                                      steps, backtracks))
+        return True
 
     def touch(self, key: str) -> None:
         """Refresh ``key``'s LRU and bucket recency without re-storing.

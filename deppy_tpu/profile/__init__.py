@@ -43,8 +43,8 @@ from .ledger import (
     render_metric_lines,
     sample_rate,
 )
-from .slo import (SLOAccountant, SLOConfig, sanitize_tenant,
-                  slo_config_from_env)
+from .slo import (SLOAccountant, SLOConfig, sanitize_replica,
+                  sanitize_tenant, slo_config_from_env)
 
 __all__ = [
     "DEFAULT_TENANT",
@@ -59,6 +59,7 @@ __all__ = [
     "record_backend_flush",
     "record_device_dispatch",
     "sample_rate",
+    "sanitize_replica",
     "sanitize_tenant",
     "slo_config_from_env",
 ]

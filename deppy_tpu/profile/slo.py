@@ -55,6 +55,18 @@ OVERFLOW_TENANT = "_overflow"
 # charset so a hostile header can never inject exposition syntax.
 _TENANT_RE = re.compile(r"[^A-Za-z0-9._-]+")
 _MAX_TENANT_LEN = 64
+# Replica identities are operator-set (never attacker-controlled), but
+# they still land in label values — same charset plus ':' and '[]' so
+# the conventional host:port spelling survives (ISSUE 15).
+_REPLICA_RE = re.compile(r"[^A-Za-z0-9._:\[\]-]+")
+
+
+def sanitize_replica(raw: Optional[str]) -> Optional[str]:
+    """Serving-identity string → label-safe replica id (None when it
+    sanitizes to nothing)."""
+    if not raw:
+        return None
+    return _REPLICA_RE.sub("", raw.strip())[:_MAX_TENANT_LEN] or None
 
 
 def sanitize_tenant(raw: Optional[str]) -> str:
@@ -143,10 +155,17 @@ class SLOAccountant:
     the same injection pattern the fault and hostpool families use, so
     embedded servers and tests get it without touching a registry."""
 
-    def __init__(self, config: Optional[SLOConfig] = None):
+    def __init__(self, config: Optional[SLOConfig] = None,
+                 replica: Optional[str] = None):
         from ..analysis import lockdep
 
         self.config = config if config is not None else SLOConfig()
+        # Replica identity (ISSUE 15): set from the server's serving
+        # identity (--replica / DEPPY_TPU_REPLICA) so fleet burn rate
+        # is attributable per tenant PER REPLICA when N replicas'
+        # scrapes aggregate.  None (single-process deployments) keeps
+        # the historical tenant-only label set byte for byte.
+        self.replica = sanitize_replica(replica)
         self._lock = lockdep.make_lock("profile.slo")
         self._tenants: Dict[str, _TenantStats] = {}
 
@@ -212,13 +231,15 @@ class SLOAccountant:
         if not snap:
             return []
         lines = []
+        rep = (f',replica="{self.replica}"' if self.replica else "")
 
         def fam(name, kind, help, value_of):
             lines.append(f"# HELP {name} {help}")
             lines.append(f"# TYPE {name} {kind}")
             for tenant, view in snap.items():
                 lines.append(
-                    f'{name}{{tenant="{tenant}"}} {value_of(view)}')
+                    f'{name}{{tenant="{tenant}"{rep}}} '
+                    f"{value_of(view)}")
 
         fam("deppy_tenant_requests_total", "counter",
             "Requests served, by tenant (X-Deppy-Tenant).",

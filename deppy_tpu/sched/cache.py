@@ -253,6 +253,21 @@ class ResultCache:
                 self._size_changed_locked()
         return n
 
+    def export_seeds(self) -> list:
+        """``(key, budget, solution-dict)`` for every definitive SAT
+        entry, least recently used first — the fleet snapshot/handoff
+        surface (ISSUE 15).  UNSAT and Incomplete entries are not
+        exported: cores hold live constraint objects and incompletes
+        are budget-relative; both re-solve cold once on the inheritor.
+        Solution dicts are copied, so the snapshot cannot alias live
+        entries."""
+        out = []
+        with self._lock:
+            for key, e in self._entries.items():
+                if e.definitive and isinstance(e.result, dict):
+                    out.append((key, e.budget, dict(e.result)))
+        return out
+
     def lookup_or_plan(self, problem: Problem, key: str, budget: int):
         """Exact lookup, then the delta tier: returns ``(hit, None)`` on
         an exact hit, ``(MISS, WarmPlan)`` when the incremental index

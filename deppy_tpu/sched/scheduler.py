@@ -164,10 +164,11 @@ class _Group:
     request's queue-wait/dispatch/solve/decode breakdown."""
 
     __slots__ = ("lanes", "enq_t", "size_class", "budget", "event",
-                 "error", "report", "parent", "timing", "speculative")
+                 "error", "report", "parent", "timing", "speculative",
+                 "tenant", "priority")
 
     def __init__(self, lanes: List[_Lane], size_class: int, budget: int,
-                 speculative: bool = False):
+                 speculative: bool = False, priority: int = 1):
         self.lanes = lanes
         self.enq_t = time.monotonic()
         self.size_class = size_class
@@ -181,6 +182,12 @@ class _Group:
         # queue, no submitter waits on its event, and a dispatch failure
         # is a sink event rather than a raised request error.
         self.speculative = speculative
+        # ISSUE 15: groups are single-tenant by construction (one
+        # submit = one request = one tenant), so per-tenant queue
+        # accounting and the priority-ordered flush head key off the
+        # group, not per lane.
+        self.tenant = lanes[0].tenant if lanes else "default"
+        self.priority = priority
 
 
 def _count_lane_outcome(rep, r) -> None:
@@ -557,6 +564,8 @@ class Scheduler:
         portfolio_sample_check: Optional[float] = None,
         speculate: Optional[str] = None,
         speculate_max_backlog: Optional[int] = None,
+        fair: Optional[str] = None,
+        tenant_weights: Optional[str] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -641,7 +650,31 @@ class Scheduler:
             self._racer = PortfolioRacer(
                 "on" if mode in ("on", "1", "true", "yes") else "auto",
                 portfolio_k, portfolio_sample_check, self._registry)
+        # Weighted-fair per-tenant admission + priority lanes (ISSUE
+        # 15).  "off" restores the global-depth-only gate and strict
+        # FIFO flush head byte for byte; "on" (the default) is ALSO
+        # byte-identical while one tenant is queued — the fairness math
+        # only bites under multi-tenant contention.
+        if fair is None:
+            fair = config.env_raw("DEPPY_TPU_SCHED_FAIR", "on")
+        self.fair = str(fair).strip().lower() not in ("off", "0",
+                                                      "false", "no")
+        from .fair import TenantPolicy
+
+        if tenant_weights is None:
+            tenant_weights = config.env_raw(
+                "DEPPY_TPU_SCHED_TENANT_WEIGHTS")
+        self.tenant_policy = TenantPolicy.from_spec(tenant_weights)
+        # Queued lanes per tenant (CV-guarded, live queue only — the
+        # speculative backlog has its own cap and nobody's SLO rides
+        # it).
+        self._tenant_depth: dict = {}
         reg = self._registry
+        self._c_tenant_sheds = reg.counter(
+            "deppy_sched_tenant_sheds_total",
+            "Admissions shed by the weighted-fair per-tenant gate, by "
+            "tenant (the offender's 503s; victims under their share "
+            "keep admitting).", labelname="tenant")
         self._g_depth = reg.gauge(
             "deppy_sched_queue_depth",
             "Problems queued for a coalesced dispatch right now.")
@@ -824,21 +857,53 @@ class Scheduler:
         with self._cv:
             return self._depth
 
-    def admission_retry_after(self) -> Optional[float]:
-        """Seconds a client should back off when the queue is over
-        ``max_depth``, or None to admit — the service mirrors this into
-        its 503 + Retry-After response.  The estimate is the number of
-        flushes needed to drain the backlog times the recent dispatch
-        wall clock (EWMA), floored at 1s."""
+    def admission_retry_after(
+            self, tenant: str = "default") -> Optional[float]:
+        """Seconds a client should back off, or None to admit — the
+        service mirrors this into its 503 + Retry-After response.
+
+        With the fair gate off this is the historical GLOBAL check:
+        shed everyone once total depth reaches ``max_depth``.  With it
+        on (ISSUE 15) the shed is PER TENANT: a tenant sheds once its
+        own queued lanes reach its weighted share of ``max_depth``
+        among the tenants queued right now — a lone tenant's share is
+        the whole queue (identical behavior), while under contention
+        the noisy tenant sheds at its share and the victim's lanes
+        always find room.  A hard GLOBAL backstop at
+        2x ``max_depth`` still bounds the aggregate: per-tenant caps
+        sum to ``max_depth`` for any FIXED tenant set, but
+        X-Deppy-Tenant is client-controlled and sequentially minted
+        fresh tenants could otherwise ratchet total depth to
+        ``max_depth * H(T)`` unbounded (each new tenant's share is
+        computed against the tenants queued at ITS arrival).  The
+        estimate is the number of flushes needed to drain the
+        relevant backlog times the recent dispatch wall clock (EWMA),
+        floored at 1s."""
         if self.max_depth <= 0:
             return None
         with self._cv:
             depth = self._depth
-        if depth < self.max_depth:
-            return None
-        with self._cv:
             ewma = self._dispatch_ewma_s
-        flushes = max(depth / float(self.max_fill), 1.0)
+            if self.fair:
+                t_depth = self._tenant_depth.get(tenant, 0)
+                active = [t for t, n in self._tenant_depth.items()
+                          if n > 0]
+            else:
+                t_depth, active = depth, []
+        if not self.fair:
+            if depth < self.max_depth:
+                return None
+        elif depth >= 2 * self.max_depth:
+            # Aggregate backstop: overload protection (memory, drain
+            # latency) must not depend on client-chosen tenant labels.
+            self._c_tenant_sheds.inc(label=tenant)
+            t_depth = max(t_depth, depth)
+        else:
+            cap = self.tenant_policy.cap(tenant, self.max_depth, active)
+            if t_depth < cap:
+                return None
+            self._c_tenant_sheds.inc(label=tenant)
+        flushes = max(t_depth / float(self.max_fill), 1.0)
         return max(flushes * ewma, 1.0)
 
     # ---------------------------------------------------------------- submit
@@ -906,15 +971,17 @@ class Scheduler:
         report = None
         timing: dict = {}
         groups: List[tuple] = []
+        prio = (self.tenant_policy.priority(tenant) if self.fair
+                else 1)
         if pending:
             groups.append(
                 (pending, self._make_group([lane for _, lane in pending],
-                                           budget)))
+                                           budget, priority=prio)))
         if warm_pending:
             groups.append(
                 (warm_pending,
                  _Group([lane for _, lane in warm_pending],
-                        INCREMENTAL_CLASS, budget)))
+                        INCREMENTAL_CLASS, budget, priority=prio)))
         for _, group in groups:
             self._enqueue(group)
         for grp_pending, group in groups:
@@ -974,11 +1041,13 @@ class Scheduler:
         return results
 
     def _make_group(self, lanes: List[_Lane], budget: int,
-                    speculative: bool = False) -> _Group:
+                    speculative: bool = False,
+                    priority: int = 1) -> _Group:
         from ..engine.driver import _bucket, _cost_proxy
 
         size_class = _bucket(max(_cost_proxy(l.problem) for l in lanes))
-        return _Group(lanes, size_class, budget, speculative=speculative)
+        return _Group(lanes, size_class, budget, speculative=speculative,
+                      priority=priority)
 
     # ------------------------------------------------ speculation (ISSUE 14)
 
@@ -1088,6 +1157,9 @@ class Scheduler:
             if self.running:
                 self._queue.append(group)
                 self._depth += len(group.lanes)
+                self._tenant_depth[group.tenant] = (
+                    self._tenant_depth.get(group.tenant, 0)
+                    + len(group.lanes))
                 self._g_depth.set(self._depth)
                 self._cv.notify_all()
                 return
@@ -1107,6 +1179,7 @@ class Scheduler:
             with self._cv:
                 orphans, self._queue = self._queue, []
                 self._depth = 0
+                self._tenant_depth.clear()
                 self._g_depth.set(0)
                 self._spec_queue = []
                 self._spec_depth = 0
@@ -1146,7 +1219,8 @@ class Scheduler:
                         # window: a pre-solve dispatch here could push
                         # the live flush past max_wait — idle priority
                         # means idle, not "between live flushes".
-                        head_due = self._queue[0].enq_t + self.max_wait_s
+                        head_due = (self._head_locked().enq_t
+                                    + self.max_wait_s)
                         delay = head_due - time.monotonic()
                         self._cv.wait(timeout=max(delay, 0.001))
                         continue
@@ -1186,18 +1260,41 @@ class Scheduler:
             self._g_spec_depth.set(self._spec_depth)
         return take, "spec"
 
+    # A queued group older than this many coalescing windows becomes
+    # the flush head regardless of priority class: a sustained urgent
+    # stream must not starve bulk lanes forever (their submitter
+    # threads block on group.event with no timeout — the historical
+    # FIFO head guaranteed dispatch within ~max_wait).
+    PRIORITY_AGING_WINDOWS = 100
+
+    def _head_locked(self) -> _Group:
+        """The next flush head (caller holds the lock): the oldest
+        group of the most urgent priority class queued (ISSUE 15 —
+        priority lanes; with every group at the default priority this
+        is exactly the historical FIFO head), unless the globally
+        oldest group has aged past PRIORITY_AGING_WINDOWS coalescing
+        windows — starvation beats priority."""
+        oldest = min(self._queue, key=lambda g: g.enq_t)
+        aging_s = max(self.max_wait_s * self.PRIORITY_AGING_WINDOWS,
+                      0.5)
+        if time.monotonic() - oldest.enq_t >= aging_s:
+            return oldest
+        return min(self._queue, key=lambda g: (g.priority, g.enq_t))
+
     def _drain_locked(self, force: bool = False):
         """Pick the flushable group set (caller holds the lock): the
-        oldest group plus every queued group in its size class and
-        budget, up to ``max_fill`` lanes.  Returns ([], None) when no
-        flush is due yet."""
-        head = self._queue[0]
+        priority head plus every queued group in its size class and
+        budget, up to ``max_fill`` lanes.  Coalescing ignores priority
+        — same-class batchmates share the head's dispatch, which is a
+        free ride for them, never a delay for the head.  Returns
+        ([], None) when no flush is due yet."""
+        head = self._head_locked()
         take = [head]
         lanes = len(head.lanes)
-        for g in self._queue[1:]:
+        for g in self._queue:
             if lanes >= self.max_fill:
                 break
-            if (g.size_class == head.size_class
+            if (g is not head and g.size_class == head.size_class
                     and g.budget == head.budget
                     and lanes + len(g.lanes) <= self.max_fill):
                 take.append(g)
@@ -1213,6 +1310,12 @@ class Scheduler:
         taken = set(map(id, take))
         self._queue = [g for g in self._queue if id(g) not in taken]
         self._depth -= lanes
+        for g in take:
+            left = self._tenant_depth.get(g.tenant, 0) - len(g.lanes)
+            if left > 0:
+                self._tenant_depth[g.tenant] = left
+            else:
+                self._tenant_depth.pop(g.tenant, None)
         self._g_depth.set(self._depth)
         return take, reason
 

@@ -250,11 +250,23 @@ class Server:
         portfolio: Optional[str] = None,
         speculate: Optional[str] = None,
         speculate_max_backlog: Optional[int] = None,
+        replica: Optional[str] = None,
+        fair: Optional[str] = None,
+        tenant_weights: Optional[str] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
         self.max_body_bytes = max_body_bytes
         self.metrics = Metrics()
+        # Replica serving identity (ISSUE 15): --replica /
+        # DEPPY_TPU_REPLICA / `replica` config key.  Fleet deployments
+        # set one per process so the SLO families, /debug/slo, and
+        # every request's root span attribute burn rate per tenant PER
+        # REPLICA; unset (single-process) keeps every surface byte-
+        # identical to pre-fleet.
+        if replica is None:
+            replica = config.env_str("DEPPY_TPU_REPLICA")
+        self.replica = profiling.sanitize_replica(replica)
         # Per-tenant SLO accounting (ISSUE 11): tenant identity from
         # X-Deppy-Tenant, targets from the declarative SLO spec
         # (--slo / DEPPY_TPU_SLO: inline JSON, @FILE, or a path).
@@ -265,7 +277,8 @@ class Server:
         # servers that come and go.
         self.slo = profiling.SLOAccountant(
             profiling.slo_config_from_env() if slo is None
-            else profiling.SLOConfig.from_spec(slo))
+            else profiling.SLOConfig.from_spec(slo),
+            replica=self.replica)
         self.metrics.slo = self.slo
         self.ready = threading.Event()
         self._stop = threading.Event()
@@ -294,7 +307,9 @@ class Server:
                 incremental_index_size=incremental_index_size,
                 portfolio=portfolio,
                 speculate=speculate,
-                speculate_max_backlog=speculate_max_backlog)
+                speculate_max_backlog=speculate_max_backlog,
+                fair=fair,
+                tenant_weights=tenant_weights)
         # Fault-domain knobs (ISSUE 2).  request_deadline_s: default
         # wall-clock budget per /v1/resolve (clients override per request
         # via the X-Deppy-Deadline-S header; None = unbounded).  drain_s
@@ -362,27 +377,31 @@ class Server:
         return self._probe.server_address[1]
 
     def admission_retry_after(
-            self, deadline_s: Optional[float]
+            self, deadline_s: Optional[float],
+            tenant: str = "default",
     ) -> Optional[Tuple[float, str]]:
         """Degraded-mode gate for one request: (seconds the client
         should wait before retrying, error text), or None to admit.
         Three unmeetable cases: the request's deadline is already spent
         (a proxy-propagated budget of <= 0), the caller insists on the
         device backend while the accelerator breaker is open, or the
-        scheduler queue is over its depth limit (ISSUE 3: queue depth
-        feeds the same 503 + Retry-After machinery).  An open breaker
-        alone does NOT shed auto/host traffic — the scheduler's queue
-        drains on the host engine in that mode."""
+        scheduler queue is over its depth limit — per TENANT under the
+        weighted-fair gate (ISSUE 15: the noisy tenant sheds at its
+        share while victims under theirs keep admitting), globally with
+        ``DEPPY_TPU_SCHED_FAIR=off``.  An open breaker alone does NOT
+        shed auto/host traffic — the scheduler's queue drains on the
+        host engine in that mode."""
         breaker = faults.default_breaker()
         if deadline_s is not None and deadline_s <= 0:
-            faults.note_deadline_exceeded("service.resolve")
+            faults.note_deadline_exceeded("service.resolve",
+                                          tenant=tenant)
             return (max(breaker.remaining_s(), 1.0),
                     "degraded: request deadline cannot be met")
         if self.backend == "tpu" and breaker.blocks_device():
             return (max(breaker.remaining_s(), 1.0),
                     "degraded: accelerator breaker open")
         if self.scheduler is not None:
-            retry = self.scheduler.admission_retry_after()
+            retry = self.scheduler.admission_retry_after(tenant=tenant)
             if retry is not None:
                 return retry, "overloaded: scheduler queue full"
         return None
@@ -409,7 +428,7 @@ class Server:
         faults.inject("service.resolve")
         if deadline_s is None:
             deadline_s = self.request_deadline_s
-        gate = self.admission_retry_after(deadline_s)
+        gate = self.admission_retry_after(deadline_s, tenant=tenant)
         if gate is not None:
             retry_after, msg = gate
             self.metrics.observe_error()
@@ -674,9 +693,26 @@ def _api_handler(server: Server):
             elif self.path.split("?", 1)[0] == "/debug/slo":
                 # Per-tenant SLO accounting (ISSUE 11): every observed
                 # tenant's counters, window p99 vs target, and
-                # error-budget burn rate.
+                # error-budget burn rate.  Fleet deployments (ISSUE 15)
+                # also see the replica's serving identity, so N
+                # replicas' documents aggregate attributably; without
+                # one the body is byte-identical to pre-fleet.
+                doc = {"slo": server.slo.snapshot()}
+                if server.replica is not None:
+                    doc["replica"] = server.replica
+                self._send(200, json.dumps(doc, sort_keys=True),
+                           "application/json")
+            elif self.path.split("?", 1)[0] == "/debug/warmstate":
+                # Warm-state snapshot export (ISSUE 15): the drain
+                # handoff's read side.  404 with the scheduler off —
+                # there is no warm tier to export.
+                if server.scheduler is None:
+                    self._send_json(404, {"error": "not found"})
+                    return
+                from .fleet import export_warm_state
+
                 self._send(200, json.dumps(
-                    {"slo": server.slo.snapshot()}, sort_keys=True),
+                    export_warm_state(server.scheduler)),
                     "application/json")
             else:
                 self._send_json(404, {"error": "not found"})
@@ -711,6 +747,30 @@ def _api_handler(server: Server):
                     self._resolve_request()
                 finally:
                     server._exit_request()
+                return
+            if self.path == "/debug/warmstate":
+                # Warm-state snapshot import (ISSUE 15): the drain
+                # handoff's write side — a draining replica's shard,
+                # delivered by the router, merges into this replica's
+                # clause-set index and exact cache (live state wins).
+                if server.scheduler is None:
+                    self._send_json(404, {"error": "not found"})
+                    return
+                doc, err = self._read_json_body()
+                if err is not None:
+                    return
+                from .fleet import SnapshotFormatError, import_warm_state
+
+                server._enter_request()
+                try:
+                    out = import_warm_state(server.scheduler, doc)
+                except SnapshotFormatError as e:
+                    server.metrics.observe_error()
+                    self._send_json(400, {"error": str(e)})
+                    return
+                finally:
+                    server._exit_request()
+                self._send_json(200, {"imported": out})
                 return
             if self.path in ("/v1/catalog/publish", "/v1/resolve/preview"):
                 # Speculative pre-resolution (ISSUE 14): the publish
@@ -866,10 +926,16 @@ def _api_handler(server: Server):
                 # request_id rides the root span's attrs so `deppy
                 # trace CLIENT-ID` resolves from live sink lines alone
                 # (no flight-recorder dump required).
+                # Replica identity rides the root span only when set
+                # (ISSUE 15): replica-free deployments keep their
+                # pre-fleet span attrs byte for byte.
+                span_attrs = {"path": "/v1/resolve",
+                              "request_id": ctx.request_id,
+                              "tenant": tenant}
+                if server.replica is not None:
+                    span_attrs["replica"] = server.replica
                 with telemetry.trace.activate(ctx), \
-                        reg.span("service.request", path="/v1/resolve",
-                                 request_id=ctx.request_id,
-                                 tenant=tenant) as sp:
+                        reg.span("service.request", **span_attrs) as sp:
                     status = self._resolve_request_inner(
                         t0, timings, want_timings, tenant, request_stats)
                     sp["status"] = status
@@ -989,6 +1055,9 @@ def serve(
     portfolio: Optional[str] = None,
     speculate: Optional[str] = None,
     speculate_max_backlog: Optional[int] = None,
+    replica: Optional[str] = None,
+    fair: Optional[str] = None,
+    tenant_weights: Optional[str] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -1006,7 +1075,9 @@ def serve(
                  incremental_max_delta=incremental_max_delta,
                  incremental_index_size=incremental_index_size,
                  slo=slo, portfolio=portfolio, speculate=speculate,
-                 speculate_max_backlog=speculate_max_backlog)
+                 speculate_max_backlog=speculate_max_backlog,
+                 replica=replica, fair=fair,
+                 tenant_weights=tenant_weights)
     srv.start()
     stop = threading.Event()
 

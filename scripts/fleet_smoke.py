@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""Fleet soak/chaos smoke test (`make fleet-smoke`, ISSUE 15).
+
+Boots a local 3-replica fleet (in-process servers, host backend)
+behind the affinity router plus a single-replica reference, then
+drives the acceptance surface end to end as one sustained scenario:
+
+  * **churn soak + byte-identity** — sustained mixed-tenant churn
+    (one-row family deltas, rotating tenants) through the router;
+    every response byte-identical to the reference server, fleet-wide
+    warm-hit ratio >= 0.9 under affinity routing;
+  * **publish burst** — a catalog publish through the router fans out
+    to EVERY replica's speculative tier;
+  * **replica kill** — one replica dies mid-soak; its in-flight
+    requests retry once on the ring successor (clients see 200s, the
+    router's breaker marks it dead), and the family's churn keeps
+    serving;
+  * **drain handoff** — a second replica drains: its warm state splits
+    across the arc inheritors via /fleet/drain, the drained replica
+    leaves the rotation, and the inherited family's next delta serves
+    WARM on the inheritor (no cold re-solve);
+  * **noisy-tenant fairness** — under injected dispatch latency and a
+    tiny queue depth, a flooding tenant is shed by the weighted-fair
+    gate while the victim tenant (priority lane) stays under its SLO
+    with zero 503s.
+
+Fast on purpose: host backend, no device compile — the subsystem suite
+is ``make test-fleet`` (tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.client import HTTPConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+FAMILIES = 8
+BUNDLES = 6
+BSIZE = 6
+ROUNDS = 12  # warm-hit ceiling is (ROUNDS-1)/ROUNDS; 12 -> 0.9167
+TENANTS = ("alpha", "beta", "gamma")
+
+
+def request(port, method, path, body=None, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=60)
+    h = dict(headers or {})
+    if body is not None:
+        h.setdefault("Content-Type", "application/json")
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=h)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def metric(text: str, name: str):
+    total = None
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            total = (total or 0.0) + float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def family_doc(name: str, tgts: dict) -> dict:
+    """Disconnected-bundle family; ``tgts[b]`` churns bundle b's
+    mid-chain dependency (one-row delta, one-bundle cone)."""
+    variables = []
+    for b in range(BUNDLES):
+        for j in range(BSIZE):
+            cons = []
+            if j == 0:
+                cons.append({"type": "mandatory"})
+                cons.append({"type": "dependency",
+                             "ids": [f"{name}b{b}v1"]})
+            elif j == 1:
+                cons.append({"type": "dependency",
+                             "ids": [f"{name}b{b}v{tgts.get(b, 2)}"]})
+            elif j < BSIZE - 1:
+                cons.append({"type": "dependency",
+                             "ids": [f"{name}b{b}v{j + 1}"]})
+            variables.append({"id": f"{name}b{b}v{j}",
+                              "constraints": cons})
+    return {"variables": variables}
+
+
+def mutate(tgts: dict, rnd: int) -> None:
+    b = rnd % BUNDLES
+    tgts[b] = 2 + (tgts.get(b, 2) - 2 + 1) % (BSIZE - 2)
+
+
+def fleet_metric(replicas, name) -> float:
+    total = 0.0
+    for srv in replicas:
+        _, m = request(srv.api_port, "GET", "/metrics")
+        total += metric(m.decode(), name) or 0.0
+    return total
+
+
+def main() -> int:
+    from deppy_tpu import faults
+    from deppy_tpu.fleet import Router, doc_affinity_keys
+    from deppy_tpu.service import Server
+    from deppy_tpu.telemetry import percentile
+
+    def boot(i):
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host",
+                     replica=f"rep{i}")
+        srv.start()
+        return srv
+
+    replicas = [boot(i) for i in range(3)]
+    addrs = [f"127.0.0.1:{s.api_port}" for s in replicas]
+    router = Router(bind_address="127.0.0.1:0", replicas=addrs,
+                    probe_interval_s=0.2, probe_failures=2)
+    router.start()
+    reference = Server(bind_address="127.0.0.1:0",
+                       probe_address="127.0.0.1:0", backend="host")
+    reference.start()
+    killed = drained = None
+    try:
+        # ---- phase 1: mixed-tenant churn soak + byte identity -------
+        states = [dict() for _ in range(FAMILIES)]
+        latencies = []
+        for rnd in range(ROUNDS):
+            for f in range(FAMILIES):
+                if rnd:
+                    mutate(states[f], rnd - 1)
+                doc = family_doc(f"f{f}.", states[f])
+                hdrs = {"X-Deppy-Tenant": TENANTS[f % len(TENANTS)]}
+                t0 = time.perf_counter()
+                s1, b1 = request(router.api_port, "POST",
+                                 "/v1/resolve", doc, hdrs)
+                latencies.append(time.perf_counter() - t0)
+                s2, b2 = request(reference.api_port, "POST",
+                                 "/v1/resolve", doc, hdrs)
+                assert s1 == s2 == 200, (rnd, f, s1, s2, b1[:200])
+                assert b1 == b2, (
+                    f"round {rnd} family {f}: fleet response diverges "
+                    f"from single replica\nfleet: {b1!r}\none:   {b2!r}")
+        warm = fleet_metric(replicas, "deppy_cache_hits_total") \
+            + fleet_metric(replicas, "deppy_incremental_hits_total")
+        asks = fleet_metric(replicas, "deppy_cache_hits_total") \
+            + fleet_metric(replicas, "deppy_cache_misses_total")
+        warm_ratio = warm / max(asks, 1.0)
+        p99 = percentile(sorted(latencies), 99)
+        assert warm_ratio >= 0.9, (
+            f"affinity warm-hit ratio {warm_ratio:.3f} < 0.9 "
+            f"(warm={warm} asks={asks})")
+
+        # ---- phase 2: publish burst fans out fleet-wide -------------
+        delta = {"updates": [{"id": "f0.b0v1", "constraints": [
+            {"type": "dependency", "ids": ["f0.b0v2"]}]}]}
+        s, body = request(router.api_port, "POST",
+                          "/v1/catalog/publish", delta)
+        assert s == 200, (s, body)
+        merged = json.loads(body)["publish"]
+        assert merged["replicas"] == 3 and merged["errors"] == 0, merged
+        for srv in replicas:
+            _, m = request(srv.api_port, "GET", "/metrics")
+            pubs = metric(m.decode(),
+                          "deppy_speculate_publishes_total")
+            assert pubs and pubs >= 1, (
+                "publish did not reach every replica's speculative "
+                "tier")
+
+        # ---- phase 3: replica kill -> retry on successor ------------
+        probe = family_doc("f1.", states[1])
+        owner = router.target_for(doc_affinity_keys(probe)[0])
+        killed = replicas[addrs.index(owner)]
+        killed.shutdown()
+        ok = 0
+        for f in range(FAMILIES):
+            mutate(states[f], ROUNDS - 1)
+            doc = family_doc(f"f{f}.", states[f])
+            s, body = request(router.api_port, "POST", "/v1/resolve",
+                              doc)
+            assert s == 200, (
+                f"request after replica kill failed: {s} {body[:200]}")
+            ok += 1
+        _, m = request(router.api_port, "GET", "/metrics")
+        rtext = m.decode()
+        assert (metric(rtext, "deppy_fleet_retries_total") or 0) >= 1 \
+            or (metric(rtext, "deppy_fleet_replica_transitions_total")
+                or 0) >= 1, rtext
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if any(st["dead"] for st in router.replica_states()):
+                break
+            time.sleep(0.05)
+        assert any(st["dead"] for st in router.replica_states()), (
+            "router never marked the killed replica dead")
+
+        # ---- phase 4: drain handoff -> warm recovery ----------------
+        survivors = [a for a in addrs
+                     if a != owner]
+        drain_addr = survivors[0]
+        s, body = request(router.api_port, "POST", "/fleet/drain",
+                          {"replica": drain_addr})
+        assert s == 200, (s, body)
+        out = json.loads(body)["drain"]
+        assert out["handed_off"] >= 1, out
+        drained = replicas[addrs.index(drain_addr)]
+        warm_before = fleet_metric(
+            [r for r in replicas if r not in (killed, drained)],
+            "deppy_incremental_hits_total")
+        served_warm = 0
+        for f in range(FAMILIES):
+            mutate(states[f], ROUNDS)
+            doc = family_doc(f"f{f}.", states[f])
+            s, body = request(router.api_port, "POST", "/v1/resolve",
+                              doc)
+            assert s == 200, (s, body[:200])
+        warm_after = fleet_metric(
+            [r for r in replicas if r not in (killed, drained)],
+            "deppy_incremental_hits_total")
+        served_warm = warm_after - warm_before
+        assert served_warm >= 1, (
+            "post-drain churn never warm-served on the inheritors — "
+            "the handoff lost the warm tier")
+        drained.shutdown()
+
+        # ---- phase 5: noisy-tenant fairness -------------------------
+        os.environ["DEPPY_TPU_SCHED_MAX_DEPTH"] = "8"
+        faults.configure_plan(faults.plan_from_spec(json.dumps([
+            {"point": "sched.dispatch", "kind": "latency",
+             "latency_s": 0.1, "times": -1}])))
+        fair_srv = Server(
+            bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+            backend="host",
+            tenant_weights=json.dumps(
+                {"victim": {"weight": 1, "priority": 0},
+                 "noisy": {"weight": 1, "priority": 1}}))
+        fair_srv.start()
+        try:
+            stop = threading.Event()
+
+            def flood(tid: int):
+                # Every flood request is a FRESH family: repeats would
+                # serve from the exact cache without queueing and the
+                # flood would never back the queue up.
+                i = 0
+                while not stop.is_set():
+                    doc = family_doc(f"noise{tid}x{i}.", {})
+                    request(fair_srv.api_port, "POST", "/v1/resolve",
+                            doc, {"X-Deppy-Tenant": "noisy"})
+                    i += 1
+
+            threads = [threading.Thread(target=flood, args=(tid,),
+                                        daemon=True)
+                       for tid in range(10)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # let the flood back the queue up
+            victim_lat = []
+            victim_bad = 0
+            for i in range(12):
+                doc = family_doc(f"victim{i}.", {})
+                t0 = time.perf_counter()
+                s, _ = request(fair_srv.api_port, "POST",
+                               "/v1/resolve", doc,
+                               {"X-Deppy-Tenant": "victim"})
+                victim_lat.append(time.perf_counter() - t0)
+                if s != 200:
+                    victim_bad += 1
+            stop.set()
+            for t in threads:
+                t.join(10.0)
+
+            _, m = request(fair_srv.api_port, "GET", "/metrics")
+            text = m.decode()
+
+            def sheds(tenant: str) -> float:
+                prefix = ('deppy_sched_tenant_sheds_total'
+                          f'{{tenant="{tenant}"}} ')
+                return sum(float(line.rsplit(" ", 1)[1])
+                           for line in text.splitlines()
+                           if line.startswith(prefix))
+
+            victim_p99 = percentile(sorted(victim_lat), 99)
+            assert victim_bad == 0, (
+                f"victim tenant saw {victim_bad} non-200s under the "
+                f"noisy flood — fairness gate failed")
+            assert sheds("victim") == 0, (
+                f"victim tenant was shed {sheds('victim')}x")
+            noisy_shed_n = sheds("noisy")
+            assert noisy_shed_n >= 1, (
+                f"noisy tenant was never shed\n{text}")
+            assert victim_p99 < 1.0, (
+                f"victim p99 {victim_p99:.3f}s blew the default SLO "
+                f"target under the noisy flood")
+        finally:
+            faults.configure_plan(None)
+            os.environ.pop("DEPPY_TPU_SCHED_MAX_DEPTH", None)
+            fair_srv.shutdown()
+
+        print(f"fleet-smoke: PASS ({ROUNDS}x{FAMILIES} mixed-tenant "
+              f"churn byte-identical via 3-replica fleet, warm-hit "
+              f"{warm_ratio:.3f}, soak p99 {p99 * 1e3:.1f}ms; publish "
+              f"fanned out to 3 replicas; replica kill survived with "
+              f"retry; drain handed off {out['handed_off']} entries "
+              f"and churn re-warmed ({int(served_warm)} warm serve(s))"
+              f"; noisy tenant shed {int(noisy_shed_n)}x while victim "
+              f"p99 {victim_p99 * 1e3:.0f}ms with 0 errors)")
+        return 0
+    finally:
+        router.shutdown()
+        for srv in replicas + [reference]:
+            if srv in (killed, drained):
+                continue
+            try:
+                srv.shutdown()
+            except Exception:
+                pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -758,7 +758,12 @@ class TestSchedulerEwmaRegression:
         monkeypatch.setattr(sch, "_solve_lanes",
                             lambda lanes, timing=None: None)
         with sch._cv:
-            sch._depth = 8  # over max_depth: admission estimates engage
+            # Over max_depth: admission estimates engage.  A real
+            # single-tenant backlog keeps the per-tenant ledger in
+            # sync with the global depth (the ISSUE 15 fair gate
+            # reads it), so the simulation pokes both.
+            sch._depth = 8
+            sch._tenant_depth["default"] = 8
 
         stop = threading.Event()
         errors = []
@@ -788,5 +793,6 @@ class TestSchedulerEwmaRegression:
             ewma = sch._dispatch_ewma_s
             sch._dispatch_ewma_s = 2.0
             sch._depth = sch.max_fill * 4
+            sch._tenant_depth["default"] = sch.max_fill * 4
         assert ewma != 0.05
         assert sch.admission_retry_after() == pytest.approx(8.0)

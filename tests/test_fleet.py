@@ -1,0 +1,655 @@
+"""Replica fleet with warm-state affinity routing (ISSUE 15).
+
+The acceptance surface, from the issue:
+
+  * a 3-replica fleet behind the affinity router serves a mixed
+    request stream byte-identical to a single replica;
+  * the affinity key is FAMILY-stable (churn deltas of one family land
+    on one replica) and the ring reassigns only a removed replica's
+    arcs;
+  * the warm-state snapshot round-trips (index entries plan warm
+    starts on the importer, cache seeds hit) and is integrity-checked;
+  * killing a replica degrades only requests routed to it — by one
+    retry on the ring successor, never to a client-visible error — and
+    a drain hands warm state to the arc inheritors so the family's
+    next delta serves warm instead of cold;
+  * the weighted-fair admission gate sheds only the tenant over its
+    share (the global-depth 503 replacement) and priority lanes order
+    the flush head;
+  * trace identity (traceparent / X-Deppy-Request-Id / X-Deppy-Tenant)
+    survives the router hop.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from http.client import HTTPConnection
+
+import pytest
+
+from deppy_tpu import faults, telemetry
+from deppy_tpu.fleet import (HashRing, Router, SnapshotFormatError,
+                             affinity_key, doc_affinity_keys,
+                             export_warm_state, import_warm_state)
+from deppy_tpu.fleet.snapshot import split_snapshot, verify_snapshot
+from deppy_tpu.sched import Scheduler
+from deppy_tpu.sched.fair import TenantPolicy
+from deppy_tpu.sched.scheduler import _Group, _Lane
+from deppy_tpu.service import Server
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def fresh_fault_state():
+    prev_breaker = faults.set_default_breaker(faults.CircuitBreaker())
+    prev_plan = faults.configure_plan(None)
+    prev_reg = telemetry.set_default_registry(telemetry.Registry())
+    yield
+    telemetry.set_default_registry(prev_reg)
+    faults.configure_plan(prev_plan)
+    faults.set_default_breaker(prev_breaker)
+
+
+# --------------------------------------------------------------- helpers
+
+
+def _family_doc(name: str, state: int = 0, bundles: int = 5,
+                size: int = 5) -> dict:
+    """One family's /v1/resolve document: ``bundles`` DISCONNECTED
+    dependency chains sharing one vocabulary.  ``state`` rotates one
+    mid-chain dependency inside bundle 0 only, so consecutive states
+    are one-row deltas of the SAME family (same ids, same affinity
+    key) whose touched cone is one bundle — the shape the incremental
+    tier warm-serves."""
+    variables = []
+    for b in range(bundles):
+        for j in range(size):
+            cons = []
+            if j == 0:
+                cons.append({"type": "mandatory"})
+                cons.append({"type": "dependency",
+                             "ids": [f"{name}b{b}v1"]})
+            elif j == 1 and b == 0:
+                tgt = 2 + state % (size - 2)
+                cons.append({"type": "dependency",
+                             "ids": [f"{name}b0v{tgt}"]})
+            elif j < size - 1:
+                cons.append({"type": "dependency",
+                             "ids": [f"{name}b{b}v{j + 1}"]})
+            variables.append({"id": f"{name}b{b}v{j}",
+                              "constraints": cons})
+    return {"variables": variables}
+
+
+def _request(port, method, path, body=None, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    h = dict(headers or {})
+    payload = None
+    if body is not None:
+        payload = json.dumps(body)
+        h.setdefault("Content-Type", "application/json")
+    conn.request(method, path, body=payload, headers=h)
+    resp = conn.getresponse()
+    data = resp.read()
+    hdrs = {k: v for k, v in resp.getheaders()}
+    conn.close()
+    return resp.status, data, hdrs
+
+
+def _metric(text: str, name: str):
+    total = None
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            total = (total or 0.0) + float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _host_server(**kw):
+    srv = Server(bind_address="127.0.0.1:0",
+                 probe_address="127.0.0.1:0", backend="host", **kw)
+    srv.start()
+    return srv
+
+
+# ------------------------------------------------------------------ ring
+
+
+class TestRing:
+    def test_affinity_key_is_family_stable(self):
+        a = _family_doc("f", state=0)
+        b = _family_doc("f", state=2)
+        ka = doc_affinity_keys(a)
+        kb = doc_affinity_keys(b)
+        assert ka == kb  # churn delta, same family -> same key
+        assert ka != doc_affinity_keys(_family_doc("g"))
+
+    def test_affinity_key_order_sensitive(self):
+        assert affinity_key(["a", "b"]) != affinity_key(["b", "a"])
+        # No separator aliasing between adjacent identifiers.
+        assert affinity_key(["ab", "c"]) != affinity_key(["a", "bc"])
+
+    def test_batch_doc_keys(self):
+        doc = {"problems": [_family_doc("x"), _family_doc("y")]}
+        keys = doc_affinity_keys(doc)
+        assert len(keys) == 2 and keys[0] != keys[1]
+        assert doc_affinity_keys({"nope": 1}) == [None]
+
+    def test_route_deterministic_and_exclusion_moves_only_dead_arcs(self):
+        ring = HashRing(["a", "b", "c"])
+        keys = [affinity_key([f"k{i}"]) for i in range(200)]
+        owners = {k: ring.route(k) for k in keys}
+        assert owners == {k: ring.route(k) for k in keys}
+        assert set(owners.values()) == {"a", "b", "c"}
+        moved = 0
+        for k, owner in owners.items():
+            after = ring.route(k, exclude={"b"})
+            if owner != "b":
+                assert after == owner  # surviving arcs are untouched
+            else:
+                assert after in ("a", "c")
+                moved += 1
+        assert moved > 0
+
+    def test_successor_is_distinct(self):
+        ring = HashRing(["a", "b", "c"])
+        k = affinity_key(["k"])
+        owner = ring.route(k)
+        assert ring.successor(k, owner) != owner
+        assert ring.route(k, exclude={"a", "b", "c"}) is None
+
+    def test_requires_replicas(self):
+        with pytest.raises(ValueError):
+            HashRing([])
+
+
+# -------------------------------------------------------------- snapshot
+
+
+class TestSnapshot:
+    def _warm_scheduler(self):
+        sched = Scheduler(backend="host", speculate="off",
+                          portfolio="off")
+        from deppy_tpu import io as problem_io
+
+        fam = problem_io.problems_from_document(_family_doc("s"))[0]
+        fam2 = problem_io.problems_from_document(
+            _family_doc("s", state=1))[0]
+        sched.submit([fam])
+        sched.submit([fam2])
+        return sched
+
+    def test_round_trip(self):
+        src = self._warm_scheduler()
+        try:
+            snap = export_warm_state(src)
+            assert snap["version"] == 1
+            assert len(snap["index"]) >= 1
+            assert len(snap["cache"]) >= 1
+            assert all(e["affinity"] for e in snap["index"])
+            # JSON round trip: exactly what the HTTP handoff ships.
+            snap = json.loads(json.dumps(snap))
+            dst = Scheduler(backend="host", speculate="off",
+                            portfolio="off")
+            out = import_warm_state(dst, snap)
+            assert out["index_imported"] == len(snap["index"])
+            assert out["cache_seeds"] == len(snap["cache"])
+            # The imported exact seed hits without a solve...
+            from deppy_tpu import io as problem_io
+            from deppy_tpu.sched.cache import MISS, fingerprint
+            from deppy_tpu.sat.encode import encode
+
+            fam = problem_io.problems_from_document(_family_doc("s"))[0]
+            p = encode(fam)
+            budget = snap["cache"][0]["budget"]
+            hit = dst.cache.lookup(fingerprint(p), budget)
+            assert hit is not MISS
+            # ...and the imported index entry plans a warm start for
+            # the family's NEXT delta (the handoff's whole point).
+            nxt = encode(problem_io.problems_from_document(
+                _family_doc("s", state=2))[0])
+            plan = dst.incremental.plan(nxt, fingerprint(nxt),
+                                        1 << 24)
+            assert plan is not None
+            # Re-import skips resident entries (live state wins).
+            again = import_warm_state(dst, snap)
+            assert again["index_imported"] == 0
+            assert again["index_skipped"] == len(snap["index"])
+        finally:
+            src.stop()
+
+    def test_integrity_and_version_checks(self):
+        src = self._warm_scheduler()
+        try:
+            snap = export_warm_state(src)
+            tampered = json.loads(json.dumps(snap))
+            tampered["cache"] = []
+            with pytest.raises(SnapshotFormatError):
+                verify_snapshot(tampered)
+            skewed = json.loads(json.dumps(snap))
+            skewed["version"] = 99
+            with pytest.raises(SnapshotFormatError):
+                verify_snapshot(skewed)
+            with pytest.raises(SnapshotFormatError):
+                verify_snapshot(["not", "an", "object"])
+            dst = Scheduler(backend="host", speculate="off",
+                            portfolio="off")
+            with pytest.raises(SnapshotFormatError):
+                import_warm_state(dst, tampered)
+        finally:
+            src.stop()
+
+    def test_split_by_owner(self):
+        src = self._warm_scheduler()
+        try:
+            snap = export_warm_state(src)
+            shards = split_snapshot(snap, lambda aff: "r1")
+            assert set(shards) == {"r1"}
+            verify_snapshot(shards["r1"])  # re-sealed
+            assert split_snapshot(snap, lambda aff: None) == {}
+        finally:
+            src.stop()
+
+    def test_import_rejects_nonzero_backtracks(self):
+        """A tampered snapshot must not widen the zero-backtrack warm
+        certification gate."""
+        import numpy as np
+        from collections import Counter
+
+        from deppy_tpu.incremental import ClauseSetIndex
+
+        idx = ClauseSetIndex()
+        ok = idx.import_entry("k", Counter({("c", 0, 1): 1}),
+                              (1, ("a",)), np.ones(1, dtype=bool),
+                              10, backtracks=3)
+        assert ok is False and len(idx) == 0
+
+    def test_import_rejects_misaligned_model(self):
+        """The snapshot checksum has no secret — anyone can seal a
+        document — so import must validate that a model is
+        index-aligned with its vocabulary: admitting a misaligned
+        entry would plant a crash on the live warm path for that
+        family's next delta."""
+        import numpy as np
+        from collections import Counter
+
+        from deppy_tpu.incremental import ClauseSetIndex
+
+        idx = ClauseSetIndex()
+        with pytest.raises(ValueError):
+            idx.import_entry("k", Counter({("c", 0, 1): 1}),
+                             (3, ("a", "b", "c")),
+                             np.ones(1, dtype=bool), 10, backtracks=0)
+        assert len(idx) == 0
+
+
+# ------------------------------------------------------- fair admission
+
+
+class TestFairAdmission:
+    def test_policy_spec(self):
+        pol = TenantPolicy.from_spec(
+            '{"gold": {"weight": 3, "priority": 0}, "bulk": 1, '
+            '"default": {"weight": 2}}')
+        assert pol.weight("gold") == 3 and pol.priority("gold") == 0
+        assert pol.weight("bulk") == 1 and pol.priority("bulk") == 1
+        assert pol.weight("stranger") == 2
+        assert pol.cap("gold", 100, {"bulk"}) == pytest.approx(75.0)
+        with pytest.raises(ValueError):
+            TenantPolicy.from_spec('{"a": {"weight": -1}}')
+        with pytest.raises(ValueError):
+            TenantPolicy.from_spec('["not", "a", "mapping"]')
+
+    def test_noisy_tenant_sheds_victim_admits(self):
+        sched = Scheduler(backend="host", max_depth=100, fair="on",
+                          speculate="off", portfolio="off")
+        with sched._cv:
+            sched._tenant_depth.update({"noisy": 60, "victim": 2})
+            sched._depth = 62
+        assert sched.admission_retry_after(tenant="noisy") is not None
+        assert sched.admission_retry_after(tenant="victim") is None
+        lines = "\n".join(sched._registry.render_lines())
+        assert 'deppy_sched_tenant_sheds_total{tenant="noisy"} 1' \
+            in lines
+
+    def test_single_tenant_matches_global_gate(self):
+        sched = Scheduler(backend="host", max_depth=10, fair="on",
+                          speculate="off", portfolio="off")
+        with sched._cv:
+            sched._tenant_depth["solo"] = 9
+            sched._depth = 9
+        assert sched.admission_retry_after(tenant="solo") is None
+        with sched._cv:
+            sched._tenant_depth["solo"] = 10
+            sched._depth = 10
+        assert sched.admission_retry_after(tenant="solo") is not None
+
+    def test_fair_off_restores_global_gate(self):
+        sched = Scheduler(backend="host", max_depth=10, fair="off",
+                          speculate="off", portfolio="off")
+        with sched._cv:
+            sched._depth = 10
+        # Global: EVERY tenant sheds, share or no share.
+        assert sched.admission_retry_after(tenant="victim") is not None
+
+    def test_minted_tenants_hit_global_backstop(self):
+        """X-Deppy-Tenant is client-controlled: sequentially minted
+        fresh tenants must not ratchet aggregate depth unbounded (each
+        new tenant's share is computed against the tenants queued at
+        ITS arrival).  At 2x max_depth EVERYONE sheds, share or no
+        share."""
+        sched = Scheduler(backend="host", max_depth=10, fair="on",
+                          speculate="off", portfolio="off")
+        with sched._cv:
+            sched._tenant_depth.update(
+                {f"mint{i}": 2 for i in range(10)})
+            sched._depth = 20
+        # A brand-new tenant's weighted share (10/11 of max_depth) is
+        # nowhere near filled — the backstop sheds it anyway.
+        assert sched.admission_retry_after(tenant="fresh") is not None
+        lines = "\n".join(sched._registry.render_lines())
+        assert 'deppy_sched_tenant_sheds_total{tenant="fresh"} 1' \
+            in lines
+
+    def test_depth_accounting_through_dispatch(self):
+        from deppy_tpu import io as problem_io
+
+        sched = Scheduler(backend="host", speculate="off",
+                          portfolio="off")
+        sched.start()
+        try:
+            fam = problem_io.problems_from_document(
+                _family_doc("acct"))[0]
+            sched.submit([fam], tenant="t1")
+            with sched._cv:
+                assert sched._tenant_depth.get("t1", 0) == 0
+        finally:
+            sched.stop()
+
+
+class TestPriorityLanes:
+    def test_priority_head_precedes_older_bulk(self):
+        sched = Scheduler(
+            backend="host", speculate="off", portfolio="off",
+            fair="on",
+            tenant_weights='{"gold": {"weight": 1, "priority": 0}}')
+        bulk = _Group([_Lane(None, "k1", None, 1, None,
+                             tenant="bulk")], 4, 1, priority=1)
+        time.sleep(0.002)
+        gold = _Group([_Lane(None, "k2", None, 1, None,
+                             tenant="gold")], 8, 1, priority=0)
+        sched._queue = [bulk, gold]
+        with sched._cv:
+            assert sched._head_locked() is gold
+            sched._depth = 2
+            sched._tenant_depth.update({"bulk": 1, "gold": 1})
+            take, reason = sched._drain_locked(force=True)
+        assert take[0] is gold and reason == "drain"
+        with sched._cv:
+            assert sched._tenant_depth == {"bulk": 1}
+
+    def test_aged_bulk_beats_sustained_urgent(self):
+        """Starvation guard: a bulk group older than the aging bound
+        becomes head despite a queued urgent group — a sustained
+        priority-0 stream must not park a bulk submitter (blocked on
+        group.event with no timeout) forever."""
+        sched = Scheduler(backend="host", speculate="off",
+                          portfolio="off", fair="on")
+        bulk = _Group([_Lane(None, "k1", None, 1, None,
+                             tenant="bulk")], 4, 1, priority=1)
+        gold = _Group([_Lane(None, "k2", None, 1, None,
+                             tenant="gold")], 8, 1, priority=0)
+        bulk.enq_t -= max(
+            sched.max_wait_s * sched.PRIORITY_AGING_WINDOWS, 0.5) + 0.1
+        sched._queue = [bulk, gold]
+        with sched._cv:
+            assert sched._head_locked() is bulk
+
+    def test_default_priorities_keep_fifo(self):
+        sched = Scheduler(backend="host", speculate="off",
+                          portfolio="off")
+        a = _Group([_Lane(None, "k1", None, 1, None)], 4, 1)
+        time.sleep(0.002)
+        b = _Group([_Lane(None, "k2", None, 1, None)], 4, 1)
+        sched._queue = [a, b]
+        with sched._cv:
+            assert sched._head_locked() is a
+
+
+# ----------------------------------------------------------- slo replica
+
+
+class TestReplicaIdentity:
+    def test_slo_lines_carry_replica_label(self):
+        from deppy_tpu.profile import SLOAccountant
+
+        acc = SLOAccountant(replica="127.0.0.1:8080")
+        acc.observe("tenant1", 0.01)
+        lines = "\n".join(acc.render_metric_lines())
+        assert ('deppy_tenant_requests_total{tenant="tenant1",'
+                'replica="127.0.0.1:8080"} 1') in lines
+        bare = SLOAccountant()
+        bare.observe("tenant1", 0.01)
+        assert 'deppy_tenant_requests_total{tenant="tenant1"} 1' \
+            in "\n".join(bare.render_metric_lines())
+
+    def test_debug_slo_reports_replica(self):
+        srv = _host_server(replica="r-1")
+        try:
+            _request(srv.api_port, "POST", "/v1/resolve",
+                     _family_doc("slo"))
+            status, body, _ = _request(srv.api_port, "GET",
+                                       "/debug/slo")
+            doc = json.loads(body)
+            assert status == 200 and doc["replica"] == "r-1"
+        finally:
+            srv.shutdown()
+
+
+# ------------------------------------------------------------- fleet e2e
+
+
+class TestFanOutFailure:
+    def test_all_transport_failures_answer_503(self):
+        """A fan-out reaching ZERO replicas must not render success:
+        a 200 publish with no recipients reads as "delta propagated"
+        (and a 200 empty preview as "no impact") when nothing was
+        reached."""
+        router = Router(bind_address="127.0.0.1:0",
+                        replicas="127.0.0.1:9",  # nothing listens
+                        probe_interval_s=0, probe_failures=100)
+        router.start()
+        try:
+            for path in ("/v1/catalog/publish", "/v1/resolve/preview"):
+                status, body, _ = _request(router.api_port, "POST",
+                                           path, {"updates": []})
+                assert status == 503, (path, status, body)
+                assert b"no replica reachable" in body
+        finally:
+            router.shutdown()
+
+
+@pytest.fixture
+def fleet():
+    """Three replicas + affinity router + a single reference server.
+    The router's prober is slowed way down so the kill test exercises
+    the FORWARD-failure retry path deterministically."""
+    replicas = [_host_server(replica=f"rep{i}") for i in range(3)]
+    addrs = [f"127.0.0.1:{s.api_port}" for s in replicas]
+    router = Router(bind_address="127.0.0.1:0", replicas=addrs,
+                    probe_interval_s=60.0, probe_failures=2)
+    router.start()
+    reference = _host_server()
+    try:
+        yield replicas, addrs, router, reference
+    finally:
+        router.shutdown()
+        for s in replicas + [reference]:
+            try:
+                s.shutdown()
+            # deppy: lint-ok[exception-hygiene] teardown of an already-killed replica
+            except Exception:
+                pass
+
+
+class TestFleetEndToEnd:
+    def test_three_replicas_byte_identical_to_one(self, fleet):
+        replicas, addrs, router, reference = fleet
+        stream = []
+        for i in range(5):
+            for state in range(3):
+                stream.append(_family_doc(f"fam{i}", state))
+        stream.append({"problems": [_family_doc(f"fam{i}")
+                                    for i in range(5)]})
+        stream.append({"variables": "malformed"})
+        stream.append({"variables": [
+            {"id": "u1", "constraints": [{"type": "mandatory"},
+                                         {"type": "prohibited"}]}]})
+        for doc in stream:
+            s1, b1, _ = _request(router.api_port, "POST",
+                                 "/v1/resolve", doc)
+            s2, b2, _ = _request(reference.api_port, "POST",
+                                 "/v1/resolve", doc)
+            assert (s1, b1) == (s2, b2)
+        # Affinity actually spread families over >1 replica, and the
+        # repeat states were warm/cache-served on their owners.
+        _, metrics, _ = _request(router.api_port, "GET", "/metrics")
+        routed = [line for line in metrics.decode().splitlines()
+                  if line.startswith("deppy_fleet_routed_total{")]
+        assert len(routed) >= 2
+
+    def test_family_affinity_concentrates_churn(self, fleet):
+        replicas, addrs, router, reference = fleet
+        for state in range(4):
+            _request(router.api_port, "POST", "/v1/resolve",
+                     _family_doc("churny", state))
+        # All four states of one family hit ONE replica; its warm tier
+        # (exact cache for repeats, index for deltas) saw every one.
+        hits = []
+        for srv in replicas:
+            _, m, _ = _request(srv.api_port, "GET", "/metrics")
+            text = m.decode()
+            looked = (_metric(text, "deppy_cache_misses_total") or 0) \
+                + (_metric(text, "deppy_cache_hits_total") or 0)
+            hits.append(looked)
+        assert sum(1 for h in hits if h) == 1
+
+    def test_replica_kill_retries_on_successor(self, fleet):
+        replicas, addrs, router, reference = fleet
+        doc = _family_doc("killfam")
+        key = doc_affinity_keys(doc)[0]
+        owner = router.target_for(key)
+        victim = replicas[addrs.index(owner)]
+        victim.shutdown()
+        # No prober help here (interval 60s): the live forward fails,
+        # charges the breaker, and retries once on the ring successor
+        # — the client sees a 200, never the crash.
+        status, body, _ = _request(router.api_port, "POST",
+                                   "/v1/resolve", doc)
+        assert status == 200
+        s2, b2, _ = _request(reference.api_port, "POST", "/v1/resolve",
+                             doc)
+        assert body == b2
+        _, metrics, _ = _request(router.api_port, "GET", "/metrics")
+        assert (_metric(metrics.decode(),
+                        "deppy_fleet_retries_total") or 0) >= 1
+        # Second failure reaches the threshold: the replica is dead,
+        # its arcs reassign, later requests route straight past it.
+        _request(router.api_port, "POST", "/v1/resolve", doc)
+        states = {s["replica"]: s for s in router.replica_states()}
+        assert states[owner]["dead"] is True
+        assert router.target_for(key) != owner
+
+    def test_drain_hands_off_warm_state(self, fleet):
+        replicas, addrs, router, reference = fleet
+        docs = [_family_doc(f"drainfam{i}") for i in range(4)]
+        for doc in docs:
+            _request(router.api_port, "POST", "/v1/resolve", doc)
+        victim_addr = router.target_for(doc_affinity_keys(docs[0])[0])
+        status, body, _ = _request(router.api_port, "POST",
+                                   "/fleet/drain",
+                                   {"replica": victim_addr})
+        assert status == 200
+        out = json.loads(body)["drain"]
+        assert out["handed_off"] >= 1 and out["recipients"]
+        # The drained replica is out of the rotation...
+        new_owner = router.target_for(doc_affinity_keys(docs[0])[0])
+        assert new_owner != victim_addr
+        # ...and the family's next delta warm-serves on the inheritor
+        # instead of cold-solving (the handoff's acceptance).
+        nxt = _family_doc("drainfam0", state=1)
+        assert router.target_for(doc_affinity_keys(nxt)[0]) == new_owner
+        s, b, _ = _request(router.api_port, "POST", "/v1/resolve", nxt)
+        assert s == 200
+        inheritor = replicas[addrs.index(new_owner)]
+        _, m, _ = _request(inheritor.api_port, "GET", "/metrics")
+        assert (_metric(m.decode(),
+                        "deppy_incremental_hits_total") or 0) >= 1
+
+    def test_trace_identity_survives_the_hop(self, fleet):
+        replicas, addrs, router, reference = fleet
+        doc = _family_doc("traced")
+        headers = {
+            "traceparent": "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01",
+            "X-Deppy-Request-Id": "fleet-req-1",
+            "X-Deppy-Tenant": "fleet-tenant",
+        }
+        status, _, hdrs = _request(router.api_port, "POST",
+                                   "/v1/resolve", doc, headers)
+        assert status == 200
+        # The replica honored and echoed the identity through the
+        # router (one trace tree fleet-wide in `deppy trace`).
+        assert hdrs.get("X-Deppy-Request-Id") == "fleet-req-1"
+        assert hdrs.get("traceparent", "").startswith(
+            "00-" + "ab" * 16)
+        owner = replicas[addrs.index(
+            router.target_for(doc_affinity_keys(doc)[0]))]
+        _, body, _ = _request(owner.api_port, "GET", "/debug/slo")
+        assert "fleet-tenant" in json.loads(body)["slo"]
+
+    def test_publish_fans_out_to_every_replica(self, fleet):
+        replicas, addrs, router, reference = fleet
+        for i in range(3):
+            _request(router.api_port, "POST", "/v1/resolve",
+                     _family_doc(f"pub{i}"))
+        delta = {"updates": [{"id": "pub0v1", "constraints": [
+            {"type": "dependency", "ids": ["pub0v3"]}]}]}
+        status, body, _ = _request(router.api_port, "POST",
+                                   "/v1/catalog/publish", delta)
+        assert status == 200
+        merged = json.loads(body)["publish"]
+        assert merged["replicas"] == 3 and merged["errors"] == 0
+        _, metrics, _ = _request(router.api_port, "GET", "/metrics")
+        assert _metric(metrics.decode(),
+                       "deppy_fleet_publish_fanout_total") == 3.0
+
+    def test_warmstate_endpoints_404_with_sched_off(self):
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host",
+                     sched="off")
+        srv.start()
+        try:
+            s, _, _ = _request(srv.api_port, "GET", "/debug/warmstate")
+            assert s == 404
+            s, _, _ = _request(srv.api_port, "POST",
+                               "/debug/warmstate", {"version": 1})
+            assert s == 404
+        finally:
+            srv.shutdown()
+
+    def test_warmstate_import_rejects_tampering(self):
+        srv = _host_server()
+        try:
+            _request(srv.api_port, "POST", "/v1/resolve",
+                     _family_doc("tamper"))
+            s, body, _ = _request(srv.api_port, "GET",
+                                  "/debug/warmstate")
+            snap = json.loads(body)
+            snap["checksum"] = "0" * 64
+            s, body, _ = _request(srv.api_port, "POST",
+                                  "/debug/warmstate", snap)
+            assert s == 400
+            assert "integrity" in json.loads(body)["error"]
+        finally:
+            srv.shutdown()

@@ -438,9 +438,13 @@ class TestAdmission:
         srv = Server(bind_address="127.0.0.1:0",
                      probe_address="127.0.0.1:0", backend="host")
         # Pretend a deep backlog without racing a real flood: the
-        # admission gate reads queue_depth via the scheduler.
+        # admission gate reads queue_depth via the scheduler.  A real
+        # single-tenant backlog keeps the per-tenant ledger in sync
+        # with the global depth (ISSUE 15's fair gate reads it), so
+        # the simulation pokes both.
         srv.scheduler.max_depth = 1
         srv.scheduler._depth = 5
+        srv.scheduler._tenant_depth["default"] = 5
         srv.start()
         try:
             status, data = request(srv.api_port, "POST", "/v1/resolve",
@@ -450,11 +454,13 @@ class TestAdmission:
             assert "overloaded" in doc["error"]
             assert doc["retry_after_s"] >= 1.0
             srv.scheduler._depth = 0
+            srv.scheduler._tenant_depth.clear()
             status, _ = request(srv.api_port, "POST", "/v1/resolve",
                                 {"variables": [{"id": "a"}]})
             assert status == 200
         finally:
             srv.scheduler._depth = 0
+            srv.scheduler._tenant_depth.clear()
             srv.shutdown()
 
     def test_inline_dispatch_when_loop_not_running(self):
