@@ -132,6 +132,14 @@ fleet-smoke: ## Replica fleet end to end: 3 local replicas + affinity router, mi
 test-fleet: ## Replica-fleet subsystem tests only (the `fleet` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m fleet
 
+.PHONY: obs-smoke
+obs-smoke: ## Fleet observability plane end to end: 3 replicas stream telemetry into one merged sink, /fleet/metrics rollups match per-replica scrapes, a routed request reassembles as one trace, an injected slowdown trips the drift watchdog on exactly the slow replica, deppy top + /debug/dump fan-out (ISSUE 16 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/obs_smoke.py
+
+.PHONY: test-obs
+test-obs: ## Fleet-observability subsystem tests only (the `obs` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m obs
+
 .PHONY: lint
 lint: ## Static analysis: the six deppy-lint checkers vs analysis/baseline.json (ISSUE 7/8 acceptance; docs/analysis.md).
 	$(PYTHON) -m deppy_tpu lint

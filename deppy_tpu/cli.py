@@ -297,6 +297,28 @@ def _build_parser() -> argparse.ArgumentParser:
         "via DEPPY_TPU_SCHED_TENANT_WEIGHTS)",
     )
     p_serve.add_argument(
+        "--obs-stream", default=None, metavar="HOST:PORT",
+        help="stream this replica's telemetry sink events to the fleet "
+        "router's POST /fleet/telemetry aggregator at HOST:PORT "
+        "(ISSUE 16; also via DEPPY_TPU_OBS_STREAM).  Batched and "
+        "bounded: a slow aggregator drops batches (counted in "
+        "deppy_obs_stream_dropped_total), never stalls serving",
+    )
+    p_serve.add_argument(
+        "--obs-flush-ms", type=float, default=None, metavar="MS",
+        help="telemetry-streamer flush interval in milliseconds "
+        "(default 200; also via DEPPY_TPU_OBS_FLUSH_MS)",
+    )
+    p_serve.add_argument(
+        "--obs-baseline", default=None, metavar="FILE",
+        help="arm the cost-model drift watchdog against the committed "
+        "baseline artifact (a BENCH_rNN.json with a costmodel section, "
+        "or a `deppy profile --json` report; also via "
+        "DEPPY_TPU_OBS_BASELINE).  Live us/trip per size class outside "
+        "the band emits a costmodel_drift event and the "
+        "deppy_costmodel_drift_ratio gauge",
+    )
+    p_serve.add_argument(
         "--mesh-devices", type=_mesh_devices_arg, default=None,
         metavar="N|all",
         help="shard each coalesced micro-batch across N accelerator "
@@ -360,6 +382,15 @@ def _build_parser() -> argparse.ArgumentParser:
         "fleet.forward point; inline JSON, @FILE, or a path; also via "
         "DEPPY_TPU_FAULT_PLAN)",
     )
+    p_route.add_argument(
+        "--obs-sink", default=None, metavar="FILE",
+        help="aggregate replica-streamed telemetry into the merged "
+        "fleet JSONL sink at FILE (ISSUE 16; also via "
+        "DEPPY_TPU_OBS_SINK).  Arms POST /fleet/telemetry, replica-"
+        "stamps every event, and joins the router's own spans/events "
+        "under replica=\"router\" — `deppy trace --fleet` reads this "
+        "one file",
+    )
 
     p_publish = sub.add_parser(
         "publish",
@@ -407,6 +438,14 @@ def _build_parser() -> argparse.ArgumentParser:
         help="telemetry JSONL file (default: $DEPPY_TPU_TELEMETRY_FILE)",
     )
     p_stats.add_argument(
+        "--file", action="append", default=None, dest="files",
+        metavar="FILE",
+        help="additional telemetry JSONL file(s) to merge (repeatable; "
+        "ISSUE 16): per-replica sinks summarize as one fleet view, "
+        "with flight-recorder dump copies deduped by their per-process "
+        "event seq",
+    )
+    p_stats.add_argument(
         "--output", choices=["text", "json"], default="text",
         help="output format (default: text)",
     )
@@ -438,6 +477,13 @@ def _build_parser() -> argparse.ArgumentParser:
         help="telemetry JSONL file (default: $DEPPY_TPU_TELEMETRY_FILE)",
     )
     p_profile.add_argument(
+        "--file", action="append", default=None, dest="files",
+        metavar="FILE",
+        help="additional telemetry JSONL file(s) to merge (repeatable; "
+        "ISSUE 16): the cost model fits over every replica's profile "
+        "events, dump copies deduped",
+    )
+    p_profile.add_argument(
         "--output", choices=["text", "json"], default="text",
         help="output format (default: text)",
     )
@@ -453,12 +499,42 @@ def _build_parser() -> argparse.ArgumentParser:
         help="trace id or X-Deppy-Request-Id of the request",
     )
     p_trace.add_argument(
-        "--file", default=None, metavar="FILE",
-        help="telemetry JSONL file (default: $DEPPY_TPU_TELEMETRY_FILE)",
+        "--file", action="append", default=None, metavar="FILE",
+        help="telemetry JSONL file (repeatable — multiple replica "
+        "sinks merge, dump copies deduped by event seq; default: "
+        "$DEPPY_TPU_TELEMETRY_FILE)",
+    )
+    p_trace.add_argument(
+        "--fleet", action="store_true",
+        help="fleet mode (ISSUE 16): default the input to the merged "
+        "fleet sink ($DEPPY_TPU_OBS_SINK, the router's --obs-sink "
+        "file) and reconstruct the routed request as one tree — "
+        "router hop + replica request + coalesced dispatch",
     )
     p_trace.add_argument(
         "--output", choices=["text", "json"], default="text",
         help="output format (default: text)",
+    )
+
+    p_top = sub.add_parser(
+        "top",
+        help="live terminal fleet dashboard (ISSUE 16): refreshes a "
+        "per-replica table (state, warm-hit ratio, queue depth, worst "
+        "cost-model drift ratio, telemetry events ingested) plus fleet "
+        "rollups from the router's /fleet/metrics and /fleet/status",
+    )
+    p_top.add_argument(
+        "--router", default="127.0.0.1:8079", metavar="HOST:PORT",
+        help="fleet router address (default 127.0.0.1:8079)",
+    )
+    p_top.add_argument(
+        "--interval", type=float, default=2.0, metavar="SECONDS",
+        help="refresh interval (default 2)",
+    )
+    p_top.add_argument(
+        "--once", action="store_true",
+        help="render one snapshot and exit (no screen clearing) — the "
+        "scriptable mode the obs smoke uses",
     )
 
     # Lint flags are declared inline (not imported from analysis.cli):
@@ -572,6 +648,9 @@ _CONFIG_KEYS = {
     "replica": ("replica", str),
     "schedFair": ("fair", str),
     "schedTenantWeights": ("tenant_weights", str),
+    "obsStream": ("obs_stream", str),
+    "obsFlushMs": ("obs_flush_ms", float),
+    "obsBaseline": ("obs_baseline", str),
 }
 
 
@@ -704,7 +783,8 @@ def _cmd_route(args) -> int:
                      vnodes=args.vnodes,
                      probe_interval_s=args.probe_interval,
                      probe_failures=args.probe_failures,
-                     policy=args.policy)
+                     policy=args.policy,
+                     obs_sink=args.obs_sink)
     except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -823,20 +903,45 @@ def _iter_sink_events(path: str):
     return iter_sink_events(path)
 
 
+def _sink_paths(args) -> List[str]:
+    """Effective sink path list for stats/profile: the positional file
+    plus any repeated ``--file`` (ISSUE 16), falling back to
+    $DEPPY_TPU_TELEMETRY_FILE when neither was given."""
+    from . import config
+
+    paths = ([args.file] if args.file else []) \
+        + list(getattr(args, "files", None) or [])
+    if not paths:
+        default = config.env_raw("DEPPY_TPU_TELEMETRY_FILE")
+        if default:
+            paths = [default]
+    return paths
+
+
+def _iter_paths_events(paths: List[str]):
+    """One path reads verbatim (byte-identical single-sink behavior);
+    several merge with cross-replica dedupe (ISSUE 16)."""
+    if len(paths) == 1:
+        return _iter_sink_events(paths[0])
+    from .telemetry import iter_merged_sink_events
+
+    return iter_merged_sink_events(paths)
+
+
 def _cmd_stats(args) -> int:
     """Summarize a telemetry JSONL file (the sink written under
     ``--telemetry-file`` / ``DEPPY_TPU_TELEMETRY_FILE``): per-span
     count/total/mean/p50/p95/p99 wall clock, event totals, and the last
     recorded solve report — the same report `deppy resolve --report`
     and the bench harness print.  ``--span NAME`` narrows the summary
-    to one span family."""
-    from . import config
-
-    path = args.file or config.env_raw("DEPPY_TPU_TELEMETRY_FILE")
-    if not path:
+    to one span family.  Repeated ``--file`` merges several replica
+    sinks into one fleet summary (ISSUE 16)."""
+    paths = _sink_paths(args)
+    if not paths:
         print("error: no telemetry file (pass FILE or set "
               "DEPPY_TPU_TELEMETRY_FILE)", file=sys.stderr)
         return 2
+    path = ", ".join(paths)
     spans: dict = {}
     last_report = None
     n_events = 0
@@ -847,7 +952,7 @@ def _cmd_stats(args) -> int:
     prof = {"events": 0, "trips": 0, "lane_steps": 0,
             "_useful": 0.0, "_useful_n": 0}
     try:
-        for ev in _iter_sink_events(path):
+        for ev in _iter_paths_events(paths):
             if ev is None:
                 n_bad += 1
                 continue
@@ -972,14 +1077,25 @@ def _cmd_trace(args) -> int:
     recorder ``trace`` dumps) and pretty-print it — including dispatch
     traces grafted via their span links, so a request served by a
     coalesced dispatch shows queue-wait → dispatch (with retry/fallback
-    events) → decode as one tree."""
+    events) → decode as one tree.  ``--fleet`` reads the merged fleet
+    sink instead, so a routed request reconstructs router hop →
+    replica request → dispatch from one file; repeated ``--file``
+    merges several replica sinks with dump copies deduped
+    (ISSUE 16)."""
     from . import config
 
-    path = args.file or config.env_raw("DEPPY_TPU_TELEMETRY_FILE")
-    if not path:
+    paths = list(args.file or [])
+    if not paths:
+        default = config.env_raw("DEPPY_TPU_OBS_SINK") if args.fleet \
+            else config.env_raw("DEPPY_TPU_TELEMETRY_FILE")
+        if default:
+            paths = [default]
+    if not paths:
         print("error: no telemetry file (pass --file or set "
-              "DEPPY_TPU_TELEMETRY_FILE)", file=sys.stderr)
+              + ("DEPPY_TPU_OBS_SINK" if args.fleet
+                 else "DEPPY_TPU_TELEMETRY_FILE") + ")", file=sys.stderr)
         return 2
+    path = ", ".join(paths)
 
     # (trace_id, span_id) -> span event; trace_id -> [events]; the
     # request-id alias map comes from flight-recorder dumps.
@@ -1008,9 +1124,12 @@ def _cmd_trace(args) -> int:
         # more per additional dump).  Stamped events carry a per-process
         # `seq` exactly so dump copies dedupe without collapsing
         # genuinely distinct identical-field events; pre-seq sink lines
-        # fall back to the full canonical form.
+        # fall back to the full canonical form.  seq counters are
+        # per-process, so in a merged fleet sink the key needs the
+        # aggregator's replica stamp too (absent = None on local sinks,
+        # preserving single-sink behavior).
         seq = ev.get("seq")
-        key = (tid, seq) if seq is not None \
+        key = (ev.get("replica"), tid, seq) if seq is not None \
             else json.dumps(ev, sort_keys=True, default=str)
         if key in seen_events:
             return
@@ -1018,7 +1137,7 @@ def _cmd_trace(args) -> int:
         events_by_trace.setdefault(tid, []).append(ev)
 
     try:
-        for ev in _iter_sink_events(path):
+        for ev in _iter_paths_events(paths):
             if ev is None:
                 continue
             kind = ev.get("kind")
@@ -1152,17 +1271,19 @@ def _cmd_profile(args) -> int:
     (ISSUE 11): trip-overhead regression, useful-work ratio per size
     class, straggler/pad waste breakdowns, per-backend µs/solve — the
     continuously-collected version of the hand-run A/B trip-overhead
-    model (see docs/observability.md, Profiling)."""
-    from . import config
+    model (see docs/observability.md, Profiling).  Repeated ``--file``
+    fits the model over several replica sinks merged (ISSUE 16)."""
     from .profile import report as profile_report
 
-    path = args.file or config.env_raw("DEPPY_TPU_TELEMETRY_FILE")
-    if not path:
+    paths = _sink_paths(args)
+    if not paths:
         print("error: no telemetry file (pass FILE or set "
               "DEPPY_TPU_TELEMETRY_FILE)", file=sys.stderr)
         return 2
+    path = ", ".join(paths)
     try:
-        summary = profile_report.summarize(path)
+        summary = profile_report.summarize(
+            paths[0] if len(paths) == 1 else paths)
     except FileNotFoundError:
         print(f"error: no such file: {path}", file=sys.stderr)
         return 2
@@ -1311,6 +1432,9 @@ def _cmd_serve(args) -> int:
         "replica": None,
         "fair": None,
         "tenant_weights": None,
+        "obs_stream": None,
+        "obs_flush_ms": None,
+        "obs_baseline": None,
     }
     try:
         if args.config:
@@ -1340,6 +1464,9 @@ def _cmd_serve(args) -> int:
             ("replica", args.replica),
             ("fair", args.sched_fair),
             ("tenant_weights", args.sched_tenant_weights),
+            ("obs_stream", args.obs_stream),
+            ("obs_flush_ms", args.obs_flush_ms),
+            ("obs_baseline", args.obs_baseline),
         ):
             if val is not None:
                 kwargs[key] = val
@@ -1377,6 +1504,15 @@ def _cmd_serve(args) -> int:
     return 0
 
 
+def _cmd_top(args) -> int:
+    """Live terminal fleet dashboard over the router's /fleet/status +
+    /fleet/metrics surfaces (ISSUE 16)."""
+    from .obs import top
+
+    return top.run(args.router, interval_s=args.interval,
+                   once=args.once)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     from .utils.platform_env import apply_platform_env
 
@@ -1402,6 +1538,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_trace(args)
     if args.command == "profile":
         return _cmd_profile(args)
+    if args.command == "top":
+        return _cmd_top(args)
     if args.command == "compiles":
         return _cmd_compiles(args)
     if args.command == "lint":

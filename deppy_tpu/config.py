@@ -241,6 +241,44 @@ _DECLARATIONS: List[EnvVar] = [
        "or {weight, priority} ('default' covers unlisted tenants; "
        "also --sched-tenant-weights).",
        flag="--sched-tenant-weights", config_key="schedTenantWeights"),
+    # --- observability plane (ISSUE 16) ----------------------------------
+    _v("DEPPY_TPU_OBS_STREAM", "str", None, "deppy_tpu.obs.stream",
+       "Fleet telemetry streaming: aggregator address (host:port, "
+       "normally the router) this replica batch-pushes its sink events "
+       "to via POST /fleet/telemetry (also --obs-stream); unset keeps "
+       "the local-sink-only pipeline byte for byte.",
+       flag="--obs-stream", config_key="obsStream"),
+    _v("DEPPY_TPU_OBS_FLUSH_MS", "float", 200.0, "deppy_tpu.obs.stream",
+       "Max milliseconds a queued telemetry event waits before the "
+       "streamer flushes a batch to the aggregator (also "
+       "--obs-flush-ms).",
+       flag="--obs-flush-ms", config_key="obsFlushMs"),
+    _v("DEPPY_TPU_OBS_QUEUE", "int", 4096, "deppy_tpu.obs.stream",
+       "Streamer queue capacity in events; a slow aggregator fills it "
+       "and further events are DROPPED and counted "
+       "(deppy_obs_stream_dropped_total) instead of stalling serving."),
+    _v("DEPPY_TPU_OBS_BATCH", "int", 256, "deppy_tpu.obs.stream",
+       "Max events per streamed POST /fleet/telemetry batch."),
+    _v("DEPPY_TPU_OBS_SINK", "path", None, "deppy_tpu.obs.aggregate",
+       "Router-side merged fleet sink: JSONL path the telemetry "
+       "aggregator appends replica-stamped events to (also --obs-sink "
+       "on `deppy route`); unset 404s POST /fleet/telemetry.",
+       flag="--obs-sink"),
+    _v("DEPPY_TPU_OBS_BASELINE", "path", None, "deppy_tpu.obs.drift",
+       "Cost-model baseline artifact for the drift watchdog: a "
+       "BENCH_rNN.json (or any JSON with a `costmodel` section) whose "
+       "per-size-class µs/trip the live regression is compared "
+       "against (also --obs-baseline); unset disarms the watchdog "
+       "byte for byte.",
+       flag="--obs-baseline", config_key="obsBaseline"),
+    _v("DEPPY_TPU_OBS_DRIFT_BAND", "float", 0.5, "deppy_tpu.obs.drift",
+       "Relative drift band for the cost-model watchdog: a live "
+       "per-size-class µs/trip fit farther than this fraction from "
+       "the baseline emits a costmodel_drift event and pushes "
+       "deppy_costmodel_drift_ratio past the band."),
+    _v("DEPPY_TPU_OBS_DRIFT_MIN", "int", 8, "deppy_tpu.obs.drift",
+       "Minimum sampled device dispatches per size class before the "
+       "drift watchdog trusts its regression enough to compare."),
     # --- service ---------------------------------------------------------
     _v("DEPPY_TPU_REQUEST_DEADLINE_S", "float", None, "deppy_tpu.service",
        "Default wall-clock budget per /v1/resolve request (clients "

@@ -104,6 +104,7 @@ class Router:
         probe_failures: Optional[int] = None,
         policy: str = "affinity",
         max_body_bytes: int = 8 * 1024 * 1024,
+        obs_sink: Optional[str] = None,
     ):
         from ..analysis import lockdep
 
@@ -168,6 +169,29 @@ class Router:
             "deppy_fleet_handoff_entries_total",
             "Warm-state entries (index entries + cache seeds) handed "
             "off to arc inheritors during drains.")
+        # Fleet observability plane (ISSUE 16): --obs-sink /
+        # DEPPY_TPU_OBS_SINK names the merged fleet JSONL sink.
+        # Replicas batch-push their sink events to POST /fleet/telemetry
+        # and each lands replica-stamped; the router's OWN events
+        # (replica up/down faults on the default registry,
+        # router.forward spans on this registry) join the same sink via
+        # forwarders stamped "router", so `deppy trace --fleet` rebuilds
+        # a routed request as one tree from this single file.
+        if obs_sink is None:
+            obs_sink = config.env_str("DEPPY_TPU_OBS_SINK")
+        self.aggregator = None
+        self._obs_forwarders: list = []
+        if obs_sink:
+            from ..obs.aggregate import ROUTER_REPLICA, Aggregator
+
+            self.aggregator = Aggregator(obs_sink, registry=self.registry)
+
+            def _to_sink(ev, _agg=self.aggregator):
+                _agg.ingest_event(ROUTER_REPLICA, ev)
+
+            for reg in (self.registry, telemetry.default_registry()):
+                reg.add_forwarder(_to_sink)
+                self._obs_forwarders.append((reg, _to_sink))
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
         from ..service import _make_http_server, _parse_addr
@@ -317,6 +341,37 @@ class Router:
         if t is not None:
             t.join(PROBE_TIMEOUT_S + self.probe_interval_s + 1.0)
             self._probe_thread = None
+        for reg, fn in self._obs_forwarders:
+            reg.remove_forwarder(fn)
+        self._obs_forwarders = []
+        if self.aggregator is not None:
+            self.aggregator.close()
+            self.aggregator = None
+
+    def dump_fanout(self, body: Optional[bytes] = None) -> dict:
+        """POST /debug/dump to every live replica (ISSUE 16): one
+        operator signal — SIGUSR2 on the router, or its /debug/dump
+        endpoint — flushes every replica's flight recorder into its
+        sink/stream.  Returns the per-replica dump counts."""
+        dumped: Dict[str, int] = {}
+        errors: List[str] = []
+        for address in self.live_replicas():
+            try:
+                status, data, _ = self.forward(
+                    address, "POST", "/debug/dump", body or b"{}",
+                    {"Content-Type": "application/json"},
+                    timeout=PROBE_TIMEOUT_S * 5)
+            except OSError:
+                errors.append(address)
+                continue
+            if status != 200:
+                errors.append(address)
+                continue
+            try:
+                dumped[address] = int(json.loads(data).get("dumped", 0))
+            except (ValueError, json.JSONDecodeError):
+                dumped[address] = 0
+        return {"dumped": dumped, "errors": errors}
 
     # ------------------------------------------------------------- drain
 
@@ -417,9 +472,17 @@ def _router_handler(router: Router):
                 return None
             return self.rfile.read(length)
 
+        # traceparent naming the router hop span as parent (ISSUE 16);
+        # set only while an aggregator is armed, so disarmed forwards
+        # stay byte-identical.
+        _hop_traceparent = None
+
         def _fwd_headers(self) -> dict:
-            return {k: self.headers[k] for k in FORWARD_HEADERS
-                    if self.headers.get(k) is not None}
+            h = {k: self.headers[k] for k in FORWARD_HEADERS
+                 if self.headers.get(k) is not None}
+            if self._hop_traceparent:
+                h["traceparent"] = self._hop_traceparent
+            return h
 
         def _relay(self, status: int, body: bytes, hdrs: dict) -> None:
             self._send(status, body,
@@ -464,8 +527,52 @@ def _router_handler(router: Router):
                     "policy": router.policy,
                     "vnodes": router.ring.vnodes,
                     "replicas": router.replica_states()})
+            elif path == "/fleet/metrics":
+                # Metrics federation (ISSUE 16): every live replica
+                # scraped concurrently, families merged under the
+                # `replica` label, fleet rollups on top.
+                router._c_requests.inc(label="fleet_metrics")
+                from ..obs import federate
+
+                self._send(200,
+                           federate.render_fleet_metrics(router).encode(),
+                           "text/plain; version=0.0.4")
+            elif path == "/fleet/status":
+                router._c_requests.inc(label="fleet_status")
+                agg = router.aggregator
+                self._send_json(200, {
+                    "policy": router.policy,
+                    "vnodes": router.ring.vnodes,
+                    "replicas": router.replica_states(),
+                    "telemetry": {
+                        "ingested": agg.counts() if agg else {}}})
+            elif path == "/debug/traces":
+                self._traces()
             else:
                 self._send_json(404, {"error": "not found"})
+
+        def _traces(self):
+            """Cross-replica trace lookup (ISSUE 16): only the replica
+            that served a request retains it in its flight recorder, so
+            the query fans out and the first hit is relayed."""
+            router._c_requests.inc(label="traces")
+            last = None
+            for address in router.live_replicas():
+                try:
+                    out = router.forward(address, "GET", self.path, None,
+                                         timeout=PROBE_TIMEOUT_S * 5)
+                except OSError:
+                    continue
+                if out[0] == 200:
+                    self._relay(*out)
+                    return
+                last = out
+            if last is not None:
+                self._relay(*last)
+            else:
+                self._send_json(503, {
+                    "error": "fleet: no replica reachable",
+                    "retry_after_s": max(router.probe_interval_s, 1.0)})
 
         def do_POST(self):
             path = self.path.split("?", 1)[0]
@@ -475,14 +582,70 @@ def _router_handler(router: Router):
                 self._fan_out(path)
             elif path == "/fleet/drain":
                 self._drain()
+            elif path == "/fleet/telemetry":
+                self._telemetry()
+            elif path == "/debug/dump":
+                router._c_requests.inc(label="dump")
+                raw = self._read_body()
+                if raw is None:
+                    return
+                self._send_json(200, router.dump_fanout(raw))
             else:
                 self._send_json(404, {"error": "not found"})
+
+        def _telemetry(self):
+            """Replica-pushed telemetry batches (ISSUE 16).  404 with no
+            aggregator armed — the streamer counts the rejection and
+            drops the batch; serving is never in the loop."""
+            if router.aggregator is None:
+                self._send_json(404, {"error": "not found"})
+                return
+            router._c_requests.inc(label="telemetry")
+            raw = self._read_body()
+            if raw is None:
+                return
+            try:
+                doc = json.loads(raw or b"null")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send_json(400,
+                                {"error": f"invalid JSON body: {e}"})
+                return
+            accepted, err = router.aggregator.ingest(doc)
+            if err is not None:
+                self._send_json(400, {"error": err})
+                return
+            self._send_json(200, {"accepted": accepted})
 
         def _resolve(self):
             router._c_requests.inc(label="resolve")
             raw = self._read_body()
             if raw is None:
                 return
+            if router.aggregator is not None:
+                # Router hop span (ISSUE 16): adopt (or mint) the
+                # request's trace, open router.forward on the router's
+                # registry, and forward a traceparent naming the hop as
+                # parent — each replica's service.request root nests
+                # under it, so the merged sink reconstructs the routed
+                # request as ONE span tree.
+                ctx = telemetry.trace.context_from_headers(
+                    self.headers.get("traceparent"),
+                    self.headers.get("X-Deppy-Request-Id"))
+                with telemetry.trace.activate(ctx), \
+                        router.registry.span(
+                            "router.forward", path="/v1/resolve",
+                            request_id=ctx.request_id) as sp:
+                    if sp.span_id:
+                        self._hop_traceparent = (
+                            f"00-{ctx.trace_id}-{sp.span_id}-01")
+                    try:
+                        self._resolve_routed(raw, sp)
+                    finally:
+                        self._hop_traceparent = None
+                return
+            self._resolve_routed(raw, None)
+
+        def _resolve_routed(self, raw: bytes, sp) -> None:
             try:
                 doc = json.loads(raw or b"null")
                 keys = doc_affinity_keys(doc)
@@ -495,6 +658,8 @@ def _router_handler(router: Router):
             for i, key in enumerate(keys):
                 by_target.setdefault(
                     router.target_for(key), []).append(i)
+            if sp is not None:
+                sp.set(problems=len(keys), targets=len(by_target))
             if len(by_target) == 1:
                 # One owner: forward the ORIGINAL bytes — byte-identity
                 # with a single replica is structural, not re-rendered.
@@ -505,6 +670,8 @@ def _router_handler(router: Router):
                 status, body, hdrs, target = out
                 if status == 200:
                     router._c_routed.inc(len(keys), label=target)
+                if sp is not None:
+                    sp.set(replica=target, status=status)
                 self._relay(status, body, hdrs)
                 return
             self._resolve_split(doc, keys, by_target)
@@ -674,21 +841,39 @@ def serve_router(bind_address: str = ":8079", replicas=None,
                  vnodes: Optional[int] = None,
                  probe_interval_s: Optional[float] = None,
                  probe_failures: Optional[int] = None,
-                 policy: str = "affinity") -> None:
+                 policy: str = "affinity",
+                 obs_sink: Optional[str] = None) -> None:
     """Blocking entry point for ``deppy route`` — the router analog of
     ``service.serve`` (SIGTERM/Ctrl-C stop it cleanly)."""
     import signal
+    import sys
 
     router = Router(bind_address=bind_address, replicas=replicas,
                     vnodes=vnodes, probe_interval_s=probe_interval_s,
-                    probe_failures=probe_failures, policy=policy)
+                    probe_failures=probe_failures, policy=policy,
+                    obs_sink=obs_sink)
     router.start()
     stop = threading.Event()
 
     def _on_sigterm(signum, frame):
         stop.set()
 
+    def _on_sigusr2(signum, frame):
+        # Fleet-wide flight-recorder dump (ISSUE 16): the replica-local
+        # SIGUSR2 semantics, fanned out — one signal on the router
+        # flushes every live replica's recorder into its sink/stream.
+        out = router.dump_fanout()
+        total = sum(out["dumped"].values())
+        print(f"[route] SIGUSR2: dumped {total} flight-recorder "
+              f"trace(s) across {len(out['dumped'])} replica(s)"
+              + (f"; unreachable: {','.join(out['errors'])}"
+                 if out["errors"] else ""),
+              file=sys.stderr, flush=True)
+
     prev = signal.signal(signal.SIGTERM, _on_sigterm)
+    prev_usr2 = None
+    if hasattr(signal, "SIGUSR2"):  # absent on Windows
+        prev_usr2 = signal.signal(signal.SIGUSR2, _on_sigusr2)
     print(f"deppy fleet router listening on :{router.api_port} "
           f"({len(router.ring.replicas)} replicas, policy "
           f"{router.policy})", flush=True)
@@ -699,6 +884,8 @@ def serve_router(bind_address: str = ":8079", replicas=None,
         pass
     finally:
         signal.signal(signal.SIGTERM, prev)
+        if prev_usr2 is not None:
+            signal.signal(signal.SIGUSR2, prev_usr2)
         router.shutdown()
 
 

@@ -1,0 +1,180 @@
+"""Telemetry streaming: replica -> aggregator event push (ISSUE 16).
+
+A :class:`TelemetryStreamer` registers as an event forwarder on the
+process telemetry registry (:meth:`Registry.add_forwarder`), so every
+JSONL sink event — profile, race, fault, lockdep, compileguard,
+speculate, spans, flight-recorder dumps — is also enqueued for the
+fleet aggregator, sink file or not.
+
+Backpressure contract (the load-bearing part): ``enqueue`` NEVER
+blocks and NEVER raises.  The queue is a bounded list under a named
+lock; when a slow (or dead) aggregator lets it fill, further events
+are dropped and counted (``deppy_obs_stream_dropped_total``) — serving
+latency is unperturbed by observability.  A daemon thread drains the
+queue in batches of ``DEPPY_TPU_OBS_BATCH`` at most every
+``DEPPY_TPU_OBS_FLUSH_MS`` milliseconds, POSTing
+``{"replica": ..., "events": [...]}`` to ``/fleet/telemetry`` on the
+aggregator; a failed POST drops that batch (counted) rather than
+requeueing it, so the queue bound is real.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from typing import List, Optional, Tuple
+
+# The streamer's own families (registered on the process registry only
+# while a streamer is armed; `obs.render_metric_lines` mirrors them
+# onto the service /metrics).
+STREAM_FAMILIES = (
+    "deppy_obs_stream_events_total",
+    "deppy_obs_stream_dropped_total",
+    "deppy_obs_stream_batches_total",
+    "deppy_obs_stream_errors_total",
+)
+
+POST_TIMEOUT_S = 5.0
+
+
+def _parse_target(target: str) -> Tuple[str, int]:
+    host, _, port = target.rpartition(":")
+    return (host or "127.0.0.1"), int(port)
+
+
+class TelemetryStreamer:
+    """Bounded, non-blocking event pusher to the fleet aggregator."""
+
+    def __init__(self, target: str, replica: Optional[str] = None,
+                 queue_cap: Optional[int] = None,
+                 batch: Optional[int] = None,
+                 flush_ms: Optional[float] = None,
+                 registry=None):
+        from .. import config, telemetry
+        from ..analysis import lockdep
+        from ..profile import sanitize_replica
+
+        self.target = target
+        self._host, self._port = _parse_target(target)
+        self.replica = sanitize_replica(replica) or "unknown"
+        if queue_cap is None:
+            queue_cap = config.env_int("DEPPY_TPU_OBS_QUEUE", 4096,
+                                       strict=False)
+        if batch is None:
+            batch = config.env_int("DEPPY_TPU_OBS_BATCH", 256,
+                                   strict=False)
+        if flush_ms is None:
+            flush_ms = config.env_float("DEPPY_TPU_OBS_FLUSH_MS", 200.0,
+                                        strict=False)
+        self._cap = max(int(queue_cap), 1)
+        self._batch = max(int(batch), 1)
+        self._flush_s = max(float(flush_ms), 1.0) / 1000.0
+        self._lock = lockdep.make_lock("obs.stream")
+        self._queue: List[dict] = []
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._registry = (registry if registry is not None
+                          else telemetry.default_registry())
+        reg = self._registry
+        self._c_events = reg.counter(
+            "deppy_obs_stream_events_total",
+            "Telemetry events enqueued for fleet streaming.")
+        self._c_dropped = reg.counter(
+            "deppy_obs_stream_dropped_total",
+            "Telemetry events dropped on a full streamer queue (slow "
+            "or dead aggregator) — the backpressure valve; serving "
+            "never blocks on observability.")
+        self._c_batches = reg.counter(
+            "deppy_obs_stream_batches_total",
+            "Telemetry batches delivered to the fleet aggregator.")
+        self._c_errors = reg.counter(
+            "deppy_obs_stream_errors_total",
+            "Telemetry batch POSTs that failed (batch dropped, not "
+            "requeued).")
+
+    # --------------------------------------------------------- event side
+
+    def __call__(self, event: dict) -> None:
+        """The registry-forwarder entry point."""
+        self.enqueue(event)
+
+    def enqueue(self, event: dict) -> None:
+        with self._lock:
+            if len(self._queue) >= self._cap:
+                dropped = True
+                depth = len(self._queue)
+            else:
+                self._queue.append(event)
+                dropped = False
+                depth = len(self._queue)
+        if dropped:
+            self._c_dropped.inc()
+            return
+        self._c_events.inc()
+        if depth >= self._batch:
+            self._wake.set()
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # --------------------------------------------------------- drain side
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._registry.add_forwarder(self)
+        self._thread = threading.Thread(
+            target=self._run, name="obs-stream", daemon=True)
+        self._thread.start()
+
+    def close(self, drain_s: float = 2.0) -> None:
+        """Detach from the registry, flush what's queued, stop."""
+        self._registry.remove_forwarder(self)
+        self._stop.set()
+        self._wake.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=drain_s)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self._flush_s)
+            self._wake.clear()
+            self.flush()
+        self.flush()
+
+    def flush(self) -> None:
+        """Drain the queue in batches; called from the drain thread and
+        from tests."""
+        while True:
+            with self._lock:
+                batch = self._queue[: self._batch]
+                del self._queue[: len(batch)]
+            if not batch:
+                return
+            if self._post(batch):
+                self._c_batches.inc()
+            else:
+                self._c_errors.inc()
+            if len(batch) < self._batch:
+                return
+
+    def _post(self, batch: List[dict]) -> bool:
+        body = json.dumps({"replica": self.replica,
+                           "events": batch}).encode("utf-8")
+        conn = http.client.HTTPConnection(self._host, self._port,
+                                          timeout=POST_TIMEOUT_S)
+        try:
+            conn.request("POST", "/fleet/telemetry", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            return 200 <= resp.status < 300
+        except (OSError, http.client.HTTPException):
+            return False
+        finally:
+            conn.close()

@@ -24,18 +24,22 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 
-def summarize(path: str) -> dict:
+def summarize(path) -> dict:
     """Aggregate a sink's ``profile`` events into the cost model, and
     its ``race`` events (ISSUE 13) into the per-class portfolio table
     — wins/cancels/win-margin per backend plus straggler-resubmission
-    counts, from the sink alone."""
-    from ..telemetry import iter_sink_events
+    counts, from the sink alone.  ``path`` is one sink path, or a list
+    of per-replica sinks to merge (ISSUE 16: flight-recorder dump
+    copies dedupe by their per-process event seq)."""
+    from ..telemetry import iter_merged_sink_events, iter_sink_events
 
+    events = (iter_sink_events(path) if isinstance(path, str)
+              else iter_merged_sink_events(path))
     device: List[dict] = []
     backends: Dict[str, dict] = {}
     races: Dict[str, dict] = {}
     n_events = 0
-    for ev in iter_sink_events(path):
+    for ev in events:
         if ev is None:
             continue
         if ev.get("kind") == "race":

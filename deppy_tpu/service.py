@@ -221,6 +221,13 @@ class Metrics:
         # so a tenant-free deployment's scrape is unchanged.
         if self.slo is not None:
             lines += self.slo.render_metric_lines()
+        # Fleet observability families (ISSUE 16): telemetry-streamer
+        # counters + cost-model drift gauges, process-global like the
+        # profiler's.  Disarmed (no --obs-stream / --obs-baseline) this
+        # appends nothing — scrapes stay byte-identical.
+        from . import obs
+
+        lines += obs.render_metric_lines()
         return "\n".join(lines) + "\n"
 
 
@@ -253,6 +260,9 @@ class Server:
         replica: Optional[str] = None,
         fair: Optional[str] = None,
         tenant_weights: Optional[str] = None,
+        obs_stream: Optional[str] = None,
+        obs_flush_ms: Optional[float] = None,
+        obs_baseline: Optional[str] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -280,6 +290,31 @@ class Server:
             else profiling.SLOConfig.from_spec(slo),
             replica=self.replica)
         self.metrics.slo = self.slo
+        # Fleet observability plane (ISSUE 16).  --obs-stream arms the
+        # telemetry streamer (sink events batch-pushed to the router's
+        # POST /fleet/telemetry); --obs-baseline arms the cost-model
+        # drift watchdog.  Both install process-global forwarders on
+        # the default registry — replica-scoped state like the
+        # profiler's, except a fleet replica runs exactly one Server, so
+        # this Server owns their lifecycle and detaches them on
+        # shutdown().  Unset (the default) arms nothing: the event
+        # pipeline and /metrics stay byte-identical to pre-obs.
+        if obs_stream is None:
+            obs_stream = config.env_str("DEPPY_TPU_OBS_STREAM")
+        if obs_baseline is None:
+            obs_baseline = config.env_str("DEPPY_TPU_OBS_BASELINE")
+        self._obs_armed = False
+        if obs_stream or obs_baseline:
+            from . import obs
+
+            if obs_stream:
+                obs.start_streamer(obs_stream, replica=self.replica,
+                                   flush_ms=obs_flush_ms)
+                self._obs_armed = True
+            if obs_baseline:
+                if obs.start_watchdog(obs_baseline,
+                                      replica=self.replica) is not None:
+                    self._obs_armed = True
         self.ready = threading.Event()
         self._stop = threading.Event()
         # Cross-request continuous batching + result cache (ISSUE 3):
@@ -601,6 +636,14 @@ class Server:
             # flips to ready on its next tick, shrinking the failover
             # window from lease-expiry to renew-interval.
             self.elector.stop(release=True)
+        if self._obs_armed:
+            # Detach the streamer/watchdog forwarders this Server armed
+            # (final flush included) so embedded servers in tests don't
+            # leak obs state across instances.
+            from . import obs
+
+            obs.stop_all()
+            self._obs_armed = False
         for srv in (self._api, self._probe):
             if self._threads:
                 # BaseServer.shutdown blocks forever unless serve_forever is
@@ -790,6 +833,24 @@ def _api_handler(server: Server):
                         self._preview_request(spec)
                 finally:
                     server._exit_request()
+                return
+            if self.path == "/debug/dump":
+                # Flight-recorder dump on demand (ISSUE 16): the HTTP
+                # twin of SIGUSR2, so the router can fan one operator
+                # signal out to every live replica.  The optional JSON
+                # body names a reason for the dumped trace events.
+                doc, err = self._read_json_body()
+                if err is not None:
+                    return
+                reason = "http"
+                if isinstance(doc, dict) and isinstance(
+                        doc.get("reason"), str) and doc["reason"]:
+                    reason = doc["reason"]
+                n = telemetry.trace.default_recorder().dump(reason=reason)
+                out = {"dumped": n}
+                if server.replica is not None:
+                    out["replica"] = server.replica
+                self._send_json(200, out)
                 return
             self._send_json(404, {"error": "not found"})
 
@@ -1058,6 +1119,9 @@ def serve(
     replica: Optional[str] = None,
     fair: Optional[str] = None,
     tenant_weights: Optional[str] = None,
+    obs_stream: Optional[str] = None,
+    obs_flush_ms: Optional[float] = None,
+    obs_baseline: Optional[str] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -1077,7 +1141,9 @@ def serve(
                  slo=slo, portfolio=portfolio, speculate=speculate,
                  speculate_max_backlog=speculate_max_backlog,
                  replica=replica, fair=fair,
-                 tenant_weights=tenant_weights)
+                 tenant_weights=tenant_weights,
+                 obs_stream=obs_stream, obs_flush_ms=obs_flush_ms,
+                 obs_baseline=obs_baseline)
     srv.start()
     stop = threading.Event()
 

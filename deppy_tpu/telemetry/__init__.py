@@ -25,6 +25,7 @@ from .registry import (
     Span,
     configure_sink,
     default_registry,
+    iter_merged_sink_events,
     iter_sink_events,
     percentile,
     set_default_registry,
@@ -56,6 +57,7 @@ __all__ = [
     "default_registry",
     "detach_report",
     "end_report",
+    "iter_merged_sink_events",
     "iter_sink_events",
     "last_report",
     "percentile",

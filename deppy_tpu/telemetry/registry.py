@@ -73,6 +73,39 @@ def iter_sink_events(path: str):
             yield ev if isinstance(ev, dict) else None
 
 
+def iter_merged_sink_events(paths):
+    """Yield events from several sink files as ONE deduplicated stream
+    (ISSUE 16: `deppy stats/trace/profile --file a.jsonl --file
+    b.jsonl` merges replica sinks and the fleet aggregator's merged
+    sink without hand-concatenation).  Dedupe keys, in order:
+
+      * stamped events — ``(replica, trace_id, seq)``: ``seq`` is the
+        per-process event sequence (telemetry.trace), unique within a
+        replica; the ``replica`` stamp (added by the fleet aggregator)
+        disambiguates seq collisions across replicas;
+      * span events — ``(replica, trace_id, span_id)``;
+      * everything else — the event's canonical JSON.
+
+    Malformed lines yield None, like :func:`iter_sink_events`."""
+    seen = set()
+    for path in paths:
+        for ev in iter_sink_events(path):
+            if ev is None:
+                yield None
+                continue
+            replica, tid = ev.get("replica"), ev.get("trace_id")
+            if ev.get("seq") is not None:
+                key = (replica, tid, "e", ev["seq"])
+            elif ev.get("kind") == "span" and ev.get("span_id"):
+                key = (replica, tid, "s", ev["span_id"])
+            else:
+                key = json.dumps(ev, sort_keys=True, default=str)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield ev
+
+
 def percentile(sorted_vals, q):
     """Nearest-rank percentile over pre-sorted values (0 on empty) —
     THE percentile statistic, shared by `deppy stats`, the trip
@@ -335,6 +368,12 @@ class Registry:
         self._sink_lock = lockdep.make_lock("telemetry.registry.sink")
         self._sink_path = sink_path
         self._sink_file = None
+        # Event forwarders (ISSUE 16): callables handed every emitted
+        # event alongside (or instead of) the sink file — the fleet
+        # telemetry streamer registers here.  Stored as an immutable
+        # tuple swapped atomically under _sink_lock so emit() can read
+        # it without taking the lock (empty tuple = pre-obs fast path).
+        self._forwarders: Tuple = ()
         # Bounded in-memory span tail for `deppy stats` on a live
         # process and for tests; not a durable record (the sink is).
         self._recent_spans: List[dict] = []
@@ -414,7 +453,7 @@ class Registry:
 
         traced = _trace.current_context() is not None
         # deppy: lint-ok[concurrency-discipline] deliberate unlocked fast-path read; emit() re-checks under the lock
-        if self._sink_path is None and not traced:
+        if self._sink_path is None and not traced and not self._forwarders:
             return
         event = {"ts": round(time.time(), 3), "kind": kind, **fields}
         if traced:
@@ -441,10 +480,43 @@ class Registry:
         with self._sink_lock:
             return self._sink_path
 
+    @property
+    def forwarding(self) -> bool:
+        """True when at least one event forwarder is registered —
+        emitted events have somewhere to go even without a sink file
+        (the flight recorder's dump gate checks both)."""
+        # deppy: lint-ok[concurrency-discipline] atomic tuple swap; a one-swap-stale verdict only gates a dump
+        return bool(self._forwarders)
+
+    def add_forwarder(self, fn) -> None:
+        """Register a callable handed every emitted event (ISSUE 16:
+        the fleet telemetry streamer).  Forwarders run before the sink
+        write and must never block or raise into the pipeline — emit()
+        swallows their exceptions."""
+        with self._sink_lock:
+            if fn not in self._forwarders:
+                self._forwarders = self._forwarders + (fn,)
+
+    def remove_forwarder(self, fn) -> None:
+        with self._sink_lock:
+            self._forwarders = tuple(
+                f for f in self._forwarders if f is not fn)
+
     def emit(self, event: dict) -> None:
-        """Append one event object to the sink, if configured.  Sink I/O
-        failures disable the sink rather than failing the solve — the
-        pipeline must never die to observability."""
+        """Append one event object to the sink, if configured, and hand
+        it to every registered forwarder.  Sink I/O failures disable
+        the sink rather than failing the solve — the pipeline must
+        never die to observability."""
+        # Forwarders first: streaming works without a local sink.  The
+        # tuple is swapped atomically, so the unlocked read sees a
+        # consistent (possibly one-swap-stale) set.
+        # deppy: lint-ok[concurrency-discipline] atomic tuple swap; emit must not serialize on the sink lock
+        for fn in self._forwarders:
+            try:
+                fn(event)
+            # deppy: lint-ok[exception-hygiene] a broken forwarder must never fail the solve; the streamer counts its own errors
+            except Exception:
+                pass
         # deppy: lint-ok[concurrency-discipline] double-checked: the unlocked read only skips work, the locked one decides
         if self._sink_path is None:
             return

@@ -408,7 +408,10 @@ class FlightRecorder:
         from .registry import default_registry
 
         reg = default_registry()
-        if reg.sink_path is None:
+        # Forwarders count as an output: a fleet replica streaming to
+        # the aggregator (ISSUE 16) dumps into the merged sink even
+        # with no local sink file configured.
+        if reg.sink_path is None and not reg.forwarding:
             return
         reg.emit({"ts": round(time.time(), 3), "kind": "trace",
                   "reason": reason, "trace": trace})
@@ -458,7 +461,8 @@ class FlightRecorder:
         :meth:`record`."""
         from .registry import default_registry
 
-        if default_registry().sink_path is None:
+        reg = default_registry()
+        if reg.sink_path is None and not reg.forwarding:
             return 0
         traces = self.traces()
         for trace in traces:

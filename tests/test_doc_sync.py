@@ -35,6 +35,7 @@ CODE_SCOPE = [
     REPO / "deppy_tpu" / "incremental",
     REPO / "deppy_tpu" / "speculate",
     REPO / "deppy_tpu" / "fleet",
+    REPO / "deppy_tpu" / "obs",
     REPO / "deppy_tpu" / "profile",
     REPO / "deppy_tpu" / "service.py",
     REPO / "deppy_tpu" / "engine" / "driver.py",
