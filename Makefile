@@ -140,6 +140,18 @@ obs-smoke: ## Fleet observability plane end to end: 3 replicas stream telemetry 
 test-obs: ## Fleet-observability subsystem tests only (the `obs` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m obs
 
+.PHONY: soak-smoke
+soak-smoke: ## Elastic-fleet chaos survival gate, quick shape: open-loop load across replica kill / runtime join+arc-flip / drain / router failover, byte-identity vs a fault-free oracle (ISSUE 17 acceptance at --seconds 70; this target runs the 20s smoke).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/soak_smoke.py --seconds 20
+
+.PHONY: soak-gate
+soak-gate: ## The full ISSUE 17 acceptance run (>= 60s sustained load; writes benchmarks/results/soak_r17.json).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/soak_smoke.py
+
+.PHONY: test-soak
+test-soak: ## Soak/chaos survival tests only (the `soak` pytest marker; the full-length run needs -m "soak" without the slow deselect).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m soak
+
 .PHONY: lint
 lint: ## Static analysis: the six deppy-lint checkers vs analysis/baseline.json (ISSUE 7/8 acceptance; docs/analysis.md).
 	$(PYTHON) -m deppy_tpu lint

@@ -1,0 +1,560 @@
+"""Soak/chaos survival gate (ISSUE 17): elastic fleet under churn.
+
+The elastic-membership claim is not "joins work on a quiet fleet" —
+it is that a fleet survives the full churn script **while serving**:
+replicas crash, a new replica joins at runtime (warm-state stream,
+then the atomic arc flip), a member drains out, and a router dies with
+clients failing over to its peer — all under sustained open-loop
+mixed-tenant load, with nothing a client can observe beyond counted
+admission sheds.
+
+The harness:
+
+* **Fleet** — 3 in-process host-backend replicas behind TWO peered
+  elastic routers (``--peers`` each other); clients prefer router 0
+  and fail over to router 1 on a transport error.  A fourth,
+  fleet-detached replica is the **fault-free oracle**.
+* **Load** — an open-loop generator: arrivals on a fixed schedule
+  (``rate`` per second), each request on its own thread (bounded
+  in-flight), never waiting for the previous answer — overload shows
+  up as queueing, not as a politely slowed generator.  Families are
+  picked Zipf-style (weights ``1/(rank+1)^1.1``) so a hot head and a
+  long warm tail coexist; ~40% of picks churn the family's catalog by
+  a one-row delta first; tenants mix ``gold`` (priority lane) and
+  ``bulk`` traffic.
+* **Chaos script** (fractions of the run): 0.15 hard-kill a replica;
+  0.35 boot a NEW replica with ``--fleet-router`` (the real announce →
+  join-stream → arc-flip path) and wait for membership; 0.55 drain a
+  member through ``POST /fleet/drain``; 0.75 wait for the peer router
+  to gossip up to the latest epoch, then kill router 0.
+* **Verdict** — the run FAILS on any of: a client-visible error
+  (non-200 that is not a counted admission shed), a byte-identity
+  mismatch (every k-th successful response replayed on the oracle
+  after the run and compared), a shed landing on the ``gold`` tenant,
+  p99 over budget, or a post-join fleet-wide warm-hit ratio under the
+  floor (the join stream must actually carry the warm state — a fleet
+  that cold-solves after every flip "survives" by re-doing all its
+  work).
+
+Emits one JSON record in the bench.py contract; ``--out`` writes the
+full artifact (benchmarks/results/soak_r17.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .fleet import _family_doc, _metric, _mutate, _request
+from .harness import log
+
+# Client-side discrimination of 503s: the router's no-replica answer
+# is a route outage (an ERROR for the gate); anything else with a 503
+# status is a replica admission shed (counted per tenant, allowed for
+# bulk, fatal for gold).
+_OUTAGE_MARKER = b"no replica reachable"
+
+TENANT_WEIGHTS = json.dumps({
+    "gold": {"weight": 3, "priority": 0},
+    "bulk": {"weight": 1, "priority": 1},
+})
+
+
+def _zipf_weights(n: int, s: float = 1.1) -> List[float]:
+    return [1.0 / float(rank + 1) ** s for rank in range(n)]
+
+
+class _Stats:
+    """Thread-safe tally of everything the gate judges."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies: List[float] = []
+        self.post_join_latencies: List[float] = []
+        self.ok = 0
+        self.errors: List[str] = []
+        self.sheds: Dict[str, int] = {}
+        self.failovers = 0
+        self.generator_drops = 0
+        self.samples: List[tuple] = []   # (doc_json, results) replays
+        self.join_done_at: Optional[float] = None
+
+
+def _scrape_warm(port: int) -> Optional[Dict[str, float]]:
+    try:
+        status, body = _request(port, "GET", "/metrics")
+    except OSError:
+        return None
+    if status != 200:
+        return None
+    text = body.decode()
+    return {
+        "warm": _metric(text, "deppy_cache_hits_total")
+        + _metric(text, "deppy_incremental_hits_total"),
+        "asks": _metric(text, "deppy_cache_hits_total")
+        + _metric(text, "deppy_cache_misses_total"),
+    }
+
+
+class SoakFleet:
+    """The fleet + routers + oracle under test, and the chaos that
+    befalls them."""
+
+    def __init__(self, seconds: float, rate: float, seed: int,
+                 n_families: int, bundles: int, size: int,
+                 sample_every: int, max_in_flight: int):
+        from ..fleet import Router
+        from ..service import Server
+
+        self.seconds = float(seconds)
+        self.rate = float(rate)
+        self.rnd = random.Random(seed)
+        self.n_families = n_families
+        self.bundles = bundles
+        self.size = size
+        self.sample_every = max(int(sample_every), 1)
+        self.max_in_flight = max(int(max_in_flight), 1)
+        self.states: List[Dict[int, int]] = [dict()
+                                             for _ in range(n_families)]
+        self.weights = _zipf_weights(n_families)
+        self.stats = _Stats()
+        self._doc_lock = threading.Lock()
+
+        self.replicas = [
+            Server(bind_address="127.0.0.1:0",
+                   probe_address="127.0.0.1:0", backend="host",
+                   replica=f"soak{i}", tenant_weights=TENANT_WEIGHTS)
+            for i in range(3)]
+        for srv in self.replicas:
+            srv.start()
+        addrs = [f"127.0.0.1:{s.api_port}" for s in self.replicas]
+        # Two peered elastic routers.  Router 1's push loop converges
+        # both directions (each /fleet/sync exchange reconciles the
+        # inbound view AND answers with the local one), so router 0
+        # learning its peer address post-start is bookkeeping, not a
+        # gossip gap.
+        self.router0 = Router(bind_address="127.0.0.1:0",
+                              replicas=addrs, membership="elastic",
+                              probe_interval_s=0.3, probe_failures=2,
+                              sync_interval_s=0.4)
+        self.router0.start()
+        r0 = f"127.0.0.1:{self.router0.api_port}"
+        self.router1 = Router(bind_address="127.0.0.1:0",
+                              replicas=addrs, membership="elastic",
+                              peers=[r0], probe_interval_s=0.3,
+                              probe_failures=2, sync_interval_s=0.4)
+        self.router1.start()
+        self.router0.peers = [f"127.0.0.1:{self.router1.api_port}"]
+        self.router_ports = [self.router0.api_port,
+                             self.router1.api_port]
+        self._primary = 0
+        self.oracle = Server(bind_address="127.0.0.1:0",
+                             probe_address="127.0.0.1:0",
+                             backend="host", replica="oracle")
+        self.oracle.start()
+        self.joiner = None
+        self._warm_base: Dict[int, Dict[str, float]] = {}
+        self._warm_final: Dict[int, Dict[str, float]] = {}
+        self.chaos_log: List[str] = []
+        self.peer_view: Optional[dict] = None
+
+    # ---------------------------------------------------------- client
+
+    def _build_request(self) -> tuple:
+        """Pick tenant + family, maybe churn it, render the doc.
+        Serialized under one lock so churn deltas stay one-row."""
+        with self._doc_lock:
+            fam = self.rnd.choices(range(self.n_families),
+                                   weights=self.weights)[0]
+            if self.rnd.random() < 0.4:
+                _mutate(self.states[fam], self.rnd.randrange(1 << 20),
+                        self.bundles, self.size)
+            tenant = "gold" if self.rnd.random() < 0.25 else "bulk"
+            sample = (self.stats.ok + len(self.stats.errors)) \
+                % self.sample_every == 0
+            doc = _family_doc(f"soak.f{fam}.", self.states[fam],
+                              self.bundles, self.size)
+        return doc, tenant, sample
+
+    def _post_resolve(self, port: int, doc: dict, tenant: str):
+        from http.client import HTTPConnection
+
+        conn = HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request("POST", "/v1/resolve", body=json.dumps(doc),
+                         headers={"Content-Type": "application/json",
+                                  "X-Deppy-Tenant": tenant})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def _one_request(self, doc: dict, tenant: str, sample: bool):
+        st = self.stats
+        t0 = time.perf_counter()
+        try:
+            status, body = self._post_resolve(
+                self.router_ports[self._primary], doc, tenant)
+        except OSError:
+            # Router down: fail over to the peer and retry once —
+            # the "clients can hit any router" contract.
+            self._primary = 1 - self._primary
+            with st.lock:
+                st.failovers += 1
+            try:
+                status, body = self._post_resolve(
+                    self.router_ports[self._primary], doc, tenant)
+            except OSError as exc:
+                with st.lock:
+                    st.errors.append(f"both routers unreachable: {exc}")
+                return
+        dt = time.perf_counter() - t0
+        with st.lock:
+            if status == 200:
+                st.ok += 1
+                st.latencies.append(dt)
+                if st.join_done_at is not None:
+                    st.post_join_latencies.append(dt)
+                if sample:
+                    st.samples.append(
+                        (json.dumps(doc),
+                         json.loads(body)["results"]))
+            elif status == 503 and _OUTAGE_MARKER not in body:
+                st.sheds[tenant] = st.sheds.get(tenant, 0) + 1
+            else:
+                st.errors.append(
+                    f"HTTP {status} ({tenant}): {body[:160]!r}")
+
+    def _generate(self, stop_at: float):
+        """Open-loop arrivals: fixed interval, thread per request,
+        never blocked by a slow server (a full in-flight window is
+        counted, not waited out)."""
+        interval = 1.0 / max(self.rate, 0.1)
+        threads: List[threading.Thread] = []
+        next_at = time.monotonic()
+        while time.monotonic() < stop_at:
+            now = time.monotonic()
+            if now < next_at:
+                time.sleep(min(next_at - now, 0.05))
+                continue
+            next_at += interval
+            threads = [t for t in threads if t.is_alive()]
+            if len(threads) >= self.max_in_flight:
+                with self.stats.lock:
+                    self.stats.generator_drops += 1
+                continue
+            doc, tenant, sample = self._build_request()
+            t = threading.Thread(target=self._one_request,
+                                 args=(doc, tenant, sample),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=30)
+
+    # ----------------------------------------------------------- chaos
+
+    def _router_doc(self, port: int, path: str) -> Optional[dict]:
+        try:
+            status, body = _request(port, "GET", path)
+        except OSError:
+            return None
+        if status != 200:
+            return None
+        return json.loads(body)
+
+    def _kill_replica(self):
+        victim = self.replicas[2]
+        addr = f"127.0.0.1:{victim.api_port}"
+        victim.shutdown(drain_s=0)
+        self.chaos_log.append(f"killed replica {addr}")
+        log(f"  chaos: killed replica {addr}")
+
+    def _join_replica(self, deadline_s: float = 20.0):
+        from ..service import Server
+
+        self.joiner = Server(
+            bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+            backend="host", replica="joiner",
+            tenant_weights=TENANT_WEIGHTS,
+            fleet_router=f"127.0.0.1:{self.router0.api_port}")
+        self.joiner.start()
+        addr = f"127.0.0.1:{self.joiner.api_port}"
+        deadline = time.monotonic() + deadline_s
+        joined = False
+        while time.monotonic() < deadline:
+            doc = self._router_doc(self.router0.api_port,
+                                   "/fleet/replicas")
+            if doc and addr in doc.get("members", []):
+                joined = True
+                break
+            time.sleep(0.2)
+        if not joined:
+            with self.stats.lock:
+                self.stats.errors.append(
+                    f"joiner {addr} never became a member")
+            return
+        with self.stats.lock:
+            self.stats.join_done_at = time.monotonic()
+        # Post-join warm-accounting baseline: every replica serving
+        # from here to the end.
+        for srv in (self.replicas[0], self.replicas[1], self.joiner):
+            snap = _scrape_warm(srv.api_port)
+            if snap is not None:
+                self._warm_base[srv.api_port] = snap
+        self.chaos_log.append(f"joined replica {addr}")
+        log(f"  chaos: joined replica {addr} (arc flip committed)")
+
+    def _drain_replica(self):
+        victim = self.replicas[1]
+        addr = f"127.0.0.1:{victim.api_port}"
+        try:
+            status, body = _request(
+                self.router0.api_port, "POST", "/fleet/drain",
+                {"replica": addr})
+            if status != 200:
+                with self.stats.lock:
+                    self.stats.errors.append(
+                        f"drain of {addr}: HTTP {status}: "
+                        f"{body[:160]!r}")
+        except OSError as exc:
+            with self.stats.lock:
+                self.stats.errors.append(f"drain of {addr}: {exc}")
+        # The drained member's warm counters stop here; capture them
+        # as its final word before the process goes away.
+        snap = _scrape_warm(victim.api_port)
+        if snap is not None:
+            self._warm_final[victim.api_port] = snap
+        victim.shutdown(drain_s=0)
+        self.chaos_log.append(f"drained replica {addr}")
+        log(f"  chaos: drained replica {addr}")
+
+    def _kill_router(self, deadline_s: float = 10.0):
+        # The peer must have gossiped up to the latest epoch before
+        # the authoritative router dies, or the failover target would
+        # route on a stale ring.
+        want = self.router0.epoch
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            doc = self._router_doc(self.router1.api_port,
+                                   "/fleet/replicas")
+            if doc and doc.get("epoch", 0) >= want:
+                self.peer_view = {k: doc[k] for k in
+                                  ("epoch", "members", "membership")}
+                break
+            time.sleep(0.2)
+        if self.peer_view is None:
+            with self.stats.lock:
+                self.stats.errors.append(
+                    f"peer router never reached epoch {want}")
+        self.router0.shutdown()
+        self.chaos_log.append(
+            f"killed router 0 at epoch {want}; peer view "
+            f"{self.peer_view}")
+        log(f"  chaos: killed router 0 (peer at epoch "
+            f"{(self.peer_view or {}).get('epoch')})")
+
+    def _chaos(self, t0: float):
+        script = [(0.15, self._kill_replica),
+                  (0.35, self._join_replica),
+                  (0.55, self._drain_replica),
+                  (0.75, self._kill_router)]
+        for frac, action in script:
+            delay = t0 + frac * self.seconds - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                action()
+            except Exception as exc:  # deppy: lint-ok[exception-hygiene] — a chaos step must not silently end the script; the failure is the run's verdict
+                with self.stats.lock:
+                    self.stats.errors.append(
+                        f"chaos step {action.__name__}: "
+                        f"{type(exc).__name__}: {exc}")
+
+    # ----------------------------------------------------------- gates
+
+    def _replay_oracle(self) -> int:
+        mismatches = 0
+        for doc_json, results in self.stats.samples:
+            status, body = _request(self.oracle.api_port, "POST",
+                                    "/v1/resolve",
+                                    json.loads(doc_json))
+            if status != 200:
+                mismatches += 1
+                continue
+            if json.loads(body)["results"] != results:
+                mismatches += 1
+        return mismatches
+
+    def _warm_hit_post_join(self) -> Optional[float]:
+        if not self._warm_base:
+            return None
+        for port, base in self._warm_base.items():
+            if port in self._warm_final:
+                continue
+            snap = _scrape_warm(port)
+            if snap is not None:
+                self._warm_final[port] = snap
+        warm = asks = 0.0
+        for port, base in self._warm_base.items():
+            final = self._warm_final.get(port)
+            if final is None:
+                continue
+            warm += final["warm"] - base["warm"]
+            asks += final["asks"] - base["asks"]
+        if asks <= 0:
+            return None
+        return warm / asks
+
+    def shutdown(self):
+        for router in (self.router0, self.router1):
+            try:
+                router.shutdown()
+            except Exception:  # deppy: lint-ok[exception-hygiene] — already chaos-killed routers re-shutdown on the cleanup path
+                pass
+        servers = [s for s in self.replicas if s is not None]
+        if self.joiner is not None:
+            servers.append(self.joiner)
+        servers.append(self.oracle)
+        for srv in servers:
+            try:
+                srv.shutdown(drain_s=0)
+            except Exception:  # deppy: lint-ok[exception-hygiene] — chaos-killed replicas re-shutdown on the cleanup path
+                pass
+
+
+def run_soak(seconds: float = 75.0, rate: float = 25.0,
+             seed: int = 1117, n_families: int = 12, bundles: int = 5,
+             size: int = 6, sample_every: int = 7,
+             max_in_flight: int = 64, p99_budget_ms: float = 2000.0,
+             warm_hit_floor: float = 0.8,
+             out_path: Optional[str] = None) -> dict:
+    from ..telemetry import percentile
+
+    log(f"soak workload: {seconds:.0f}s at {rate}/s open-loop, "
+        f"{n_families} Zipf families over a {bundles}x{size} catalog, "
+        f"3 replicas + runtime joiner, 2 peered routers, seed {seed}")
+    fleet = SoakFleet(seconds, rate, seed, n_families, bundles, size,
+                      sample_every, max_in_flight)
+    st = fleet.stats
+    try:
+        t0 = time.monotonic()
+        chaos = threading.Thread(target=fleet._chaos, args=(t0,),
+                                 name="soak-chaos", daemon=True)
+        chaos.start()
+        fleet._generate(t0 + seconds)
+        chaos.join(timeout=30)
+        wall = time.monotonic() - t0
+        mismatches = fleet._replay_oracle()
+        warm_hit = fleet._warm_hit_post_join()
+        lat = sorted(st.latencies)
+        p99_ms = round(percentile(lat, 99) * 1e3, 3) if lat else 0.0
+        p50_ms = round(percentile(lat, 50) * 1e3, 3) if lat else 0.0
+        gates = {
+            "client_errors": len(st.errors) == 0,
+            "byte_identity": mismatches == 0,
+            "gold_sheds": st.sheds.get("gold", 0) == 0,
+            "p99_budget": bool(lat) and p99_ms <= p99_budget_ms,
+            "warm_hit_post_join": (warm_hit is not None
+                                   and warm_hit >= warm_hit_floor),
+            "chaos_script_complete": len(fleet.chaos_log) == 4,
+        }
+        passed = all(gates.values())
+        record = {
+            "metric": ("soak survival p99 ms (open-loop churn across "
+                       "kill/join/drain/router-failover)"),
+            "value": p99_ms,
+            "unit": "ms",
+            "vs_baseline": round(warm_hit, 4) if warm_hit is not None
+            else 0.0,
+            "workload": "soak",
+            "passed": passed,
+            "gates": gates,
+            "seconds": round(wall, 1),
+            "rate": rate,
+            "requests_ok": st.ok,
+            "errors": st.errors[:20],
+            "sheds": st.sheds,
+            "failovers": st.failovers,
+            "generator_drops": st.generator_drops,
+            "oracle_samples": len(st.samples),
+            "oracle_mismatches": mismatches,
+            "p50_ms": p50_ms,
+            "p99_ms": p99_ms,
+            "warm_hit_post_join": (round(warm_hit, 4)
+                                   if warm_hit is not None else None),
+            "chaos_log": fleet.chaos_log,
+            "peer_view_at_router_kill": fleet.peer_view,
+            "backend": "host",
+        }
+    finally:
+        fleet.shutdown()
+    log(f"soak verdict: {'PASS' if passed else 'FAIL'}  "
+        f"ok {st.ok}  errors {len(st.errors)}  sheds {st.sheds}  "
+        f"p99 {p99_ms}ms  warm-hit(post-join) {warm_hit}  "
+        f"mismatches {mismatches}  failovers {st.failovers}")
+    for err in st.errors[:10]:
+        log(f"  error: {err}")
+    if out_path:
+        import os
+        import platform
+
+        full = {
+            "issue": 17,
+            "record": "soak_r17",
+            "platform": {
+                "python": platform.python_version(),
+                "machine": platform.machine(),
+                "cpus": os.cpu_count(),
+                "jax_platforms": (os.environ.get("JAX_PLATFORMS")
+                                  or "(default)"),
+            },
+            "note": ("open-loop Zipf mixed-tenant load over an elastic "
+                     "3-replica fleet + runtime joiner behind two "
+                     "peered routers; chaos script = replica kill, "
+                     "runtime join (warm-state stream + arc flip), "
+                     "drain, router kill with client failover.  The "
+                     "gate is all-of: zero client-visible errors "
+                     "beyond counted bulk admission sheds, sampled "
+                     "byte-identity vs a fault-free oracle, zero gold "
+                     "sheds, p99 under budget, post-join fleet "
+                     "warm-hit ratio over the floor."),
+            "result": record,
+        }
+        d = os.path.dirname(out_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(full, fh, indent=1)
+            fh.write("\n")
+        log(f"wrote {out_path}")
+    return record
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seconds", type=float, default=75.0)
+    ap.add_argument("--rate", type=float, default=25.0)
+    ap.add_argument("--seed", type=int, default=1117)
+    ap.add_argument("--n-families", type=int, default=12)
+    ap.add_argument("--p99-budget-ms", type=float, default=2000.0)
+    ap.add_argument("--warm-hit-floor", type=float, default=0.8)
+    ap.add_argument("--out", default=None,
+                    help="write the full artifact JSON here "
+                    "(benchmarks/results/soak_r17.json)")
+    args = ap.parse_args()
+    record = run_soak(seconds=args.seconds, rate=args.rate,
+                      seed=args.seed, n_families=args.n_families,
+                      p99_budget_ms=args.p99_budget_ms,
+                      warm_hit_floor=args.warm_hit_floor,
+                      out_path=args.out)
+    print(json.dumps(record), flush=True)
+    return 0 if record.get("passed") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

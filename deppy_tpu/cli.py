@@ -319,6 +319,20 @@ def _build_parser() -> argparse.ArgumentParser:
         "deppy_costmodel_drift_ratio gauge",
     )
     p_serve.add_argument(
+        "--fleet-router", default=None, metavar="HOST:PORT",
+        help="announce this replica to the fleet router at HOST:PORT "
+        "(ISSUE 17; also via DEPPY_TPU_FLEET_ROUTER): POST /fleet/join "
+        "once serving starts — the router streams the warm state this "
+        "replica's arcs inherit, then flips the ring atomically — and "
+        "leave via the drain handoff on graceful shutdown",
+    )
+    p_serve.add_argument(
+        "--fleet-advertise", default=None, metavar="HOST:PORT",
+        help="the address this replica advertises when joining a fleet "
+        "(default 127.0.0.1:<api-port>; also via "
+        "DEPPY_TPU_FLEET_ADVERTISE)",
+    )
+    p_serve.add_argument(
         "--mesh-devices", type=_mesh_devices_arg, default=None,
         metavar="N|all",
         help="shard each coalesced micro-batch across N accelerator "
@@ -370,6 +384,22 @@ def _build_parser() -> argparse.ArgumentParser:
         help="routing policy (default affinity; roundrobin exists as "
         "the warm-state-destroying baseline for bench.py --workload "
         "fleet)",
+    )
+    p_route.add_argument(
+        "--membership", choices=["elastic", "static"], default=None,
+        help="fleet membership mode (default elastic; also via "
+        "DEPPY_TPU_FLEET).  elastic arms runtime joins (POST "
+        "/fleet/join — chunked warm-state streaming, then an atomic "
+        "arc flip), drain-as-leave ring removal with a membership "
+        "epoch, peer gossip, and GET /fleet/policy; static restores "
+        "the PR 15 immutable-ring surface byte for byte",
+    )
+    p_route.add_argument(
+        "--peers", default=None, metavar="HOST:PORT[,...]",
+        help="peer router addresses for membership gossip, comma-"
+        "separated (ISSUE 17; also via DEPPY_TPU_FLEET_PEERS): routers "
+        "exchange epoch-versioned ring views over POST /fleet/sync so "
+        "clients can hit any of them",
     )
     p_route.add_argument(
         "--telemetry-file", default=None, metavar="FILE",
@@ -608,6 +638,42 @@ def _build_parser() -> argparse.ArgumentParser:
         "compile-guard status) instead of reading a sink",
     )
 
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="elastic fleet operations against a running router "
+        "(ISSUE 17): print the SLO-burn autoscale recommendation "
+        "(GET /fleet/policy), and optionally apply it in local-process "
+        "mode — execution stays operator-driven",
+    )
+    fleet_sub = p_fleet.add_subparsers(dest="fleet_command")
+    p_fpolicy = fleet_sub.add_parser(
+        "policy",
+        help="print the router's current autoscale recommendation "
+        "(scale_up / scale_down / rebalance / hold) as JSON",
+    )
+    p_fscale = fleet_sub.add_parser(
+        "scale",
+        help="fetch the recommendation; with --apply, execute it "
+        "locally: scale_up spawns a `deppy serve --fleet-router` "
+        "replica on a free port (it joins via the warm-state stream + "
+        "arc flip), scale_down/rebalance drains the suggested replica",
+    )
+    for p_f in (p_fpolicy, p_fscale):
+        p_f.add_argument(
+            "--router", default="127.0.0.1:8079", metavar="HOST:PORT",
+            help="fleet router address (default 127.0.0.1:8079)",
+        )
+    p_fscale.add_argument(
+        "--apply", action="store_true",
+        help="execute the recommendation in local-process mode (the "
+        "bench/soak harness); without it the recommendation is only "
+        "printed",
+    )
+    p_fscale.add_argument(
+        "--backend", default="host",
+        help="backend for a replica spawned by scale_up (default host)",
+    )
+
     p_doctor = sub.add_parser(
         "doctor",
         help="diagnose the accelerator backend (probe in a killable "
@@ -651,6 +717,8 @@ _CONFIG_KEYS = {
     "obsStream": ("obs_stream", str),
     "obsFlushMs": ("obs_flush_ms", float),
     "obsBaseline": ("obs_baseline", str),
+    "fleetRouter": ("fleet_router", str),
+    "fleetAdvertise": ("fleet_advertise", str),
 }
 
 
@@ -784,10 +852,115 @@ def _cmd_route(args) -> int:
                      probe_interval_s=args.probe_interval,
                      probe_failures=args.probe_failures,
                      policy=args.policy,
-                     obs_sink=args.obs_sink)
+                     obs_sink=args.obs_sink,
+                     membership=args.membership,
+                     peers=args.peers)
     except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    return 0
+
+
+def _cmd_fleet(args) -> int:
+    """Elastic fleet operations (ISSUE 17): `deppy fleet policy`
+    prints the router's autoscale recommendation; `deppy fleet scale
+    --apply` executes it in local-process mode — scale_up spawns a
+    joining replica, scale_down/rebalance drains the suggested victim.
+    Exit 0 on success, 1 on a router-side error, 2 on usage/transport
+    errors."""
+    from http.client import HTTPConnection
+
+    if not getattr(args, "fleet_command", None):
+        print("error: fleet requires a subcommand (policy, scale)",
+              file=sys.stderr)
+        return 2
+    host, _, port = args.router.rpartition(":")
+    try:
+        port_n = int(port)
+    except ValueError:
+        print(f"error: invalid --router address {args.router!r} "
+              "(want HOST:PORT)", file=sys.stderr)
+        return 2
+    host = host or "127.0.0.1"
+
+    def _exchange(method: str, path: str, doc=None, timeout=120.0):
+        conn = HTTPConnection(host, port_n, timeout=timeout)
+        try:
+            conn.request(
+                method, path,
+                body=json.dumps(doc).encode() if doc is not None
+                else None,
+                headers={"Content-Type": "application/json"}
+                if doc is not None else {})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    try:
+        status, body = _exchange("GET", "/fleet/policy")
+    except OSError as e:
+        print(f"error: router {args.router} unreachable: {e}",
+              file=sys.stderr)
+        return 2
+    if status != 200:
+        print(f"error: GET /fleet/policy -> HTTP {status}: "
+              f"{body[:200].decode('utf-8', 'replace')}",
+              file=sys.stderr)
+        return 1
+    policy = json.loads(body).get("policy") or {}
+    print(json.dumps(policy, indent=2))
+    if args.fleet_command == "policy" or not args.apply:
+        return 0
+    decision = policy.get("decision")
+    if decision == "hold":
+        print("fleet scale: hold — nothing to apply")
+        return 0
+    if decision == "scale_up":
+        import socket
+        import subprocess
+
+        ports = []
+        for _ in range(2):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        addr = f"127.0.0.1:{ports[0]}"
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import sys; from deppy_tpu.cli import main; "
+             "sys.exit(main())",
+             "serve", "--bind-address", addr,
+             "--health-probe-bind-address", f"127.0.0.1:{ports[1]}",
+             "--backend", args.backend,
+             "--replica", f"scale{ports[0]}",
+             "--fleet-router", args.router,
+             "--fleet-advertise", addr],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        print(f"fleet scale: spawned replica {addr} (pid {proc.pid}); "
+              "it joins the ring once its warm-state stream lands")
+        return 0
+    target = policy.get("target")
+    if not target:
+        print("fleet scale: recommendation names no target replica; "
+              "nothing to apply")
+        return 0
+    try:
+        status, body = _exchange("POST", "/fleet/drain",
+                                 {"replica": target})
+    except OSError as e:
+        print(f"error: drain of {target} failed: {e}", file=sys.stderr)
+        return 1
+    if status != 200:
+        print(f"error: POST /fleet/drain -> HTTP {status}: "
+              f"{body[:200].decode('utf-8', 'replace')}",
+              file=sys.stderr)
+        return 1
+    out = json.loads(body).get("drain") or {}
+    print(f"fleet scale: drained {target} ({decision}); handed off "
+          f"{out.get('handed_off', 0)} warm entries to "
+          f"{sorted(out.get('recipients') or {})}")
     return 0
 
 
@@ -1435,6 +1608,8 @@ def _cmd_serve(args) -> int:
         "obs_stream": None,
         "obs_flush_ms": None,
         "obs_baseline": None,
+        "fleet_router": None,
+        "fleet_advertise": None,
     }
     try:
         if args.config:
@@ -1467,6 +1642,8 @@ def _cmd_serve(args) -> int:
             ("obs_stream", args.obs_stream),
             ("obs_flush_ms", args.obs_flush_ms),
             ("obs_baseline", args.obs_baseline),
+            ("fleet_router", args.fleet_router),
+            ("fleet_advertise", args.fleet_advertise),
         ):
             if val is not None:
                 kwargs[key] = val
@@ -1528,6 +1705,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_bench(args)
     if args.command == "serve":
         return _cmd_serve(args)
+    if args.command == "fleet":
+        return _cmd_fleet(args)
     if args.command == "route":
         return _cmd_route(args)
     if args.command == "publish":

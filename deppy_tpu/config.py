@@ -226,6 +226,63 @@ _DECLARATIONS: List[EnvVar] = [
        "service.request span so burn rate is attributable per tenant "
        "per replica; unset keeps single-process surfaces unchanged.",
        flag="--replica", config_key="replica"),
+    # --- elastic membership (ISSUE 17) -----------------------------------
+    _v("DEPPY_TPU_FLEET", "str", "elastic", "deppy_tpu.fleet.membership",
+       "Fleet membership mode (also --membership on `deppy route`): "
+       "'elastic' arms runtime joins (POST /fleet/join — chunked "
+       "warm-state streaming, then an atomic arc flip), drain-as-leave "
+       "ring removal with a membership epoch, peer gossip (POST "
+       "/fleet/sync), and GET /fleet/policy; 'static' restores the "
+       "PR 15 immutable-ring surface byte for byte.",
+       flag="--membership"),
+    _v("DEPPY_TPU_FLEET_PEERS", "str", None, "deppy_tpu.fleet.router",
+       "Peer router addresses for membership gossip, comma-separated "
+       "host:port (also --peers on `deppy route`): routers exchange "
+       "epoch-versioned ring views so clients can hit any of them and "
+       "a dead router is not an outage.",
+       flag="--peers"),
+    _v("DEPPY_TPU_FLEET_SYNC_INTERVAL_S", "float", 2.0,
+       "deppy_tpu.fleet.router",
+       "Seconds between membership gossip rounds with the peer list "
+       "(jittered like the probe loop; 0 disables the background loop "
+       "— inbound POST /fleet/sync still reconciles).",),
+    _v("DEPPY_TPU_FLEET_PROBE_JITTER", "float", 0.2,
+       "deppy_tpu.fleet.router",
+       "Random fraction of the probe (and gossip) interval added to "
+       "each cycle's sleep, clamped to [0, 1] — the lease renew_jitter "
+       "pattern, so a large fleet's probes do not thunder in lockstep."),
+    _v("DEPPY_TPU_FLEET_JOIN_CHUNK", "int", 64,
+       "deppy_tpu.fleet.membership",
+       "Warm-state entries per checksummed join-stream chunk: a "
+       "joining replica's inherited index entries and cache seeds "
+       "stream in bounded, individually sealed chunks so a truncated "
+       "transfer is rejected loudly and resumes per chunk."),
+    _v("DEPPY_TPU_FLEET_JOIN_RETRIES", "int", 2,
+       "deppy_tpu.fleet.membership",
+       "Resend attempts per failed join-stream chunk before the join "
+       "aborts (membership unchanged — the arc flip only happens once "
+       "the whole stream lands)."),
+    _v("DEPPY_TPU_FLEET_ROUTER", "str", None, "deppy_tpu.service",
+       "Fleet router address this replica announces itself to (also "
+       "--fleet-router): POST /fleet/join once serving starts, and the "
+       "drain handoff (leave) on graceful shutdown; unset keeps the "
+       "standalone lifecycle byte for byte.",
+       flag="--fleet-router", config_key="fleetRouter"),
+    _v("DEPPY_TPU_FLEET_ADVERTISE", "str", None, "deppy_tpu.service",
+       "host:port this replica advertises when joining a fleet (also "
+       "--fleet-advertise); defaults to 127.0.0.1:<api-port>, which "
+       "only holds for single-host fleets.",
+       flag="--fleet-advertise", config_key="fleetAdvertise"),
+    _v("DEPPY_TPU_FLEET_BURN_UP", "float", 1.0, "deppy_tpu.fleet.policy",
+       "Per-tenant SLO burn-rate threshold above which the autoscale "
+       "policy recommends scale_up (no cold capacity) or rebalance "
+       "(cold capacity exists) on GET /fleet/policy."),
+    _v("DEPPY_TPU_FLEET_BURN_DOWN", "float", 0.25,
+       "deppy_tpu.fleet.policy",
+       "Per-tenant SLO burn-rate floor: every replica under it with an "
+       "idle queue recommends scale_down; execution stays "
+       "operator-driven (`deppy fleet scale --apply` is the "
+       "local-process mode for the bench/soak harness)."),
     # --- scheduler fairness (ISSUE 15) -----------------------------------
     _v("DEPPY_TPU_SCHED_FAIR", "str", "on", "deppy_tpu.sched.scheduler",
        "Weighted-fair per-tenant admission + priority lanes: 'on' "
@@ -259,6 +316,12 @@ _DECLARATIONS: List[EnvVar] = [
        "(deppy_obs_stream_dropped_total) instead of stalling serving."),
     _v("DEPPY_TPU_OBS_BATCH", "int", 256, "deppy_tpu.obs.stream",
        "Max events per streamed POST /fleet/telemetry batch."),
+    _v("DEPPY_TPU_OBS_BACKOFF_MAX_S", "float", 5.0,
+       "deppy_tpu.obs.stream",
+       "Ceiling in seconds on the streamer's bounded exponential "
+       "hold-off after a failed telemetry POST (resumed streaks are "
+       "counted on deppy_obs_stream_reconnects_total); the final "
+       "close() flush bypasses the hold-off."),
     _v("DEPPY_TPU_OBS_SINK", "path", None, "deppy_tpu.obs.aggregate",
        "Router-side merged fleet sink: JSONL path the telemetry "
        "aggregator appends replica-stamped events to (also --obs-sink "

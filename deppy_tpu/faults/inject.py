@@ -87,6 +87,9 @@ KNOWN_POINTS = (
     "hostpool.dispatch",
     "hostpool.worker_crash",
     "fleet.forward",
+    "fleet.join_stream",
+    "fleet.arc_flip",
+    "router.peer_sync",
 )
 
 

@@ -26,8 +26,19 @@ process:
 The scheduler side of the fleet story — per-tenant weighted-fair
 admission and priority lanes replacing the global-depth 503 — lives in
 :mod:`deppy_tpu.sched.scheduler` (``DEPPY_TPU_SCHED_FAIR``).
+
+ISSUE 17 makes the ring breathe: :mod:`.membership` adds runtime joins
+(``POST /fleet/join`` — chunked warm-state streaming, then an atomic
+arc flip), drain-as-leave epoch bumps, and epoch-versioned peer gossip
+(``POST /fleet/sync``); :mod:`.policy` turns the federated per-tenant
+SLO burn rate into ``scale_up``/``scale_down``/``rebalance``
+recommendations (``GET /fleet/policy``).  ``DEPPY_TPU_FLEET=static``
+restores the PR 15 static-ring surface byte for byte.
 """
 
+from .membership import (join_replica, membership_mode,  # noqa: F401
+                         membership_view, reconcile)
+from .policy import decide as policy_decide  # noqa: F401
 from .ring import HashRing, affinity_key, doc_affinity_keys  # noqa: F401
 from .router import Router  # noqa: F401
 from .snapshot import (SNAPSHOT_VERSION, SnapshotFormatError,  # noqa: F401

@@ -31,11 +31,23 @@ dying is still there).  A transport failure on a live forward charges
 the same breaker and the request retries ONCE on the key's ring
 successor, so a replica crash degrades only its in-flight requests by
 one retry, never to client-visible errors.
+
+**Elastic membership (ISSUE 17).**  With ``DEPPY_TPU_FLEET=elastic``
+(the default) the ring is no longer fixed at boot: ``POST /fleet/join``
+admits a new replica after streaming it the warm state it inherits
+(:mod:`.membership` — the atomic arc flip), a drain additionally
+removes the replica from the ring and bumps the membership epoch, and
+routers on a static ``--peers`` list gossip epoch-versioned ring views
+over ``POST /fleet/sync`` so clients can hit any router.
+``GET /fleet/policy`` surfaces the SLO-burn autoscale recommendation
+(:mod:`.policy`).  ``DEPPY_TPU_FLEET=static`` restores the PR 15
+surface byte for byte: those endpoints 404 and the ring never rebuilds.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import threading
 from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler
@@ -47,6 +59,8 @@ from .snapshot import SnapshotFormatError, split_snapshot, verify_snapshot
 
 DEFAULT_PROBE_INTERVAL_S = 2.0
 DEFAULT_PROBE_FAILURES = 3
+DEFAULT_PROBE_JITTER = 0.2
+DEFAULT_SYNC_INTERVAL_S = 2.0
 # Forwarded solves can legitimately take minutes (budget escalation on
 # a cold device path); transport-level hangs are the prober's job.
 FORWARD_TIMEOUT_S = 600.0
@@ -92,6 +106,21 @@ def _split_host_port(address: str) -> Tuple[str, int]:
     return host or "127.0.0.1", int(port)
 
 
+def _peer_exchange(peer: str, payload: bytes,
+                   timeout: float = PROBE_TIMEOUT_S * 2
+                   ) -> Tuple[int, bytes]:
+    """One ``POST /fleet/sync`` exchange with a peer router."""
+    host, port = _split_host_port(peer)
+    conn = HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/fleet/sync", body=payload,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
 class Router:
     """The replica-fleet affinity router."""
 
@@ -105,6 +134,10 @@ class Router:
         policy: str = "affinity",
         max_body_bytes: int = 8 * 1024 * 1024,
         obs_sink: Optional[str] = None,
+        membership: Optional[str] = None,
+        peers=None,
+        probe_jitter: Optional[float] = None,
+        sync_interval_s: Optional[float] = None,
     ):
         from ..analysis import lockdep
 
@@ -134,6 +167,33 @@ class Router:
         self.probe_interval_s = max(float(probe_interval_s or 0.0), 0.0)
         self.probe_failures = max(int(probe_failures), 1)
         self.max_body_bytes = max_body_bytes
+        # Elastic membership (ISSUE 17): 'elastic' arms runtime joins
+        # (POST /fleet/join), drain-as-leave ring removal, peer gossip
+        # (POST /fleet/sync) and GET /fleet/policy; 'static'
+        # (DEPPY_TPU_FLEET=static) keeps the PR 15 immutable-ring
+        # surface byte for byte — those endpoints 404 and the epoch
+        # never surfaces.
+        from .membership import membership_mode
+
+        self.membership = membership_mode(membership)
+        self.epoch = 1
+        if peers is None:
+            peers = config.env_str("DEPPY_TPU_FLEET_PEERS")
+        if isinstance(peers, str):
+            peers = [p for p in (t.strip() for t in peers.split(","))
+                     if p]
+        self.peers: List[str] = list(dict.fromkeys(peers or []))
+        if probe_jitter is None:
+            probe_jitter = faults.env_float(
+                "DEPPY_TPU_FLEET_PROBE_JITTER", DEFAULT_PROBE_JITTER,
+                warn=True)
+        self.probe_jitter = min(max(float(probe_jitter or 0.0), 0.0),
+                                1.0)
+        if sync_interval_s is None:
+            sync_interval_s = faults.env_float(
+                "DEPPY_TPU_FLEET_SYNC_INTERVAL_S",
+                DEFAULT_SYNC_INTERVAL_S, warn=True)
+        self.sync_interval_s = max(float(sync_interval_s or 0.0), 0.0)
         self._lock = lockdep.make_lock("fleet.router")
         self._replicas: Dict[str, _Replica] = {
             a: _Replica(a) for a in addresses}
@@ -169,6 +229,27 @@ class Router:
             "deppy_fleet_handoff_entries_total",
             "Warm-state entries (index entries + cache seeds) handed "
             "off to arc inheritors during drains.")
+        # Elastic-only families register only in elastic mode so the
+        # static /metrics page stays byte-identical to PR 15.
+        self._c_joins = self._c_join_chunks = None
+        self._c_peer_syncs = self._c_policy_evals = None
+        if self.elastic:
+            self._c_joins = r.counter(
+                "deppy_fleet_joins_total",
+                "Runtime replica joins committed (atomic arc flips "
+                "after a complete warm-state stream).")
+            self._c_join_chunks = r.counter(
+                "deppy_fleet_join_chunks_total",
+                "Checksummed warm-state chunks streamed to joining "
+                "replicas.")
+            self._c_peer_syncs = r.counter(
+                "deppy_fleet_peer_syncs_total",
+                "Membership gossip exchanges with peer routers, by "
+                "outcome.", labelname="outcome").preset("ok", "error")
+            self._c_policy_evals = r.counter(
+                "deppy_fleet_policy_evals_total",
+                "Autoscale policy evaluations (GET /fleet/policy), by "
+                "decision.", labelname="decision")
         # Fleet observability plane (ISSUE 16): --obs-sink /
         # DEPPY_TPU_OBS_SINK names the merged fleet JSONL sink.
         # Replicas batch-push their sink events to POST /fleet/telemetry
@@ -194,6 +275,7 @@ class Router:
                 self._obs_forwarders.append((reg, _to_sink))
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
+        self._sync_thread: Optional[threading.Thread] = None
         from ..service import _make_http_server, _parse_addr
 
         self._api = _make_http_server(_parse_addr(bind_address),
@@ -206,6 +288,10 @@ class Router:
     def api_port(self) -> int:
         return self._api.server_address[1]
 
+    @property
+    def elastic(self) -> bool:
+        return self.membership == "elastic"
+
     def _unroutable_locked(self) -> frozenset:
         return frozenset(a for a, st in self._replicas.items()
                          if st.dead or st.drained)
@@ -213,7 +299,8 @@ class Router:
     def live_replicas(self) -> List[str]:
         with self._lock:
             dead = self._unroutable_locked()
-        return [a for a in self.ring.replicas if a not in dead]
+            ring = self.ring
+        return [a for a in ring.replicas if a not in dead]
 
     def target_for(self, key: Optional[str],
                    exclude=()) -> Optional[str]:
@@ -222,14 +309,18 @@ class Router:
         point of the baseline."""
         with self._lock:
             dead = self._unroutable_locked() | frozenset(exclude)
+            # Capture the ring inside the critical section: an elastic
+            # arc flip swaps ``self.ring`` wholesale, and routing must
+            # see one consistent (ring, health) pair.
+            ring = self.ring
             if self.policy == "roundrobin":
-                live = [a for a in self.ring.replicas if a not in dead]
+                live = [a for a in ring.replicas if a not in dead]
                 if not live:
                     return None
                 target = live[self._rr_next % len(live)]
                 self._rr_next += 1
                 return target
-        return self.ring.route(key, exclude=dead)
+        return ring.route(key, exclude=dead)
 
     def note_transport_failure(self, address: str) -> None:
         """A probe or live forward could not reach ``address``: charge
@@ -295,8 +386,15 @@ class Router:
 
     # ----------------------------------------------------------- probing
 
+    def _jittered(self, base: float, rng=random.random) -> float:
+        """One cycle's sleep with jitter (ISSUE 17 satellite — the
+        lease ``renew_jitter`` pattern): ``base`` plus a random
+        fraction of it, so a fleet of routers booted together does not
+        thunder every replica (or peer) in lockstep phase."""
+        return base + base * self.probe_jitter * rng()
+
     def _probe_loop(self) -> None:
-        while not self._stop.wait(self.probe_interval_s):
+        while not self._stop.wait(self._jittered(self.probe_interval_s)):
             with self._lock:
                 targets = [st.address for st in self._replicas.values()
                            if not st.drained]
@@ -318,10 +416,52 @@ class Router:
                 else:
                     self.note_transport_success(address)
 
+    # ---------------------------------------------------- peer gossip
+
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(self._jittered(self.sync_interval_s)):
+            self.sync_peers()
+
+    def sync_peers(self) -> dict:
+        """One gossip round (ISSUE 17): push our membership view to
+        every peer router and reconcile each answering view, so a
+        join/leave committed on either side converges on both.
+        Deliberately NOT :meth:`forward`: peers are not replicas — a
+        down peer must not charge any replica breaker or trip the
+        ``fleet.forward`` fault point."""
+        from .membership import membership_view, reconcile
+
+        payload = json.dumps({"view": membership_view(self)}).encode()
+        out = {"peers": len(self.peers), "ok": 0, "errors": 0}
+        for peer in self.peers:
+            if self._stop.is_set():
+                break
+            try:
+                faults.inject("router.peer_sync")
+                status, body = _peer_exchange(peer, payload)
+            except (OSError, faults.InjectedFault):
+                if self._c_peer_syncs is not None:
+                    self._c_peer_syncs.inc(label="error")
+                out["errors"] += 1
+                continue
+            ok = False
+            if status == 200:
+                try:
+                    remote = json.loads(body).get("view")
+                    reconcile(self, remote)
+                    ok = True
+                except (ValueError, json.JSONDecodeError):
+                    pass  # malformed peer answer: counted, next round
+            if self._c_peer_syncs is not None:
+                self._c_peer_syncs.inc(label="ok" if ok else "error")
+            out["ok" if ok else "errors"] += 1
+        return out
+
     # --------------------------------------------------------- lifecycle
 
     def start(self) -> None:
         t = threading.Thread(target=self._api.serve_forever,
+                             kwargs={"poll_interval": 0.05},
                              name="deppy-route", daemon=True)
         t.start()
         self._threads.append(t)
@@ -330,6 +470,11 @@ class Router:
                 target=self._probe_loop, name="deppy-route-probe",
                 daemon=True)
             self._probe_thread.start()
+        if self.elastic and self.peers and self.sync_interval_s > 0:
+            self._sync_thread = threading.Thread(
+                target=self._sync_loop, name="deppy-route-sync",
+                daemon=True)
+            self._sync_thread.start()
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -341,6 +486,10 @@ class Router:
         if t is not None:
             t.join(PROBE_TIMEOUT_S + self.probe_interval_s + 1.0)
             self._probe_thread = None
+        t = self._sync_thread
+        if t is not None:
+            t.join(PROBE_TIMEOUT_S * 2 + self.sync_interval_s + 1.0)
+            self._sync_thread = None
         for reg, fn in self._obs_forwarders:
             reg.remove_forwarder(fn)
         self._obs_forwarders = []
@@ -413,6 +562,19 @@ class Router:
             entries += len(shard["index"]) + len(shard["cache"])
         with self._lock:
             st.drained = True
+            if self.elastic:
+                survivors = [a for a in self.ring.replicas
+                             if a != address]
+                if survivors:
+                    # Leave = drain (ISSUE 17): in elastic mode the
+                    # drained replica leaves the ring itself — not just
+                    # route-time exclusion — and the membership epoch
+                    # advances so peer routers gossip the removal.
+                    # Routing outcomes are unchanged (a drained member
+                    # was already excluded on every walk).
+                    self.ring = HashRing(survivors,
+                                         vnodes=self.ring.vnodes)
+                    self.epoch += 1
         self._c_drains.inc()
         self._c_handoff.inc(entries)
         telemetry.default_registry().event(
@@ -437,6 +599,16 @@ class Router:
             lines.append(
                 f'deppy_fleet_replica_up{{replica="{st["replica"]}"}} '
                 f"{up}")
+        if self.elastic:
+            # Gated so the static-mode page stays byte-identical to
+            # PR 15 (the off-switch acceptance pin).
+            lines.append("# HELP deppy_fleet_epoch Monotonic membership"
+                         " epoch — increments on every committed "
+                         "join/leave arc flip (and on gossip adoption "
+                         "of a newer peer view).")
+            lines.append("# TYPE deppy_fleet_epoch gauge")
+            with self._lock:
+                lines.append(f"deppy_fleet_epoch {self.epoch}")
         return "\n".join(lines) + "\n"
 
 
@@ -523,10 +695,23 @@ def _router_handler(router: Router):
                 self._send(200, router.render_metrics().encode(),
                            "text/plain; version=0.0.4")
             elif path == "/fleet/replicas":
-                self._send_json(200, {
+                doc = {
                     "policy": router.policy,
                     "vnodes": router.ring.vnodes,
-                    "replicas": router.replica_states()})
+                    "replicas": router.replica_states()}
+                if router.elastic:
+                    # Appended after the PR 15 keys so the static-mode
+                    # body stays byte-identical (the off-switch pin).
+                    from .membership import membership_view
+
+                    view = membership_view(router)
+                    doc["membership"] = router.membership
+                    doc["epoch"] = view["epoch"]
+                    doc["members"] = view["members"]
+                    doc["peers"] = router.peers
+                self._send_json(200, doc)
+            elif path == "/fleet/policy":
+                self._policy()
             elif path == "/fleet/metrics":
                 # Metrics federation (ISSUE 16): every live replica
                 # scraped concurrently, families merged under the
@@ -550,6 +735,18 @@ def _router_handler(router: Router):
                 self._traces()
             else:
                 self._send_json(404, {"error": "not found"})
+
+        def _policy(self):
+            """SLO-burn autoscale recommendation (ISSUE 17): scrape the
+            fleet, run the policy, recommend.  Execution stays
+            operator-driven — this endpoint never mutates membership."""
+            if not router.elastic:
+                self._send_json(404, {"error": "not found"})
+                return
+            router._c_requests.inc(label="policy")
+            from .policy import evaluate
+
+            self._send_json(200, {"policy": evaluate(router)})
 
         def _traces(self):
             """Cross-replica trace lookup (ISSUE 16): only the replica
@@ -582,6 +779,10 @@ def _router_handler(router: Router):
                 self._fan_out(path)
             elif path == "/fleet/drain":
                 self._drain()
+            elif path == "/fleet/join":
+                self._join()
+            elif path == "/fleet/sync":
+                self._sync()
             elif path == "/fleet/telemetry":
                 self._telemetry()
             elif path == "/debug/dump":
@@ -828,11 +1029,76 @@ def _router_handler(router: Router):
             except ValueError as e:
                 self._send_json(400, {"error": str(e)})
                 return
-            except (OSError, SnapshotFormatError,
-                    json.JSONDecodeError) as e:
+            except (OSError, SnapshotFormatError, json.JSONDecodeError,
+                    faults.InjectedFault) as e:
+                # InjectedFault included (ISSUE 17 satellite): a
+                # fault-plan-poisoned fleet.forward during the handoff
+                # must surface as the same 502 a real transport failure
+                # does — and the replica stays routable either way.
                 self._send_json(502, {"error": f"drain failed: {e}"})
                 return
             self._send_json(200, {"drain": out})
+
+        def _join(self):
+            """Runtime membership join (ISSUE 17 tentpole): stream the
+            joiner its inherited warm state, then atomically flip its
+            arcs live.  Any failure before the flip leaves membership
+            exactly as it was — 502, joiner not admitted."""
+            if not router.elastic:
+                self._send_json(404, {"error": "not found"})
+                return
+            router._c_requests.inc(label="join")
+            raw = self._read_body()
+            if raw is None:
+                return
+            try:
+                doc = json.loads(raw or b"null")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send_json(400,
+                                {"error": f"invalid JSON body: {e}"})
+                return
+            if not isinstance(doc, dict) \
+                    or not isinstance(doc.get("replica"), str):
+                self._send_json(
+                    400, {"error": 'join requires {"replica": '
+                          '"host:port"}'})
+                return
+            from .membership import join_replica
+
+            try:
+                out = join_replica(router, doc["replica"])
+            except ValueError as e:
+                self._send_json(400, {"error": str(e)})
+                return
+            except (OSError, SnapshotFormatError, json.JSONDecodeError,
+                    faults.InjectedFault) as e:
+                self._send_json(502, {"error": f"join failed: {e}"})
+                return
+            self._send_json(200, {"join": out})
+
+        def _sync(self):
+            """Peer-router membership gossip (ISSUE 17): reconcile the
+            sender's epoch-versioned view, answer with ours — one
+            exchange converges both directions."""
+            if not router.elastic:
+                self._send_json(404, {"error": "not found"})
+                return
+            router._c_requests.inc(label="sync")
+            raw = self._read_body()
+            if raw is None:
+                return
+            from .membership import reconcile
+
+            try:
+                doc = json.loads(raw or b"null")
+                view = doc.get("view") if isinstance(doc, dict) \
+                    else None
+                out = reconcile(router, view)
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send_json(400,
+                                {"error": f"invalid sync view: {e}"})
+                return
+            self._send_json(200, {"view": out})
 
     return Handler
 
@@ -842,7 +1108,9 @@ def serve_router(bind_address: str = ":8079", replicas=None,
                  probe_interval_s: Optional[float] = None,
                  probe_failures: Optional[int] = None,
                  policy: str = "affinity",
-                 obs_sink: Optional[str] = None) -> None:
+                 obs_sink: Optional[str] = None,
+                 membership: Optional[str] = None,
+                 peers=None) -> None:
     """Blocking entry point for ``deppy route`` — the router analog of
     ``service.serve`` (SIGTERM/Ctrl-C stop it cleanly)."""
     import signal
@@ -851,7 +1119,8 @@ def serve_router(bind_address: str = ":8079", replicas=None,
     router = Router(bind_address=bind_address, replicas=replicas,
                     vnodes=vnodes, probe_interval_s=probe_interval_s,
                     probe_failures=probe_failures, policy=policy,
-                    obs_sink=obs_sink)
+                    obs_sink=obs_sink, membership=membership,
+                    peers=peers)
     router.start()
     stop = threading.Event()
 
@@ -874,9 +1143,13 @@ def serve_router(bind_address: str = ":8079", replicas=None,
     prev_usr2 = None
     if hasattr(signal, "SIGUSR2"):  # absent on Windows
         prev_usr2 = signal.signal(signal.SIGUSR2, _on_sigusr2)
+    extra = ""
+    if router.elastic:
+        extra = ", membership elastic" + (
+            f", {len(router.peers)} peer(s)" if router.peers else "")
     print(f"deppy fleet router listening on :{router.api_port} "
           f"({len(router.ring.replicas)} replicas, policy "
-          f"{router.policy})", flush=True)
+          f"{router.policy}{extra})", flush=True)
     try:
         while not stop.is_set():
             stop.wait(3600)

@@ -16,6 +16,13 @@ queue in batches of ``DEPPY_TPU_OBS_BATCH`` at most every
 ``{"replica": ..., "events": [...]}`` to ``/fleet/telemetry`` on the
 aggregator; a failed POST drops that batch (counted) rather than
 requeueing it, so the queue bound is real.
+
+After a failed POST the streamer additionally holds off for a bounded,
+exponentially growing interval (ISSUE 17: doubling from the flush
+period up to ``DEPPY_TPU_OBS_BACKOFF_MAX_S``) instead of re-hammering
+a restarting aggregator at full flush cadence; the first successful
+POST after a down streak resets the hold-off and is counted on
+``deppy_obs_stream_reconnects_total``.
 """
 
 from __future__ import annotations
@@ -23,6 +30,7 @@ from __future__ import annotations
 import http.client
 import json
 import threading
+import time
 from typing import List, Optional, Tuple
 
 # The streamer's own families (registered on the process registry only
@@ -33,6 +41,7 @@ STREAM_FAMILIES = (
     "deppy_obs_stream_dropped_total",
     "deppy_obs_stream_batches_total",
     "deppy_obs_stream_errors_total",
+    "deppy_obs_stream_reconnects_total",
 )
 
 POST_TIMEOUT_S = 5.0
@@ -70,6 +79,12 @@ class TelemetryStreamer:
         self._cap = max(int(queue_cap), 1)
         self._batch = max(int(batch), 1)
         self._flush_s = max(float(flush_ms), 1.0) / 1000.0
+        backoff_max = config.env_float("DEPPY_TPU_OBS_BACKOFF_MAX_S",
+                                       5.0, strict=False)
+        self._backoff_max_s = max(float(backoff_max), 0.0)
+        self._backoff_s = 0.0     # current hold-off (0 = healthy)
+        self._retry_at = 0.0      # monotonic deadline of the hold-off
+        self._down = False        # last POST failed
         self._lock = lockdep.make_lock("obs.stream")
         self._queue: List[dict] = []
         self._wake = threading.Event()
@@ -93,6 +108,11 @@ class TelemetryStreamer:
             "deppy_obs_stream_errors_total",
             "Telemetry batch POSTs that failed (batch dropped, not "
             "requeued).")
+        self._c_reconnects = reg.counter(
+            "deppy_obs_stream_reconnects_total",
+            "Successful POSTs that ended a failed-POST streak: the "
+            "streamer resumed after its bounded exponential hold-off "
+            "(ISSUE 17).")
 
     # --------------------------------------------------------- event side
 
@@ -149,8 +169,14 @@ class TelemetryStreamer:
 
     def flush(self) -> None:
         """Drain the queue in batches; called from the drain thread and
-        from tests."""
+        from tests.  While a failed-POST hold-off is pending, flush is
+        a no-op (events keep queueing, bounded as ever) — except the
+        final ``close()`` flush, which bypasses the hold-off for one
+        last delivery attempt."""
         while True:
+            if self._down and not self._stop.is_set() \
+                    and time.monotonic() < self._retry_at:
+                return
             with self._lock:
                 batch = self._queue[: self._batch]
                 del self._queue[: len(batch)]
@@ -158,8 +184,23 @@ class TelemetryStreamer:
                 return
             if self._post(batch):
                 self._c_batches.inc()
+                if self._down:
+                    self._down = False
+                    self._backoff_s = 0.0
+                    self._c_reconnects.inc()
             else:
                 self._c_errors.inc()
+                base = max(self._flush_s, 0.05)
+                grown = self._backoff_s * 2.0 if self._backoff_s \
+                    else base
+                self._backoff_s = min(grown, self._backoff_max_s) \
+                    if self._backoff_max_s else base
+                self._retry_at = time.monotonic() + self._backoff_s
+                self._down = True
+                # This batch is dropped (the queue bound stays real);
+                # the REST of the queue waits out the hold-off rather
+                # than feeding a dead aggregator batch after batch.
+                return
             if len(batch) < self._batch:
                 return
 

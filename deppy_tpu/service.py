@@ -263,6 +263,8 @@ class Server:
         obs_stream: Optional[str] = None,
         obs_flush_ms: Optional[float] = None,
         obs_baseline: Optional[str] = None,
+        fleet_router: Optional[str] = None,
+        fleet_advertise: Optional[str] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -315,6 +317,22 @@ class Server:
                 if obs.start_watchdog(obs_baseline,
                                       replica=self.replica) is not None:
                     self._obs_armed = True
+        # Elastic fleet membership (ISSUE 17): --fleet-router names the
+        # affinity router this replica announces itself to (POST
+        # /fleet/join — the router streams it the warm state its arcs
+        # inherit, then flips the ring atomically) once its listeners
+        # are up, and leaves (the router's drain handoff) on graceful
+        # shutdown.  Unset keeps the standalone lifecycle byte for
+        # byte.  --fleet-advertise overrides the advertised host:port
+        # (default 127.0.0.1:<api-port> — single-host fleets only).
+        if fleet_router is None:
+            fleet_router = config.env_str("DEPPY_TPU_FLEET_ROUTER")
+        if fleet_advertise is None:
+            fleet_advertise = config.env_str("DEPPY_TPU_FLEET_ADVERTISE")
+        self.fleet_router = fleet_router
+        self.fleet_advertise = fleet_advertise
+        self._fleet_joined = False
+        self._fleet_advertised: Optional[str] = None
         self.ready = threading.Event()
         self._stop = threading.Event()
         # Cross-request continuous batching + result cache (ISSUE 3):
@@ -574,7 +592,11 @@ class Server:
         if self.elector is not None:
             self.elector.start()
         for srv in (self._api, self._probe):
-            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            # Tight poll so shutdown() returns promptly instead of
+            # waiting out BaseServer's default 0.5s select timeout.
+            t = threading.Thread(target=srv.serve_forever,
+                                 kwargs={"poll_interval": 0.05},
+                                 daemon=True)
             t.start()
             self._threads.append(t)
         if self.backend == "auto":
@@ -610,7 +632,78 @@ class Server:
                         continue  # transient; keep trying next tick
 
             threading.Thread(target=_prewarm, daemon=True).start()
+        if self.fleet_router:
+            threading.Thread(target=self._fleet_announce,
+                             name="deppy-fleet-join",
+                             daemon=True).start()
         self.ready.set()
+
+    # -------------------------------------------- fleet membership
+
+    def _fleet_post(self, path: str, doc: dict,
+                    timeout: float) -> Tuple[int, bytes]:
+        from http.client import HTTPConnection
+
+        host, _, port = str(self.fleet_router).rpartition(":")
+        if host.startswith("[") and host.endswith("]"):
+            host = host[1:-1]
+        conn = HTTPConnection(host or "127.0.0.1", int(port),
+                              timeout=timeout)
+        try:
+            conn.request("POST", path, body=json.dumps(doc).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def _fleet_announce(self, deadline_s: float = 15.0) -> None:
+        """Announce this replica to its fleet router (ISSUE 17): POST
+        /fleet/join until the router answers or the deadline passes.
+        Best-effort by design — a replica that cannot join still
+        serves standalone, and the join's warm-state stream + arc flip
+        happen entirely router-side."""
+        advertise = self.fleet_advertise \
+            or f"127.0.0.1:{self.api_port}"
+        self._fleet_advertised = advertise
+        deadline = time.monotonic() + deadline_s
+        while not self._stop.is_set():
+            try:
+                # Generous timeout: the router streams warm state to
+                # this replica before answering.
+                status, body = self._fleet_post(
+                    "/fleet/join", {"replica": advertise}, timeout=60.0)
+            except OSError:
+                if time.monotonic() >= deadline:
+                    print(f"[service] fleet join: router "
+                          f"{self.fleet_router} unreachable; serving "
+                          "standalone", file=sys.stderr, flush=True)
+                    return
+                self._stop.wait(0.5)
+                continue
+            if status == 200 or (status == 400
+                                 and b"already a fleet member" in body):
+                self._fleet_joined = True
+            else:
+                print(f"[service] fleet join rejected (HTTP {status}): "
+                      f"{body[:200]!r}; serving standalone",
+                      file=sys.stderr, flush=True)
+            return
+
+    def _fleet_leave(self) -> None:
+        """Leave = drain (ISSUE 17): ask the router to run the
+        warm-state drain handoff for this replica before the listeners
+        close — the router calls back ``GET /debug/warmstate``, so
+        this must run while the API listener still serves."""
+        try:
+            self._fleet_post("/fleet/drain",
+                             {"replica": self._fleet_advertised},
+                             timeout=60.0)
+        except OSError:
+            # Router gone (or never reachable): this death looks like
+            # a crash to the fleet and the probe loop cleans up.
+            pass
+        self._fleet_joined = False
 
     def shutdown(self, drain_s: Optional[float] = None) -> None:
         """Graceful stop: flip /readyz, wait (bounded by the drain
@@ -619,6 +712,12 @@ class Server:
         listeners.  A request slower than the drain budget is abandoned
         — by construction it has also blown its deadline."""
         self.ready.clear()
+        if self._fleet_joined:
+            # Leave the fleet FIRST (ISSUE 17): the router's drain
+            # handoff re-homes this replica's warm tier onto its arc
+            # inheritors, and needs our /debug/warmstate answered —
+            # so it must precede _stop and the listener close.
+            self._fleet_leave()
         self._stop.set()
         if drain_s is None:
             drain_s = self._drain_s
@@ -1122,6 +1221,8 @@ def serve(
     obs_stream: Optional[str] = None,
     obs_flush_ms: Optional[float] = None,
     obs_baseline: Optional[str] = None,
+    fleet_router: Optional[str] = None,
+    fleet_advertise: Optional[str] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -1143,7 +1244,8 @@ def serve(
                  replica=replica, fair=fair,
                  tenant_weights=tenant_weights,
                  obs_stream=obs_stream, obs_flush_ms=obs_flush_ms,
-                 obs_baseline=obs_baseline)
+                 obs_baseline=obs_baseline, fleet_router=fleet_router,
+                 fleet_advertise=fleet_advertise)
     srv.start()
     stop = threading.Event()
 

@@ -31,7 +31,8 @@ import pytest
 from deppy_tpu import faults, telemetry
 from deppy_tpu.fleet import (HashRing, Router, SnapshotFormatError,
                              affinity_key, doc_affinity_keys,
-                             export_warm_state, import_warm_state)
+                             export_warm_state, import_warm_state,
+                             membership_view, policy_decide, reconcile)
 from deppy_tpu.fleet.snapshot import split_snapshot, verify_snapshot
 from deppy_tpu.sched import Scheduler
 from deppy_tpu.sched.fair import TenantPolicy
@@ -653,3 +654,439 @@ class TestFleetEndToEnd:
             assert "integrity" in json.loads(body)["error"]
         finally:
             srv.shutdown()
+
+
+# --------------------------------------- elastic membership (ISSUE 17)
+
+
+def _poison(point, times=-1, kind="error"):
+    faults.configure_plan(faults.FaultPlan.from_doc(
+        [{"point": point, "kind": kind, "times": times}]))
+
+
+def _moving_family(router, joiner_addr, prefix="mv"):
+    """A family name whose affinity arc the joiner would STEAL: routed
+    to a current member now, to ``joiner_addr`` under the prospective
+    ring.  Deterministic — names are tried until one moves."""
+    prospective = HashRing(
+        list(router.ring.replicas) + [joiner_addr],
+        vnodes=router.ring.vnodes)
+    for i in range(4096):
+        name = f"{prefix}{i}"
+        key = doc_affinity_keys(_family_doc(name))[0]
+        if prospective.route(key) == joiner_addr:
+            return name, key
+    raise AssertionError("no family arc moves to the joiner")
+
+
+class TestElasticJoin:
+    def test_join_streams_warm_state_then_flips_arcs(self, fleet):
+        replicas, addrs, router, reference = fleet
+        joiner = _host_server(replica="joiner")
+        addr = f"127.0.0.1:{joiner.api_port}"
+        try:
+            name, key = _moving_family(router, addr)
+            # Warm the moving family (plus noise) on its CURRENT owner.
+            for other in ("stay0", "stay1"):
+                _request(router.api_port, "POST", "/v1/resolve",
+                         _family_doc(other))
+            s, _, _ = _request(router.api_port, "POST", "/v1/resolve",
+                               _family_doc(name))
+            assert s == 200
+            old_owner = router.target_for(key)
+            assert old_owner != addr
+            s, body, _ = _request(router.api_port, "POST",
+                                  "/fleet/join", {"replica": addr})
+            assert s == 200
+            out = json.loads(body)["join"]
+            assert out["epoch"] == 2
+            assert out["chunks"] >= 1 and out["warm_entries"] >= 1
+            # The arc flip committed: the family now routes to the
+            # joiner, and the membership surface says so.
+            assert router.target_for(key) == addr
+            s, body, _ = _request(router.api_port, "GET",
+                                  "/fleet/replicas")
+            doc = json.loads(body)
+            assert doc["membership"] == "elastic"
+            assert doc["epoch"] == 2 and addr in doc["members"]
+            # The streamed warm state is LIVE: the family's next delta
+            # warm-serves on the joiner instead of cold-solving.
+            nxt = _family_doc(name, state=1)
+            s, b1, _ = _request(router.api_port, "POST", "/v1/resolve",
+                                nxt)
+            assert s == 200
+            _, b2, _ = _request(reference.api_port, "POST",
+                                "/v1/resolve", nxt)
+            assert b1 == b2
+            _, m, _ = _request(joiner.api_port, "GET", "/metrics")
+            assert (_metric(m.decode(),
+                            "deppy_incremental_hits_total") or 0) >= 1
+        finally:
+            joiner.shutdown()
+
+    def test_join_under_churn_byte_identity(self, fleet):
+        """The pinned acceptance: a join landing mid-churn never
+        surfaces a non-200 or a response that differs from the
+        fault-free single-server oracle."""
+        replicas, addrs, router, reference = fleet
+        results = []
+        stop = False
+
+        def churn():
+            state = 0
+            while not stop or state < 6:
+                for fam in ("cfam0", "cfam1", "cfam2"):
+                    doc = _family_doc(fam, state)
+                    s, b, _ = _request(router.api_port, "POST",
+                                       "/v1/resolve", doc)
+                    results.append((doc, s, b))
+                state += 1
+                if state >= 40:
+                    break
+
+        import threading
+        t = threading.Thread(target=churn)
+        t.start()
+        joiner = _host_server(replica="churnjoiner")
+        addr = f"127.0.0.1:{joiner.api_port}"
+        try:
+            time.sleep(0.05)
+            s, body, _ = _request(router.api_port, "POST",
+                                  "/fleet/join", {"replica": addr})
+            assert s == 200
+            stop = True
+            t.join(timeout=60)
+            assert not t.is_alive()
+            assert len(results) >= 6
+            for doc, s, b in results:
+                assert s == 200
+                _, ref, _ = _request(reference.api_port, "POST",
+                                     "/v1/resolve", doc)
+                assert b == ref
+        finally:
+            stop = True
+            t.join(timeout=5)
+            joiner.shutdown()
+
+    def test_join_rejects_duplicate_and_malformed(self, fleet):
+        replicas, addrs, router, reference = fleet
+        s, body, _ = _request(router.api_port, "POST", "/fleet/join",
+                              {"replica": addrs[0]})
+        assert s == 400
+        assert "already a fleet member" in json.loads(body)["error"]
+        s, _, _ = _request(router.api_port, "POST", "/fleet/join",
+                           {"replica": 42})
+        assert s == 400
+        s, _, _ = _request(router.api_port, "POST", "/fleet/join",
+                           {"replica": "noport"})
+        assert s == 400
+        assert router.epoch == 1
+
+    def test_join_stream_fault_aborts_without_flip(self, fleet):
+        replicas, addrs, router, reference = fleet
+        joiner = _host_server(replica="badjoin")
+        addr = f"127.0.0.1:{joiner.api_port}"
+        try:
+            name, key = _moving_family(router, addr)
+            _request(router.api_port, "POST", "/v1/resolve",
+                     _family_doc(name))
+            owner = router.target_for(key)
+            _poison("fleet.join_stream", times=-1)
+            s, body, _ = _request(router.api_port, "POST",
+                                  "/fleet/join", {"replica": addr})
+            assert s == 502
+            assert "join failed" in json.loads(body)["error"]
+            # Membership is exactly as it was: no epoch bump, no
+            # member, the family still routes to its old owner.
+            faults.configure_plan(None)
+            assert router.epoch == 1
+            assert addr not in router.ring.replicas
+            assert router.target_for(key) == owner
+        finally:
+            joiner.shutdown()
+
+    def test_join_stream_resumes_after_one_fault(self, fleet):
+        """One failed chunk POST re-sends (import is idempotent); the
+        join still commits."""
+        replicas, addrs, router, reference = fleet
+        joiner = _host_server(replica="resumejoin")
+        addr = f"127.0.0.1:{joiner.api_port}"
+        try:
+            name, _ = _moving_family(router, addr)
+            _request(router.api_port, "POST", "/v1/resolve",
+                     _family_doc(name))
+            _poison("fleet.join_stream", times=1)
+            s, body, _ = _request(router.api_port, "POST",
+                                  "/fleet/join", {"replica": addr})
+            assert s == 200
+            assert json.loads(body)["join"]["epoch"] == 2
+        finally:
+            joiner.shutdown()
+
+    def test_arc_flip_fault_aborts_without_flip(self, fleet):
+        replicas, addrs, router, reference = fleet
+        joiner = _host_server(replica="flipfault")
+        addr = f"127.0.0.1:{joiner.api_port}"
+        try:
+            _poison("fleet.arc_flip")
+            s, _, _ = _request(router.api_port, "POST", "/fleet/join",
+                               {"replica": addr})
+            assert s == 502
+            assert router.epoch == 1
+            assert addr not in router.ring.replicas
+        finally:
+            joiner.shutdown()
+
+    def test_elastic_drain_leaves_ring_and_bumps_epoch(self, fleet):
+        replicas, addrs, router, reference = fleet
+        _request(router.api_port, "POST", "/v1/resolve",
+                 _family_doc("dfam"))
+        victim = addrs[1]
+        s, _, _ = _request(router.api_port, "POST", "/fleet/drain",
+                           {"replica": victim})
+        assert s == 200
+        assert router.epoch == 2
+        assert victim not in router.ring.replicas
+        view = membership_view(router)
+        assert victim in view["drained"]
+        assert victim not in view["members"]
+
+    def test_drain_chaos_replica_stays_routable(self, fleet):
+        """Satellite pin: a fault-plan-poisoned ``fleet.forward``
+        during the drain handoff answers 502 and leaves the victim
+        fully routable — a failed handoff must not half-remove a
+        member."""
+        replicas, addrs, router, reference = fleet
+        doc = _family_doc("drainchaos")
+        _request(router.api_port, "POST", "/v1/resolve", doc)
+        key = doc_affinity_keys(doc)[0]
+        victim = router.target_for(key)
+        _poison("fleet.forward", times=-1)
+        s, body, _ = _request(router.api_port, "POST", "/fleet/drain",
+                              {"replica": victim})
+        assert s == 502
+        assert "drain failed" in json.loads(body)["error"]
+        faults.configure_plan(None)
+        states = {st["replica"]: st for st in router.replica_states()}
+        assert states[victim]["drained"] is False
+        assert router.epoch == 1
+        assert router.target_for(key) == victim
+        s, b, _ = _request(router.api_port, "POST", "/v1/resolve", doc)
+        assert s == 200
+        _, ref, _ = _request(reference.api_port, "POST", "/v1/resolve",
+                             doc)
+        assert b == ref
+
+    def test_static_mode_restores_pr15_surface(self, fleet):
+        """The off-switch pin: DEPPY_TPU_FLEET=static 404s the
+        join/sync/policy endpoints, keeps /fleet/replicas byte-free of
+        membership keys, and renders no epoch gauge."""
+        replicas, addrs, router, reference = fleet
+        static = Router(bind_address="127.0.0.1:0", replicas=addrs,
+                        membership="static", probe_interval_s=60.0)
+        static.start()
+        try:
+            for path, method, body in (
+                    ("/fleet/join", "POST", {"replica": addrs[0]}),
+                    ("/fleet/sync", "POST",
+                     {"view": membership_view(router)}),
+                    ("/fleet/policy", "GET", None)):
+                s, _, _ = _request(static.api_port, method, path, body)
+                assert s == 404, path
+            s, body, _ = _request(static.api_port, "GET",
+                                  "/fleet/replicas")
+            assert sorted(json.loads(body)) == ["policy", "replicas",
+                                                "vnodes"]
+            _, m, _ = _request(static.api_port, "GET", "/metrics")
+            assert "deppy_fleet_epoch" not in m.decode()
+            assert "deppy_fleet_joins_total" not in m.decode()
+            # ...and it still serves byte-identically.
+            doc = _family_doc("staticfam")
+            s, b, _ = _request(static.api_port, "POST", "/v1/resolve",
+                               doc)
+            assert s == 200
+            _, ref, _ = _request(reference.api_port, "POST",
+                                 "/v1/resolve", doc)
+            assert b == ref
+        finally:
+            static.shutdown()
+
+    def test_elastic_metrics_render_epoch_gauge(self, fleet):
+        replicas, addrs, router, reference = fleet
+        _, m, _ = _request(router.api_port, "GET", "/metrics")
+        assert _metric(m.decode(), "deppy_fleet_epoch") == 1.0
+
+
+class TestPeerSync:
+    def _peer(self, addrs, router, **kw):
+        r = Router(bind_address="127.0.0.1:0", replicas=addrs,
+                   peers=[f"127.0.0.1:{router.api_port}"],
+                   probe_interval_s=60.0, sync_interval_s=0.0, **kw)
+        return r
+
+    def test_peer_adopts_join_and_drain_by_epoch(self, fleet):
+        replicas, addrs, router, reference = fleet
+        peer = self._peer(addrs, router)
+        joiner = _host_server(replica="syncjoiner")
+        addr = f"127.0.0.1:{joiner.api_port}"
+        try:
+            s, _, _ = _request(router.api_port, "POST", "/fleet/join",
+                               {"replica": addr})
+            assert s == 200 and router.epoch == 2
+            out = peer.sync_peers()
+            assert out == {"peers": 1, "ok": 1, "errors": 0}
+            assert peer.epoch == 2
+            assert addr in peer.ring.replicas
+            # Drain on the authoritative router; the peer learns the
+            # removal on the next round.
+            s, _, _ = _request(router.api_port, "POST", "/fleet/drain",
+                               {"replica": addr})
+            assert s == 200 and router.epoch == 3
+            peer.sync_peers()
+            assert peer.epoch == 3
+            assert addr not in peer.ring.replicas
+            assert membership_view(peer)["drained"] == [addr]
+        finally:
+            joiner.shutdown()
+
+    def test_sync_converges_the_authoritative_router_too(self, fleet):
+        """One exchange reconciles BOTH directions: a peer holding the
+        newer epoch pushes it onto the router it syncs with."""
+        replicas, addrs, router, reference = fleet
+        peer = self._peer(addrs, router)
+        peer.epoch = 7
+        peer.sync_peers()
+        assert router.epoch == 7
+
+    def test_peer_sync_fault_counted_not_raised(self, fleet):
+        replicas, addrs, router, reference = fleet
+        peer = self._peer(addrs, router)
+        _poison("router.peer_sync", times=-1)
+        out = peer.sync_peers()
+        assert out == {"peers": 1, "ok": 0, "errors": 1}
+        assert peer.epoch == 1
+
+    def test_dead_verdicts_merge_only_at_current_epoch(self, fleet):
+        replicas, addrs, router, reference = fleet
+        peer = self._peer(addrs, router)
+        stale = membership_view(router)
+        stale["dead"] = [addrs[2]]
+        stale["epoch"] = 0
+        reconcile(peer, stale)
+        states = {st["replica"]: st for st in peer.replica_states()}
+        assert states[addrs[2]]["dead"] is False
+        fresh = dict(stale, epoch=peer.epoch)
+        reconcile(peer, fresh)
+        states = {st["replica"]: st for st in peer.replica_states()}
+        assert states[addrs[2]]["dead"] is True
+
+    def test_same_epoch_tiebreak_converges_without_flapping(self):
+        a = Router(bind_address="127.0.0.1:0",
+                   replicas=["127.0.0.1:11", "127.0.0.1:12"],
+                   probe_interval_s=60.0)
+        b = Router(bind_address="127.0.0.1:0",
+                   replicas=["127.0.0.1:11", "127.0.0.1:13"],
+                   probe_interval_s=60.0)
+        va, vb = membership_view(a), membership_view(b)
+        reconcile(a, vb)
+        reconcile(b, va)
+        assert list(a.ring.replicas) == list(b.ring.replicas)
+        # Idempotent at the fixed point: replaying either original
+        # view changes nothing (no flapping).
+        winner = list(a.ring.replicas)
+        reconcile(a, vb)
+        reconcile(a, va)
+        assert list(a.ring.replicas) == winner
+
+    def test_malformed_sync_view_answers_400(self, fleet):
+        replicas, addrs, router, reference = fleet
+        for view in (None, {}, {"epoch": "x", "members": ["a:1"]},
+                     {"epoch": 2, "members": []}):
+            s, _, _ = _request(router.api_port, "POST", "/fleet/sync",
+                               {"view": view})
+            assert s == 400, view
+
+    def test_probe_jitter_bounds(self):
+        r = Router(bind_address="127.0.0.1:0",
+                   replicas=["127.0.0.1:11"], probe_jitter=0.5,
+                   probe_interval_s=60.0)
+        assert r._jittered(2.0, rng=lambda: 0.0) == 2.0
+        assert r._jittered(2.0, rng=lambda: 1.0) == 3.0
+        clamped = Router(bind_address="127.0.0.1:0",
+                         replicas=["127.0.0.1:11"], probe_jitter=7.0,
+                         probe_interval_s=60.0)
+        assert clamped.probe_jitter == 1.0
+        off = Router(bind_address="127.0.0.1:0",
+                     replicas=["127.0.0.1:11"], probe_jitter=-1.0,
+                     probe_interval_s=60.0)
+        assert off._jittered(2.0, rng=lambda: 1.0) == 2.0
+
+
+class TestFleetAnnounce:
+    def test_server_announces_on_start_and_leaves_on_shutdown(
+            self, fleet):
+        replicas, addrs, router, reference = fleet
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host",
+                     replica="announcer",
+                     fleet_router=f"127.0.0.1:{router.api_port}")
+        srv.start()
+        addr = f"127.0.0.1:{srv.api_port}"
+        try:
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                if addr in router.ring.replicas:
+                    break
+                time.sleep(0.05)
+            assert addr in router.ring.replicas
+            assert router.epoch == 2
+        finally:
+            srv.shutdown()
+        # Graceful shutdown drained it back out (leave = drain).
+        assert addr not in router.ring.replicas
+        assert addr in membership_view(router)["drained"]
+        assert router.epoch == 3
+
+
+class TestScalePolicy:
+    def test_decide_hold_without_samples(self):
+        out = policy_decide({}, 0.0, 1.0, 0.25)
+        assert out["decision"] == "hold" and out["target"] is None
+
+    def test_decide_scale_up_when_no_cold_capacity(self):
+        out = policy_decide({"a:1": {"gold": 2.0}, "b:1": {"bulk": 1.5}},
+                            0.0, 1.0, 0.25)
+        assert out["decision"] == "scale_up" and out["target"] is None
+
+    def test_decide_rebalance_onto_cold_capacity(self):
+        out = policy_decide({"a:1": {"gold": 2.0}, "b:1": {"bulk": 0.1}},
+                            0.0, 1.0, 0.25)
+        assert out["decision"] == "rebalance"
+        assert out["target"] == "a:1"
+
+    def test_decide_scale_down_cold_idle_fleet(self):
+        out = policy_decide({"a:1": {"gold": 0.2}, "b:1": {"bulk": 0.1}},
+                            0.0, 1.0, 0.25)
+        assert out["decision"] == "scale_down"
+        assert out["target"] == "b:1"
+        # A non-idle queue vetoes the shrink.
+        out = policy_decide({"a:1": {"gold": 0.2}, "b:1": {"bulk": 0.1}},
+                            3.0, 1.0, 0.25)
+        assert out["decision"] == "hold"
+
+    def test_decide_tiebreak_is_deterministic(self):
+        burns = {"b:1": {"t": 2.0}, "a:1": {"t": 2.0}, "c:1": {"t": 0.1}}
+        out = policy_decide(burns, 0.0, 1.0, 0.25)
+        assert out["target"] == "b:1"  # (burn, address) max
+
+    def test_policy_endpoint_reports_live_fleet(self, fleet):
+        replicas, addrs, router, reference = fleet
+        _request(router.api_port, "POST", "/v1/resolve",
+                 _family_doc("polfam"))
+        s, body, _ = _request(router.api_port, "GET", "/fleet/policy")
+        assert s == 200
+        out = json.loads(body)["policy"]
+        assert out["decision"] in ("hold", "scale_up", "scale_down",
+                                   "rebalance")
+        assert out["epoch"] == 1 and out["replicas"] == 3
+        assert set(out["per_replica_burn"]) <= set(addrs)

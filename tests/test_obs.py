@@ -144,6 +144,73 @@ class TestStreamer:
         assert st.queue_depth() == 0
         assert reg.counter("deppy_obs_stream_errors_total").value == 1
 
+    def test_failed_post_arms_bounded_exponential_holdoff(
+            self, monkeypatch):
+        """ISSUE 17 satellite: after a failed POST the streamer holds
+        off (doubling from the flush period, capped) instead of
+        re-hammering a restarting aggregator every flush period."""
+        monkeypatch.setenv("DEPPY_TPU_OBS_BACKOFF_MAX_S", "0.5")
+        st = TelemetryStreamer("127.0.0.1:9", replica="r1", batch=2,
+                               flush_ms=100)
+        st._post = lambda batch: False
+        st.enqueue({"i": 0})
+        st.flush()
+        assert st._down and st._backoff_s == pytest.approx(0.1)
+        # While the hold-off is pending, flush is a no-op: events keep
+        # queueing (bounded as ever), no further batch is burned.
+        st.enqueue({"i": 1})
+        st.flush()
+        assert st.queue_depth() == 1
+        reg = telemetry.default_registry()
+        assert reg.counter("deppy_obs_stream_errors_total").value == 1
+        # Each expired hold-off that fails again doubles, up to the cap.
+        for expect in (0.2, 0.4, 0.5, 0.5):
+            st._retry_at = 0.0
+            st.enqueue({"i": 2})
+            st.flush()
+            assert st._backoff_s == pytest.approx(expect)
+
+    def test_first_success_after_down_streak_counts_reconnect(self):
+        reg = telemetry.default_registry()
+        st = TelemetryStreamer("127.0.0.1:9", replica="r1", batch=2,
+                               flush_ms=100)
+        st._post = lambda batch: False
+        st.enqueue({"i": 0})
+        st.flush()
+        assert st._down
+        st._post = lambda batch: True
+        st._retry_at = 0.0
+        st.enqueue({"i": 1})
+        st.flush()
+        assert not st._down and st._backoff_s == 0.0
+        assert st.queue_depth() == 0
+        assert reg.counter(
+            "deppy_obs_stream_reconnects_total").value == 1
+        # A healthy streamer's successes are deliveries, not
+        # reconnects.
+        st.enqueue({"i": 2})
+        st.flush()
+        assert reg.counter(
+            "deppy_obs_stream_reconnects_total").value == 1
+
+    def test_close_flush_bypasses_the_holdoff(self):
+        reg = telemetry.default_registry()
+        st = TelemetryStreamer("127.0.0.1:9", replica="r1", batch=2,
+                               flush_ms=100)
+        st._post = lambda batch: False
+        st.enqueue({"i": 0})
+        st.flush()
+        st._post = lambda batch: True
+        st.enqueue({"i": 1})
+        st.flush()
+        assert st.queue_depth() == 1  # hold-off pending
+        # The final close() flush gets one last delivery attempt even
+        # inside the hold-off window.
+        st._stop.set()
+        st.flush()
+        assert st.queue_depth() == 0
+        assert reg.counter("deppy_obs_stream_batches_total").value == 1
+
     def test_forwarder_captures_sink_events(self):
         reg = telemetry.default_registry()
         st = TelemetryStreamer("127.0.0.1:9", replica="r1",

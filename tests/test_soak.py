@@ -1,0 +1,65 @@
+"""Soak/chaos survival gate (ISSUE 17).
+
+The elastic-membership acceptance is behavioral, not structural: the
+fleet must keep serving — byte-identically to a fault-free oracle —
+while the chaos script hard-kills a replica, runtime-joins a new one
+(announce -> chunked warm-state stream -> atomic arc flip), drains a
+member, and kills the primary router with clients failing over to its
+peer.  :mod:`deppy_tpu.benchmarks.soak` is the harness; these tests run
+it at two depths:
+
+  * a short tier-1 shape (~12s of open-loop load) that still exercises
+    EVERY chaos step and every gate except the full-length warm-hit
+    floor (relaxed — a dozen post-join seconds is a few hundred
+    requests, where one unlucky cold solve moves the ratio);
+  * the full acceptance shape (>= 60s, the 0.8 warm-hit floor) behind
+    the ``slow`` marker — ``make soak-gate`` is the scripted sibling.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from deppy_tpu import faults, telemetry
+from deppy_tpu.benchmarks.soak import run_soak
+
+pytestmark = pytest.mark.soak
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    prev_breaker = faults.set_default_breaker(faults.CircuitBreaker())
+    prev_plan = faults.configure_plan(None)
+    prev_reg = telemetry.set_default_registry(telemetry.Registry())
+    yield
+    telemetry.set_default_registry(prev_reg)
+    faults.configure_plan(prev_plan)
+    faults.set_default_breaker(prev_breaker)
+
+
+def _assert_survived(record: dict) -> None:
+    assert record["errors"] == [], record["errors"]
+    assert record["oracle_mismatches"] == 0
+    assert record["sheds"].get("gold", 0) == 0
+    assert record["chaos_log"], record
+    assert len(record["chaos_log"]) == 4, record["chaos_log"]
+    view = record["peer_view_at_router_kill"]
+    assert view is not None and view["epoch"] >= 3
+    assert record["gates"]["warm_hit_post_join"], record
+    assert record["passed"], record["gates"]
+
+
+def test_short_soak_survives_the_full_chaos_script():
+    record = run_soak(seconds=12.0, rate=20.0, seed=20170806,
+                      warm_hit_floor=0.7, p99_budget_ms=10_000.0)
+    _assert_survived(record)
+    assert record["requests_ok"] >= 150
+
+
+@pytest.mark.slow
+def test_full_length_soak_gate():
+    record = run_soak(seconds=70.0, rate=25.0, seed=20170807,
+                      warm_hit_floor=0.8)
+    _assert_survived(record)
+    assert record["seconds"] >= 60.0
+    assert record["p99_ms"] <= 2000.0
