@@ -152,6 +152,14 @@ soak-gate: ## The full ISSUE 17 acceptance run (>= 60s sustained load; writes be
 test-soak: ## Soak/chaos survival tests only (the `soak` pytest marker; the full-length run needs -m "soak" without the slow deselect).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m soak
 
+.PHONY: optimize-smoke
+optimize-smoke: ## Optimization tier end to end: upgrade plan oracle-checked minimal-change against a live service, soft-constraint optimum with loop counters on /metrics, explain-why-not blocking set, opt-off 404 + resolve byte-identity (ISSUE 18 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/optimize_smoke.py
+
+.PHONY: test-optimize
+test-optimize: ## Optimization-tier subsystem tests only (the `optimize` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m optimize
+
 .PHONY: lint
 lint: ## Static analysis: the six deppy-lint checkers vs analysis/baseline.json (ISSUE 7/8 acceptance; docs/analysis.md).
 	$(PYTHON) -m deppy_tpu lint

@@ -527,6 +527,40 @@ def _run_fleet(timeout_s: int) -> dict | None:
     return None
 
 
+def _run_upgrade(timeout_s: int) -> dict | None:
+    """Run the upgrade-planning workload (ISSUE 18) on the forced-CPU
+    platform: churned-catalog upgrade rounds through the scheduler
+    serving path, warm cone probes vs cold full-catalog tightening —
+    the host objective engine is what both passes measure, so the
+    accelerator probe/retry machinery has nothing to add."""
+    from deppy_tpu.utils.platform_env import run_captured
+
+    cmd = [sys.executable, "-m", "deppy_tpu.benchmarks.upgrade",
+           "--out", os.path.join(REPO, "benchmarks", "results",
+                                 "upgrade_r18.json")]
+    if "DEPPY_BENCH_N" in os.environ:
+        cmd += ["--n-packages", os.environ["DEPPY_BENCH_N"]]
+    try:
+        rc, stdout, stderr = run_captured(
+            cmd, timeout_s=timeout_s, cwd=REPO, env=_cpu_env())
+    except subprocess.TimeoutExpired:
+        _log(f"upgrade workload timed out after {timeout_s}s")
+        return None
+    if stderr:
+        print(stderr, file=sys.stderr, end="", flush=True)
+    if rc != 0:
+        _log(f"upgrade workload failed rc={rc}")
+        return None
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            return rec
+    return None
+
+
 def _run_soak(timeout_s: int) -> dict | None:
     """Run the soak/chaos survival gate (ISSUE 17) on the forced-CPU
     platform: open-loop mixed-tenant churn over an elastic fleet while
@@ -565,6 +599,21 @@ def _run_soak(timeout_s: int) -> dict | None:
 
 
 def main(workload: str = "headline") -> int:
+    if workload == "upgrade":
+        rec = _run_upgrade(RUN_TIMEOUT_S)
+        if rec is None:
+            rec = {
+                "metric": ("upgrade-plan tightening us/probe "
+                           "(warm cone probes vs cold full-catalog)"),
+                "value": 0.0,
+                "unit": "us",
+                "vs_baseline": 0.0,
+                "workload": "upgrade",
+                "backend": "none",
+                "error": "upgrade workload produced no record",
+            }
+        print(json.dumps(rec), flush=True)
+        return 0
     if workload == "soak":
         rec = _run_soak(RUN_TIMEOUT_S)
         if rec is None:
@@ -727,7 +776,7 @@ if __name__ == "__main__":
     _ap = argparse.ArgumentParser()
     _ap.add_argument("--workload",
                      choices=["headline", "churn", "hard", "publish",
-                              "fleet", "soak"],
+                              "fleet", "soak", "upgrade"],
                      default="headline",
                      help="headline = batched device vs serial host; "
                      "churn = warm-start vs cold re-resolution replay "
@@ -739,7 +788,9 @@ if __name__ == "__main__":
                      "round-robin, warm-hit + p99 (ISSUE 15); "
                      "soak = elastic-fleet chaos survival gate — "
                      "kill/join/drain/router-failover under open-loop "
-                     "load (ISSUE 17)")
+                     "load (ISSUE 17); upgrade = churned-catalog "
+                     "minimal-change upgrade planning, warm cone "
+                     "probes vs cold tightening (ISSUE 18)")
     _args = _ap.parse_args()
     try:
         rc = main(workload=_args.workload)

@@ -1,0 +1,271 @@
+"""Upgrade-planning benchmark (ISSUE 18): warm vs cold bound-tightening.
+
+Production upgrade traffic is churn-shaped: a catalog publish makes a
+few packages prefer newer bundles, and the operator asks for the
+minimal-change plan — newest acceptable bundles, fewest installed
+entities touched.  This workload replays that shape through the
+serving path (``Planner`` riding ``Scheduler.submit_optimize``) as
+rounds of upgrade queries over a churned bundle catalog: each round
+rotates which packages drift toward newer versions, so the
+preference-ordered feasibility solve over-upgrades and the tightening
+loop must walk the touch count back down to the minimum.
+
+Two passes answer the same rounds: one with warm cone probes
+(``warm: true`` — off-cone variables pinned to the previous model's
+phases, so a probe only re-searches where an improvement can come
+from) and one forced cold (every probe searches the full catalog).
+Per-probe durations come from the telemetry sink's ``optimize``
+events alone — the same stream ``deppy profile`` renders — keyed by
+the per-pass tenant label, so the two passes cannot contaminate each
+other's numbers.
+
+Emits one JSON record in the bench.py contract: ``value`` the warm
+pass's mean microseconds per tightening probe, ``vs_baseline`` the
+cold-to-warm per-probe ratio (the >= 3x acceptance), plus
+iterations-to-optimum and the objective-identity verdict (both passes
+must prove the same optimum).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .harness import log
+
+
+def upgrade_catalog(n_packages: int,
+                    catalog_versions: Dict[int, List[str]]) -> list:
+    """One round's bundle catalog.  Package ``p`` is a version group
+    (AtMost-1 pin) whose versions each depend on the next package,
+    chaining the whole catalog under one mandatory root.  Each
+    package's dependency row lists its versions NEWEST FIRST — the real
+    catalog's preference order — so the preference-ordered feasibility
+    solve upgrades EVERY package, and the tightening loop earns its
+    keep walking the touch count back to the minimal-change plan."""
+    from .. import sat
+
+    variables = []
+    for p in range(n_packages):
+        vids = catalog_versions[p]
+        cons = [sat.dependency(*vids), sat.at_most(1, *vids)]
+        if p == 0:
+            cons.insert(0, sat.mandatory())
+        variables.append(sat.variable(f"p{p}", *cons))
+        for vid in vids:
+            vcons = []
+            if p + 1 < n_packages:
+                vcons.append(sat.dependency(f"p{p + 1}"))
+            variables.append(sat.variable(vid, *vcons))
+    return variables
+
+
+def round_docs(n_packages: int, versions: int, rounds: int,
+               n_drift: int) -> List[dict]:
+    """The benchmark's request stream: one upgrade document per round
+    over a CHURNED catalog.  Each round, a rotating window of
+    ``n_drift`` packages ships a new release (a new bundle id,
+    inserted at the head of its package's preference row) that the
+    round's plan must adopt (``prefer``); the installed state carries
+    the minimal-change plan forward round to round, exactly as a
+    cluster tracks its own upgrade history."""
+    from .. import io as problem_io
+
+    # catalog_versions[p] is newest-first; installed[p] the running
+    # cluster state (initially the OLDEST bundle of every package).
+    catalog_versions = {
+        p: [f"p{p}.v{v}" for v in range(versions)]
+        for p in range(n_packages)}
+    installed = {p: f"p{p}.v{versions - 1}" for p in range(n_packages)}
+    docs = []
+    for rnd in range(rounds):
+        drift = sorted((rnd * n_drift + i) % n_packages
+                       for i in range(n_drift))
+        prefer = []
+        for p in drift:
+            release = f"p{p}.r{rnd}"
+            catalog_versions[p] = [release] + catalog_versions[p]
+            prefer.append(release)
+        variables = upgrade_catalog(n_packages, catalog_versions)
+        docs.append({
+            "query": "upgrade",
+            "variables": [problem_io.variable_to_dict(v)
+                          for v in variables],
+            "installed": ([f"p{p}" for p in range(n_packages)]
+                          + sorted(installed.values())),
+            "prefer": prefer,
+        })
+        for p in drift:  # the optimal plan: adopt the release, touch
+            installed[p] = f"p{p}.r{rnd}"  # nothing else
+    return docs
+
+
+def replay(docs: List[dict], warm: bool, tenant: str) -> dict:
+    """One full pass through the serving path: every round's document
+    answered by a fresh Planner probe loop on a shared scheduler."""
+    from ..optimize import Planner
+    from ..sched.scheduler import Scheduler
+
+    sched = Scheduler(backend="host")
+    sched.start()
+    try:
+        planner = Planner(sched)
+        iterations = 0
+        improvements = 0
+        objectives: List[int] = []
+        wall = 0.0
+        for doc in docs:
+            doc = dict(doc)
+            doc["warm"] = warm
+            t0 = time.perf_counter()
+            out = planner.handle(doc, tenant=tenant)
+            wall += time.perf_counter() - t0
+            if out.get("status") != "optimal":
+                raise RuntimeError(
+                    f"pass {tenant}: round degraded: {out}")
+            iterations += out["iterations"]
+            improvements += out["improvements"]
+            objectives.append(out["objective"])
+        return {
+            "rounds": len(docs),
+            "iterations": iterations,
+            "improvements": improvements,
+            "iterations_per_round": round(iterations / len(docs), 2),
+            "wall_s": round(wall, 3),
+            "objectives": objectives,
+        }
+    finally:
+        sched.stop()
+
+
+def probe_stats(sink_path: str) -> Dict[str, dict]:
+    """Per-(tenant, mode) probe counts and mean duration from the
+    sink's ``optimize`` events alone — the measurement is the same
+    stream ``deppy profile`` renders, not bench-side stopwatches."""
+    from ..telemetry import iter_sink_events
+
+    out: Dict[str, dict] = {}
+    for ev in iter_sink_events(sink_path):
+        if not isinstance(ev, dict) or ev.get("kind") != "optimize":
+            continue
+        key = f"{ev.get('tenant')}:{ev.get('mode')}"
+        agg = out.setdefault(key, {"probes": 0, "improved": 0,
+                                   "dur_s": 0.0})
+        agg["probes"] += 1
+        agg["dur_s"] += float(ev.get("dur_s", 0.0) or 0.0)
+        if ev.get("outcome") == "improved":
+            agg["improved"] += 1
+    for agg in out.values():
+        agg["dur_s"] = round(agg["dur_s"], 6)
+        agg["us_per_probe"] = (
+            round(agg["dur_s"] * 1e6 / agg["probes"], 1)
+            if agg["probes"] else 0.0)
+    return out
+
+
+def run(n_packages: int = 96, versions: int = 4, rounds: int = 6,
+        n_drift: int = 4, out_path: Optional[str] = None) -> dict:
+    from .. import telemetry
+
+    log(f"upgrade workload: {n_packages} packages x {versions} "
+        f"versions ({n_packages * (versions + 1)} bundles), {rounds} "
+        f"churn rounds, {n_drift} new releases/round")
+    docs = round_docs(n_packages, versions, rounds, n_drift)
+    sink = tempfile.mktemp(prefix="deppy_upgrade_", suffix=".jsonl")
+    telemetry.configure_sink(sink)
+    try:
+        cold = replay(docs, warm=False, tenant="cold")
+        warm = replay(docs, warm=True, tenant="warm")
+    finally:
+        telemetry.configure_sink(None)
+    try:
+        probes = probe_stats(sink)
+    finally:
+        try:
+            os.unlink(sink)
+        except OSError:
+            pass
+    warm_p = probes.get("warm:warm", {"probes": 0, "dur_s": 0.0})
+    cold_p = probes.get("cold:cold", {"probes": 0, "dur_s": 0.0})
+    # A zero-probe pass is an honest failure (value 0), not a divide.
+    warm_us = (warm_p["dur_s"] / warm_p["probes"] * 1e6
+               if warm_p["probes"] else 0.0)
+    cold_us = (cold_p["dur_s"] / cold_p["probes"] * 1e6
+               if cold_p["probes"] else 0.0)
+    record = {
+        "metric": ("upgrade-plan tightening us/probe "
+                   "(warm cone probes vs cold full-catalog)"),
+        "value": round(warm_us, 1),
+        "unit": "us",
+        "vs_baseline": (round(cold_us / warm_us, 2) if warm_us
+                        else 0.0),
+        "workload": "upgrade",
+        "n_packages": n_packages,
+        "versions": versions,
+        "rounds": rounds,
+        "n_drift": n_drift,
+        "iterations_per_round": warm["iterations_per_round"],
+        "warm_probe_us": round(warm_us, 1),
+        "cold_probe_us": round(cold_us, 1),
+        "warm_hit_ratio": round(
+            warm_p.get("improved", 0) / max(warm_p["probes"], 1), 4),
+        "objectives_identical": warm["objectives"] == cold["objectives"],
+        "cold": cold,
+        "warm": warm,
+        "probes": probes,
+        "backend": "host",
+    }
+    if out_path:
+        import platform
+
+        full = {
+            "issue": 18,
+            "record": "upgrade_r18",
+            "platform": {
+                "python": platform.python_version(),
+                "machine": platform.machine(),
+                "cpus": os.cpu_count(),
+                "jax_platforms": (os.environ.get("JAX_PLATFORMS")
+                                  or "(default)"),
+            },
+            "note": ("churned-catalog upgrade rounds through the "
+                     "scheduler serving path, host backend; per-probe "
+                     "durations from the telemetry sink's `optimize` "
+                     "events keyed by per-pass tenant (the stream "
+                     "`deppy profile` renders); both passes must prove "
+                     "the same optimum per round"),
+            **record,
+        }
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(full, fh, indent=1)
+            fh.write("\n")
+        log(f"wrote {out_path}")
+    return record
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n-packages", type=int, default=96)
+    ap.add_argument("--versions", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--drift", type=int, default=4)
+    ap.add_argument("--out", default=None,
+                    help="also write the full record (the benchmarks/"
+                    "results/upgrade_r18.json artifact)")
+    args = ap.parse_args()
+    record = run(n_packages=args.n_packages, versions=args.versions,
+                 rounds=args.rounds, n_drift=args.drift,
+                 out_path=args.out)
+    print(json.dumps(record), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

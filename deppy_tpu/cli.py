@@ -341,6 +341,33 @@ def _build_parser() -> argparse.ArgumentParser:
         "Each device gets its own fault domain and "
         "deppy_breaker_state{device=...} breaker",
     )
+    p_serve.add_argument(
+        "--opt", choices=["on", "off"], default=None,
+        help="optimization tier (ISSUE 18): best-solution queries — "
+        "minimal-change upgrade planning, weighted soft constraints, "
+        "and explain-why-not — behind POST /v1/optimize, served by a "
+        "bound-tightening loop riding the scheduler's idle-priority "
+        "queue (default on; 'off' 404s the endpoint and leaves "
+        "/v1/resolve byte-identical; also via DEPPY_TPU_OPT)",
+    )
+    p_serve.add_argument(
+        "--opt-max-iterations", type=int, default=None, metavar="N",
+        help="optimization tier: cap on bound-tightening probes per "
+        "request — past it the best model so far returns flagged "
+        "non-optimal (default 64; also via "
+        "DEPPY_TPU_OPT_MAX_ITERATIONS)",
+    )
+    p_serve.add_argument(
+        "--opt-iter-budget", type=int, default=None, metavar="STEPS",
+        help="optimization tier: engine step budget per tightening "
+        "probe (default 1048576; also via DEPPY_TPU_OPT_ITER_BUDGET)",
+    )
+    p_serve.add_argument(
+        "--opt-max-weight", type=int, default=None, metavar="W",
+        help="optimization tier: largest accepted soft-constraint "
+        "weight — bigger weights are a 400, bounding probe work "
+        "(default 64; also via DEPPY_TPU_OPT_MAX_WEIGHT)",
+    )
 
     p_route = sub.add_parser(
         "route",
@@ -457,6 +484,54 @@ def _build_parser() -> argparse.ArgumentParser:
         help="output format (default: text)",
     )
 
+    p_optimize = sub.add_parser(
+        "optimize",
+        help="POST an optimize request to a running service "
+        "(POST /v1/optimize, ISSUE 18): minimal-change upgrade "
+        "planning (query 'upgrade') or weighted soft constraints "
+        "(query 'soft'), answered optimal/degraded/unsat by the "
+        "bound-tightening loop",
+    )
+    p_optimize.add_argument(
+        "file",
+        help="JSON optimize document: {\"query\": \"upgrade\"|\"soft\", "
+        "\"variables\": [...], \"installed\": [...], \"prefer\": [...], "
+        "\"soft\": [{\"id\", \"installed\", \"weight\"}]} — variables "
+        "use the deppy_tpu.io problem-file format",
+    )
+    p_optimize.add_argument(
+        "--server", default="http://127.0.0.1:8080", metavar="URL",
+        help="base URL of the running service (default "
+        "http://127.0.0.1:8080)",
+    )
+    p_optimize.add_argument(
+        "--output", choices=["text", "json"], default="text",
+        help="output format (default: text)",
+    )
+
+    p_explain = sub.add_parser(
+        "explain",
+        help="explain-why-not against a running service (ISSUE 18): "
+        "POST /v1/optimize with query 'explain' — the named goals "
+        "become mandatory and the answer is either a plan or the "
+        "unsat core as a human-readable blocking set",
+    )
+    p_explain.add_argument(
+        "file",
+        help="JSON explain document: {\"variables\": [...], "
+        "\"goal\": [ids...]} (a \"query\" field, if present, must be "
+        "\"explain\")",
+    )
+    p_explain.add_argument(
+        "--server", default="http://127.0.0.1:8080", metavar="URL",
+        help="base URL of the running service (default "
+        "http://127.0.0.1:8080)",
+    )
+    p_explain.add_argument(
+        "--output", choices=["text", "json"], default="text",
+        help="output format (default: text)",
+    )
+
     p_stats = sub.add_parser(
         "stats",
         help="summarize a telemetry JSONL file: per-span counts/timings "
@@ -500,7 +575,9 @@ def _build_parser() -> argparse.ArgumentParser:
         "straggler/pad waste, per-backend us/solve — plus the "
         "portfolio race table (wins/cancels/win-margin per backend "
         "per size class, straggler resubmissions) from `race` events "
-        "(see docs/observability.md, Profiling)",
+        "and the optimization-probe table (warm-vs-cold iterations, "
+        "improvement deltas, per-probe backend wins; ISSUE 18) from "
+        "`optimize` events (see docs/observability.md, Profiling)",
     )
     p_profile.add_argument(
         "file", nargs="?", default=None,
@@ -719,6 +796,10 @@ _CONFIG_KEYS = {
     "obsBaseline": ("obs_baseline", str),
     "fleetRouter": ("fleet_router", str),
     "fleetAdvertise": ("fleet_advertise", str),
+    "opt": ("opt", str),
+    "optMaxIterations": ("opt_max_iterations", int),
+    "optIterBudget": ("opt_iter_budget", int),
+    "optMaxWeight": ("opt_max_weight", int),
 }
 
 
@@ -1043,6 +1124,103 @@ def _cmd_publish(args) -> int:
               + "  ".join(f"{k}={p.get(k)}"
                           for k in ("changed", "affected", "invalidated",
                                     "queued", "dropped", "unchanged")))
+    return 0
+
+
+def _cmd_optimize(args, explain: bool = False) -> int:
+    """POST an optimize document to a running service (POST
+    /v1/optimize, ISSUE 18).  ``explain=True`` is the `deppy explain`
+    spelling: the query field is forced to "explain" (a document that
+    names a DIFFERENT query is a usage error, not silently rewritten).
+    Exit 0 on a 2xx response, 2 on usage/transport errors (a 404 means
+    the tier is off — DEPPY_TPU_OPT=off — or the server predates it),
+    1 on any other HTTP status."""
+    from http.client import HTTPConnection, HTTPSConnection
+    from urllib.parse import urlsplit
+
+    try:
+        with open(args.file, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        print(f"error: no such file: {args.file}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: cannot read {args.file}: {e}", file=sys.stderr)
+        return 2
+    except json.JSONDecodeError as e:
+        print(f"error: invalid JSON in {args.file}: {e}", file=sys.stderr)
+        return 2
+    if explain:
+        if not isinstance(doc, dict):
+            print("error: explain document must be a JSON object",
+                  file=sys.stderr)
+            return 2
+        if doc.get("query", "explain") != "explain":
+            print(f"error: `deppy explain` requires query \"explain\", "
+                  f"the document says {doc['query']!r} — use "
+                  "`deppy optimize`", file=sys.stderr)
+            return 2
+        doc = dict(doc)
+        doc["query"] = "explain"
+    parts = urlsplit(args.server if "://" in args.server
+                     else f"http://{args.server}")
+    if parts.scheme not in ("http", "https"):
+        print(f"error: unsupported --server scheme {parts.scheme!r} "
+              "(use http:// or https://)", file=sys.stderr)
+        return 2
+    conn_cls = HTTPSConnection if parts.scheme == "https" \
+        else HTTPConnection
+    default_port = 443 if parts.scheme == "https" else 8080
+    try:
+        conn = conn_cls(parts.hostname or "127.0.0.1",
+                        parts.port or default_port, timeout=60)
+        conn.request("POST", "/v1/optimize", body=json.dumps(doc),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = resp.read()
+        status = resp.status
+        conn.close()
+    except OSError as e:
+        print(f"error: cannot reach {args.server}: {e}", file=sys.stderr)
+        return 2
+    try:
+        payload = json.loads(body)
+    except (ValueError, json.JSONDecodeError):
+        payload = {"raw": body.decode(errors="replace")}
+    if args.output == "json" or status >= 400:
+        json.dump(payload, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0 if status < 300 else (2 if status == 404 else 1)
+    out = payload.get("optimize", {})
+    if out.get("query") == "explain":
+        if out.get("status") == "feasible":
+            plan = out.get("plan") or []
+            print(f"feasible: {', '.join(plan) or '(nothing)'}")
+        elif out.get("status") == "blocked":
+            print("blocked:")
+            for line in out.get("blocking", []):
+                print(f"  {line}")
+        else:
+            print(f"degraded: {out.get('reason')}")
+    else:
+        head = out.get("status", "?")
+        if head == "degraded":
+            head += f" ({out.get('reason')})"
+        elif out.get("proof"):
+            head += f" (proof: {out['proof']})"
+        print(f"{head}: objective={out.get('objective')} "
+              f"iterations={out.get('iterations')} "
+              f"improvements={out.get('improvements')}")
+        if out.get("status") == "unsat":
+            for line in out.get("blocking", []):
+                print(f"  {line}")
+        else:
+            sel = out.get("selected") or []
+            print(f"  selected: {', '.join(sel) or '(nothing)'}")
+            if out.get("query") == "upgrade":
+                print(f"  touched={out.get('touched')} "
+                      f"missing_prefer="
+                      f"{', '.join(out.get('missing_prefer') or []) or '-'}")
     return 0
 
 
@@ -1610,6 +1788,10 @@ def _cmd_serve(args) -> int:
         "obs_baseline": None,
         "fleet_router": None,
         "fleet_advertise": None,
+        "opt": None,
+        "opt_max_iterations": None,
+        "opt_iter_budget": None,
+        "opt_max_weight": None,
     }
     try:
         if args.config:
@@ -1644,6 +1826,10 @@ def _cmd_serve(args) -> int:
             ("obs_baseline", args.obs_baseline),
             ("fleet_router", args.fleet_router),
             ("fleet_advertise", args.fleet_advertise),
+            ("opt", args.opt),
+            ("opt_max_iterations", args.opt_max_iterations),
+            ("opt_iter_budget", args.opt_iter_budget),
+            ("opt_max_weight", args.opt_max_weight),
         ):
             if val is not None:
                 kwargs[key] = val
@@ -1711,6 +1897,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_route(args)
     if args.command == "publish":
         return _cmd_publish(args)
+    if args.command == "optimize":
+        return _cmd_optimize(args)
+    if args.command == "explain":
+        return _cmd_optimize(args, explain=True)
     if args.command == "stats":
         return _cmd_stats(args)
     if args.command == "trace":

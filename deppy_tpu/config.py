@@ -198,6 +198,31 @@ _DECLARATIONS: List[EnvVar] = [
        "disables the tier; also --incremental-index-size).",
        flag="--incremental-index-size",
        config_key="incrementalIndexSize"),
+    # --- optimization tier (ISSUE 18) ------------------------------------
+    _v("DEPPY_TPU_OPT", "str", "on", "deppy_tpu.service",
+       "Optimization tier: POST /v1/optimize (`deppy optimize` / "
+       "`deppy explain`) serves minimal-change upgrade planning, "
+       "weighted soft constraints, and explain-why-not blocking sets "
+       "via the bound-tightening loop ('off' 404s the endpoint and "
+       "restores pre-tier /v1/resolve byte for byte; also --opt).",
+       flag="--opt", config_key="opt"),
+    _v("DEPPY_TPU_OPT_MAX_ITERATIONS", "int", 64,
+       "deppy_tpu.optimize.loop",
+       "Bound-tightening iteration cap per optimize request; hitting "
+       "it returns the best model found so far flagged non-optimal "
+       "(also --opt-max-iterations).",
+       flag="--opt-max-iterations", config_key="optMaxIterations"),
+    _v("DEPPY_TPU_OPT_ITER_BUDGET", "int", 1048576,
+       "deppy_tpu.optimize.loop",
+       "Engine-step budget per tightening probe; an exhausted probe "
+       "degrades the request to best-so-far instead of stalling a "
+       "speculative-class lane (also --opt-iter-budget).",
+       flag="--opt-iter-budget", config_key="optIterBudget"),
+    _v("DEPPY_TPU_OPT_MAX_WEIGHT", "int", 64, "deppy_tpu.optimize.loop",
+       "Largest accepted soft-constraint weight; heavier requests are "
+       "rejected as malformed (a weight cap keeps objective values — "
+       "and the tightening distance — bounded; also --opt-max-weight).",
+       flag="--opt-max-weight", config_key="optMaxWeight"),
     # --- fleet (ISSUE 15) ------------------------------------------------
     _v("DEPPY_TPU_FLEET_REPLICAS", "str", None, "deppy_tpu.fleet.router",
        "Replica addresses the affinity router fronts, comma-separated "

@@ -62,6 +62,11 @@ class BackendSpec:
     warm_start: bool
     definitive: bool
     cost_us: Dict[str, float]
+    # Signed objective-bound support (ISSUE 18): whether the backend can
+    # search under a mixed-sign weighted bound (cost-when-false terms
+    # folded to negative signed weights).  All-nonnegative bounds lower
+    # to plain AtMost cardinality and need only ``cardinality``.
+    bound_weights: bool = False
 
 
 _SPECS: Dict[str, BackendSpec] = {
@@ -74,7 +79,8 @@ _SPECS: Dict[str, BackendSpec] = {
         BackendSpec("host", _CLASS_NAMES, cardinality=True,
                     warm_start=True, definitive=True,
                     cost_us={"xs": 600.0, "s": 2500.0, "m": 12000.0,
-                             "l": 60000.0, "xl": 150000.0}),
+                             "l": 60000.0, "xl": 150000.0},
+                    bound_weights=True),
         BackendSpec("hostpool", _CLASS_NAMES, cardinality=True,
                     warm_start=False, definitive=True,
                     cost_us={"xs": 300.0, "s": 900.0, "m": 4000.0,
@@ -165,6 +171,28 @@ def candidates(class_name: str, k: int, device_ok: bool = True,
         if len(out) >= max(int(k), 2):
             break
     return out, measured
+
+
+def optimize_candidates(class_name: str, k: int = 2,
+                        signed: bool = False,
+                        device_ok: bool = True,
+                        pool_ok: Optional[bool] = None) -> Tuple[List[str], bool]:
+    """Raceable backends for one optimize-tier bound probe (ISSUE 18).
+
+    Definitive backends only: a probe's UNSAT at the tightened bound is
+    the tier's optimality PROOF, so a backend that can fail to decide an
+    instance it accepts (grad_relax) must never answer one.  ``signed``
+    probes (mixed-sign weights — upgrade planning's keep-installed
+    terms) further require ``bound_weights``; all-nonnegative probes
+    lower to plain AtMost cardinality and keep the full definitive
+    field."""
+    names, measured = candidates(class_name, k=len(_SPECS),
+                                 device_ok=device_ok, pool_ok=pool_ok,
+                                 cardinality=True)
+    out = [n for n in names
+           if _SPECS[n].definitive
+           and (not signed or _SPECS[n].bound_weights)]
+    return out[: max(int(k), 1)], measured
 
 
 # ------------------------------------------------------------- adapters

@@ -1,0 +1,33 @@
+"""Optimization tier (ISSUE 18): best-solution queries above plain
+resolution — minimal-change upgrade planning, weighted soft
+constraints, and explain-why-not blocking sets, all served by one
+bound-tightening loop that rides the scheduler's idle-priority queue.
+
+Surface: :class:`Planner` (the serving core the service constructs
+behind ``POST /v1/optimize``), :class:`OptimizeRequest` /
+:class:`Objective` (the format layer), and
+:class:`OptimizeFormatError` (the endpoint's 400)."""
+
+from .loop import Planner
+from .objective import (
+    BOUND_VARIABLE_ID,
+    Objective,
+    OptimizeFormatError,
+    OptimizeRequest,
+    build_objective,
+    cone_mask,
+    explain_variables,
+    native_bound_variables,
+)
+
+__all__ = [
+    "BOUND_VARIABLE_ID",
+    "Objective",
+    "OptimizeFormatError",
+    "OptimizeRequest",
+    "Planner",
+    "build_objective",
+    "cone_mask",
+    "explain_variables",
+    "native_bound_variables",
+]

@@ -38,12 +38,16 @@ def summarize(path) -> dict:
     device: List[dict] = []
     backends: Dict[str, dict] = {}
     races: Dict[str, dict] = {}
+    optimize: Dict[str, dict] = {}
     n_events = 0
     for ev in events:
         if ev is None:
             continue
         if ev.get("kind") == "race":
             _take_race(races, ev)
+            continue
+        if ev.get("kind") == "optimize":
+            _take_optimize(optimize, ev)
             continue
         if ev.get("kind") != "profile":
             continue
@@ -70,6 +74,11 @@ def summarize(path) -> dict:
             round(sum(margins) / len(margins), 6) if margins else None)
         agg["win_margin_s_min"] = (round(min(margins), 6)
                                    if margins else None)
+    for agg in optimize.values():
+        agg["probe_s"] = round(agg["probe_s"], 6)
+        agg["improvement_mean"] = (
+            round(agg["improvement_total"] / agg["improvements"], 2)
+            if agg["improvements"] else None)
     return {
         "profile_events": n_events,
         "device_dispatches": len(device),
@@ -77,6 +86,7 @@ def summarize(path) -> dict:
         "size_classes": _size_classes(device),
         "backends": backends,
         "races": races,
+        "optimize": optimize,
     }
 
 
@@ -107,6 +117,40 @@ def _take_race(races: Dict[str, dict], ev: dict) -> None:
     m = ev.get("win_margin_s")
     if isinstance(m, (int, float)):
         agg["_margins"].append(float(m))
+
+
+def _take_optimize(optimize: Dict[str, dict], ev: dict) -> None:
+    """One bound-tightening probe (ISSUE 18), keyed by probe mode —
+    the warm-vs-cold split is the table's point: per-iteration rate,
+    hit ratio, and which backend wins the cold probes, from the sink's
+    ``optimize`` events alone."""
+    key = str(ev.get("mode", "?"))
+    agg = optimize.setdefault(key, {
+        "probes": 0, "improvements": 0, "proofs": 0, "misses": 0,
+        "budget": 0, "improvement_total": 0, "probe_s": 0.0,
+        "backend_wins": {},
+    })
+    agg["probes"] += 1
+    try:
+        agg["probe_s"] += float(ev.get("dur_s", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        pass
+    outcome = ev.get("outcome")
+    if outcome == "improved":
+        agg["improvements"] += 1
+        try:
+            agg["improvement_total"] += int(ev.get("improvement", 0) or 0)
+        except (TypeError, ValueError):
+            pass
+        backend = str(ev.get("backend", "?"))
+        agg["backend_wins"][backend] = \
+            agg["backend_wins"].get(backend, 0) + 1
+    elif outcome == "unsat":
+        agg["proofs"] += 1
+    elif outcome == "budget":
+        agg["budget"] += 1
+    else:
+        agg["misses"] += 1
 
 
 def _trip_regression(device: List[dict]) -> Optional[dict]:
@@ -237,4 +281,23 @@ def render_text(summary: dict, path: str) -> str:
                 lines.append(
                     f"  {'':>10}  !! {a['check_mismatches']} sampled "
                     f"cross-check mismatch(es) — served canonical")
+    optimize = summary.get("optimize") or {}
+    if optimize:
+        lines.append("optimization probes (per mode):")
+        lines.append(f"  {'mode':>10}  {'probes':>6}  {'improved':>8}  "
+                     f"{'proofs':>6}  {'miss':>5}  {'budget':>6}  "
+                     f"{'delta/imp':>9}  {'ms/probe':>8}  "
+                     f"{'backend wins':<24}")
+        for key in sorted(optimize):
+            a = optimize[key]
+            wins = " ".join(f"{n}={c}" for n, c in
+                            sorted(a["backend_wins"].items())) or "-"
+            mean = a.get("improvement_mean")
+            per = (a["probe_s"] * 1e3 / a["probes"]
+                   if a["probes"] else 0.0)
+            lines.append(
+                f"  {key:>10}  {a['probes']:>6}  {a['improvements']:>8}  "
+                f"{a['proofs']:>6}  {a['misses']:>5}  {a['budget']:>6}  "
+                f"{mean if mean is not None else '-':>9}  {per:>8.2f}  "
+                f"{wins:<24}")
     return "\n".join(lines)

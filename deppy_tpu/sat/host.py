@@ -208,6 +208,8 @@ class HostEngine:
         assign: np.ndarray,
         min_mask: Optional[np.ndarray] = None,
         min_w: int = 0,
+        obj_w: Optional[np.ndarray] = None,
+        obj_bound: int = 0,
     ) -> Tuple[bool, np.ndarray]:
         """Propagate to fixpoint.  Returns (conflict, assignment).
 
@@ -216,11 +218,17 @@ class HostEngine:
         turns into a vmapped kernel.  ``min_mask``/``min_w`` is the dynamic
         "at most w of the extras" side-constraint used by the minimization
         loop (the native replacement for CardinalityConstrainer + Leq(w),
-        solve.go:100-110).
+        solve.go:100-110).  ``obj_w``/``obj_bound`` (ISSUE 18) is the
+        signed generalization the optimize tier's bound-tightening
+        probes use: sum(obj_w[v] for model-true v) <= obj_bound, where a
+        negative weight models a cost-when-false term (keep-installed)
+        folded to signed form — unit positive weights over a mask
+        degenerate to exactly the ``min_mask`` rule.
         """
         self._bcp_rounds = 0
         try:
-            return self._bcp_loop(assign, min_mask, min_w)
+            return self._bcp_loop(assign, min_mask, min_w, obj_w,
+                                  obj_bound)
         finally:
             # Telemetry (SURVEY.md §5): every fixpoint iteration counts,
             # whichever of the loop's return paths ended it.
@@ -233,6 +241,8 @@ class HostEngine:
         assign: np.ndarray,
         min_mask: Optional[np.ndarray],
         min_w: int,
+        obj_w: Optional[np.ndarray] = None,
+        obj_bound: int = 0,
     ) -> Tuple[bool, np.ndarray]:
         p = self.p
         self.last_conflicts = []
@@ -300,6 +310,35 @@ class HostEngine:
                             return True, assign
                         want[m] = _FALSE
 
+            if obj_w is not None:
+                mvals = assign[: self.n]
+                unk_m = mvals == _UNASSIGNED
+                neg = obj_w < 0
+                # Least achievable objective under this prefix:
+                # decided-true weights are spent, and every still-open
+                # negative weight is free to take.  Like the min_mask
+                # rule, a violated bound is a conflict with no applied
+                # constraint to blame (it is a side constraint).
+                lb = int(obj_w[mvals == _TRUE].sum()
+                         + obj_w[unk_m & neg].sum())
+                if lb > obj_bound:
+                    return True, assign
+                if unk_m.any():
+                    # Forcing: an open positive-weight var the bound
+                    # cannot afford must be false; an open negative-
+                    # weight var whose refusal would break the bound
+                    # must be true (lb already banks its weight).
+                    for m in np.nonzero(unk_m & (obj_w > 0)
+                                        & (obj_w + lb > obj_bound))[0]:
+                        if want[m] == _TRUE:
+                            return True, assign
+                        want[m] = _FALSE
+                    for m in np.nonzero(unk_m & neg
+                                        & (lb - obj_w > obj_bound))[0]:
+                        if want[m] == _FALSE:
+                            return True, assign
+                        want[m] = _TRUE
+
             pending = want != 0
             new = pending & (assign == _UNASSIGNED)
             clash = pending & (assign != _UNASSIGNED) & (assign != want)
@@ -352,12 +391,17 @@ class HostEngine:
         act_enabled: Optional[np.ndarray] = None,
         min_mask: Optional[np.ndarray] = None,
         min_w: int = 0,
+        obj_w: Optional[np.ndarray] = None,
+        obj_bound: int = 0,
     ) -> Tuple[bool, Optional[np.ndarray]]:
         """Complete search under assumptions — the analog of gini ``Solve()``
         (search.go:168, solve.go:107).  Chronological DPLL, deciding the
         lowest-index unassigned problem variable false first, so discovered
         models are biased toward minimal installs before the explicit
-        cardinality-minimization pass."""
+        cardinality-minimization pass.  The false-first / lowest-index order
+        also makes the returned model the lexicographically least model
+        (false < true over var index), which the optimize tier relies on as
+        its canonical tie-break."""
         assign = self._base.copy()
         if act_enabled is not None:
             assign[self.n :] = np.where(act_enabled, _TRUE, _UNASSIGNED)
@@ -368,7 +412,7 @@ class HostEngine:
         for m in fixed_false:
             assign[m] = _FALSE
 
-        conflict, assign = self._bcp(assign, min_mask, min_w)
+        conflict, assign = self._bcp(assign, min_mask, min_w, obj_w, obj_bound)
         if conflict:
             return False, None
         # stack of (var, phase_tried_second, snapshot)
@@ -383,7 +427,7 @@ class HostEngine:
             stack.append((var, False, assign))
             trial = assign.copy()
             trial[var] = _FALSE
-            conflict, trial = self._bcp(trial, min_mask, min_w)
+            conflict, trial = self._bcp(trial, min_mask, min_w, obj_w, obj_bound)
             while conflict:
                 # Backtrack chronologically: flip the deepest unflipped
                 # decision to true; pop flipped ones.
@@ -395,7 +439,7 @@ class HostEngine:
                 stack.append((var, True, snap))
                 trial = snap.copy()
                 trial[var] = _TRUE
-                conflict, trial = self._bcp(trial, min_mask, min_w)
+                conflict, trial = self._bcp(trial, min_mask, min_w, obj_w, obj_bound)
             assign = trial
 
     # --------------------------------------------------------------- search
@@ -875,6 +919,49 @@ class HostEngine:
                 installed_idx = [i for i in range(self.n) if m2[i] == _TRUE]
                 return [p.variables[i] for i in installed_idx], installed_idx
         raise InternalSolverError(["unexpected internal error: minimization failed"])
+
+    # ------------------------------------------------- bounded solve (opt)
+
+    def solve_bounded(
+        self,
+        obj_w: np.ndarray,
+        obj_bound: int,
+        seed_model: Optional[np.ndarray] = None,
+        cone_mask: Optional[np.ndarray] = None,
+    ) -> Tuple[bool, Optional[np.ndarray]]:
+        """One bound-tightening probe for the optimize tier (ISSUE 18):
+        find any model with ``sum(obj_w[v] for model-true v) <= obj_bound``,
+        or prove none exists under the probe's scope.
+
+        ``seed_model``/``cone_mask`` together form the warm (cone) variant
+        mirroring the incremental tier's cone solve: off-cone vars are
+        pinned to the seed model's phases and only the cone is re-searched.
+        A warm probe's UNSAT is therefore NOT an optimality proof — the
+        pinned prefix may be what blocks the bound — and callers must fall
+        back to a cold (unseeded) probe before claiming one.  A cold
+        probe's False return IS definitive: no model at this bound.
+
+        Raises Incomplete/SolveCancelled through the step counter like
+        every other entry point; ``p.errors`` raise InternalSolverError."""
+        if self.p.errors:
+            raise InternalSolverError(self.p.errors)
+        fixed_true: List[int] = []
+        fixed_false: List[int] = []
+        if seed_model is not None and cone_mask is not None:
+            for i in range(self.n):
+                if cone_mask[i]:
+                    continue
+                if seed_model[i] == _TRUE:
+                    fixed_true.append(i)
+                else:
+                    fixed_false.append(i)
+        ok, model = self._dpll(
+            fixed_true=fixed_true,
+            fixed_false=fixed_false,
+            obj_w=np.asarray(obj_w, dtype=np.int64)[: self.n],
+            obj_bound=int(obj_bound),
+        )
+        return ok, model
 
     # ---------------------------------------------------------- unsat core
 

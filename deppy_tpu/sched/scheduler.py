@@ -1152,6 +1152,73 @@ class Scheduler:
                 self._cv.notify_all()
         return queued, dropped
 
+    def submit_optimize(
+        self,
+        problem_vars: Sequence[Sequence[Variable]],
+        deadline_s: Optional[float] = None,
+        max_steps: Optional[int] = None,
+        stats: Optional[dict] = None,
+        tenant: str = "default",
+    ) -> List[object]:
+        """Blocking :meth:`submit` sibling for optimize-tier bound
+        probes (ISSUE 18), queued at IDLE priority: probe groups ride
+        the speculative queue, so a long bound-tightening loop coalesces
+        at flush boundaries like churn and live resolution traffic
+        preempts every iteration — but unlike pre-solves a submitter IS
+        waiting, so probes are never cap-dropped (the blocked caller is
+        the backpressure) and dispatch errors re-raise here.
+
+        Probes skip the result cache and the warm-plan index on purpose:
+        a probe's answer doubles as an optimality proof, so it must come
+        from an actual solve, and its model (biased by the synthetic
+        bound variable) must not seed warm starts for plain requests."""
+        from ..engine.driver import _budget
+
+        if max_steps is None:
+            max_steps = self.max_steps
+        budget = int(_budget(max_steps))
+        problems = [encode(vs) for vs in problem_vars]
+        for p in problems:
+            if p.errors:
+                raise InternalSolverError(p.errors)
+        with faults.deadline_scope(deadline_s), faults.ambient_deadline():
+            dl = faults.current_deadline()
+        lanes = [_Lane(p, fingerprint(p), max_steps, budget, dl,
+                       tenant=tenant) for p in problems]
+        group = self._make_group(lanes, budget, speculative=True)
+        inline = False
+        with self._cv:
+            if self.running:
+                self._spec_queue.append(group)
+                self._spec_depth += len(group.lanes)
+                if self._g_spec_depth is not None:
+                    self._g_spec_depth.set(self._spec_depth)
+                self._cv.notify_all()
+            else:
+                inline = True
+        if inline:
+            # No loop thread (library use, or post-shutdown stragglers):
+            # the probe dispatches on the caller's thread like _enqueue.
+            self._dispatch([group], reason="inline")
+        group.event.wait()
+        if group.error is not None:
+            raise group.error
+        deadline_misses = 0
+        for lane in lanes:
+            if lane.degraded:
+                deadline_misses += 1
+                telemetry.trace.mark_error()
+        qw = group.timing.get("queue_wait_s")
+        if qw is not None:
+            telemetry.default_registry().record_span(
+                "sched.queue_wait", qw, lanes=len(group.lanes))
+        if stats is not None:
+            stats["steps"] = sum(lane.steps for lane in lanes)
+            stats["report"] = group.report
+            stats["timings"] = dict(group.timing)
+            stats["deadline_misses"] = deadline_misses
+        return [lane.result for lane in lanes]
+
     def _enqueue(self, group: _Group) -> None:
         with self._cv:
             if self.running:
@@ -1181,6 +1248,11 @@ class Scheduler:
                 self._depth = 0
                 self._tenant_depth.clear()
                 self._g_depth.set(0)
+                # Speculative orphans fail loudly too (ISSUE 18): a
+                # pre-solve's event has no waiter, but an optimize
+                # probe's does — leaving it unset parks that submitter
+                # forever.
+                orphans += self._spec_queue
                 self._spec_queue = []
                 self._spec_depth = 0
                 self._spec_keys.clear()
@@ -1195,6 +1267,7 @@ class Scheduler:
     def _loop_inner(self) -> None:
         while True:
             discarded = 0
+            spec_orphans: List[_Group] = []
             groups: List[_Group] = []
             reason = None
             with self._cv:
@@ -1204,8 +1277,11 @@ class Scheduler:
                 if self._stop and self._spec_queue:
                     # Shutdown discards the speculative backlog: no
                     # submitter waits on a pre-solve, and opportunistic
-                    # work must never slow a drain.
+                    # work must never slow a drain.  Optimize probes
+                    # (ISSUE 18) ride this queue WITH a waiter — their
+                    # groups are failed below, outside the lock.
                     discarded = self._spec_depth
+                    spec_orphans = self._spec_queue
                     self._spec_queue = []
                     self._spec_depth = 0
                     self._spec_keys.clear()
@@ -1230,6 +1306,11 @@ class Scheduler:
                     # the dispatch preempt at the next loop iteration
                     # (the flush boundary).
                     groups, reason = self._drain_spec_locked()
+            for g in spec_orphans:
+                if not g.event.is_set():
+                    g.error = RuntimeError(
+                        "scheduler stopped before optimize dispatch")
+                    g.event.set()
             if discarded and self.speculate is not None:
                 self.speculate.note_discarded(discarded)
             if not groups:

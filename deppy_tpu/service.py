@@ -265,6 +265,10 @@ class Server:
         obs_baseline: Optional[str] = None,
         fleet_router: Optional[str] = None,
         fleet_advertise: Optional[str] = None,
+        opt: Optional[str] = None,
+        opt_max_iterations: Optional[int] = None,
+        opt_iter_budget: Optional[int] = None,
+        opt_max_weight: Optional[int] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -363,6 +367,26 @@ class Server:
                 speculate_max_backlog=speculate_max_backlog,
                 fair=fair,
                 tenant_weights=tenant_weights)
+        # Optimization tier (ISSUE 18): POST /v1/optimize serves
+        # upgrade planning / soft constraints / explain-why-not through
+        # the bound-tightening loop.  The tier rides the scheduler's
+        # idle-priority queue, so it exists only when the scheduler
+        # does; "off" (or sched off) constructs nothing — the endpoint
+        # 404s like any unknown path and every other surface is
+        # byte-identical to pre-tier.  The planner's counters register
+        # on this server's registry so they ride /metrics.
+        if opt is None:
+            opt = config.env_raw("DEPPY_TPU_OPT", "on")
+        self.optimizer = None
+        if self.scheduler is not None and str(opt).strip().lower() \
+                not in ("off", "0", "false", "no"):
+            from .optimize import Planner
+
+            self.optimizer = Planner(
+                self.scheduler, metrics=self.metrics.registry,
+                max_iterations=opt_max_iterations,
+                iter_budget=opt_iter_budget,
+                max_weight=opt_max_weight)
         # Fault-domain knobs (ISSUE 2).  request_deadline_s: default
         # wall-clock budget per /v1/resolve (clients override per request
         # via the X-Deppy-Deadline-S header; None = unbounded).  drain_s
@@ -554,6 +578,37 @@ class Server:
         self.metrics.observe_batch(outcomes, time.perf_counter() - t0,
                                    steps=steps, report=report)
         return 200, {"results": rendered}
+
+    def optimize_document(self, doc,
+                          deadline_s: Optional[float] = None,
+                          tenant: str = "default") -> Tuple[int, dict]:
+        """Serve one optimize request body (ISSUE 18); returns
+        (http_status, response_doc) with :meth:`resolve_document`'s
+        error contract: malformed documents and unresolvable references
+        are 400s, admission pressure is a 503 with ``retry_after_s``,
+        runtime failures surface as the handler's 500."""
+        from .optimize import OptimizeFormatError
+
+        if deadline_s is None:
+            deadline_s = self.request_deadline_s
+        gate = self.admission_retry_after(deadline_s, tenant=tenant)
+        if gate is not None:
+            retry_after, msg = gate
+            self.metrics.observe_error()
+            return 503, {
+                "error": msg,
+                "retry_after_s": round(retry_after, 3),
+            }
+        try:
+            out = self.optimizer.handle(doc, deadline_s=deadline_s,
+                                        tenant=tenant)
+        except OptimizeFormatError as e:
+            self.metrics.observe_error()
+            return 400, {"error": str(e)}
+        except (DuplicateIdentifier, InternalSolverError) as e:
+            self.metrics.observe_error()
+            return 400, {"error": str(e)}
+        return 200, {"optimize": out}
 
     def _on_leader_change(self, leading: bool) -> None:
         self.metrics.leader = leading
@@ -933,6 +988,19 @@ def _api_handler(server: Server):
                 finally:
                     server._exit_request()
                 return
+            if self.path == "/v1/optimize":
+                # Optimization tier (ISSUE 18).  With the tier off this
+                # path 404s exactly like any unknown path — pre-change
+                # behavior byte for byte.
+                if server.optimizer is None:
+                    self._send_json(404, {"error": "not found"})
+                    return
+                server._enter_request()
+                try:
+                    self._optimize_request()
+                finally:
+                    server._exit_request()
+                return
             if self.path == "/debug/dump":
                 # Flight-recorder dump on demand (ISSUE 16): the HTTP
                 # twin of SIGUSR2, so the router can fan one operator
@@ -1052,6 +1120,73 @@ def _api_handler(server: Server):
                         out["result"])
                 rendered.append(out)
             self._send_json(200, {"preview": rendered})
+
+        def _optimize_request(self):
+            """POST /v1/optimize (ISSUE 18) — the /v1/resolve request
+            envelope (trace context, tenant identity, deadline header,
+            SLO accounting) around the planner's bound-tightening loop,
+            so optimization cost is attributable per tenant exactly
+            like resolution cost."""
+            inbound_tp = self.headers.get("traceparent")
+            inbound_rid = self.headers.get("X-Deppy-Request-Id")
+            ctx = telemetry.trace.context_from_headers(inbound_tp,
+                                                       inbound_rid)
+            self._trace_ctx = ctx
+            self._echo_ids = inbound_tp is not None \
+                or inbound_rid is not None
+            self._echo_traceparent = inbound_tp is not None
+            tenant = profiling.sanitize_tenant(
+                self.headers.get("X-Deppy-Tenant"))
+            timings: dict = {}
+            t0 = time.perf_counter()
+            reg = telemetry.default_registry()
+            status = None
+            try:
+                span_attrs = {"path": "/v1/optimize",
+                              "request_id": ctx.request_id,
+                              "tenant": tenant}
+                if server.replica is not None:
+                    span_attrs["replica"] = server.replica
+                with telemetry.trace.activate(ctx), \
+                        reg.span("service.request", **span_attrs) as sp:
+                    status = self._optimize_request_inner(tenant)
+                    sp["status"] = status
+            finally:
+                timings["total_s"] = time.perf_counter() - t0
+                server.metrics.observe_request(timings["total_s"], None)
+                server.slo.observe(
+                    tenant, timings["total_s"],
+                    deadline_miss=False,
+                    error=status is None or status >= 500)
+                telemetry.trace.default_recorder().record(
+                    ctx, status=status, timings=timings)
+
+        def _optimize_request_inner(self, tenant) -> int:
+            deadline_s = None
+            raw_deadline = self.headers.get("X-Deppy-Deadline-S")
+            if raw_deadline is not None:
+                import math
+
+                try:
+                    deadline_s = float(raw_deadline)
+                except ValueError:
+                    deadline_s = None
+                if deadline_s is None or not math.isfinite(deadline_s):
+                    server.metrics.observe_error()
+                    return self._send_json(
+                        400, {"error": "invalid X-Deppy-Deadline-S header"})
+            doc, err = self._read_json_body()
+            if err is not None:
+                return err
+            try:
+                status, resp = server.optimize_document(
+                    doc, deadline_s=deadline_s, tenant=tenant)
+            except Exception as e:  # same contract as /v1/resolve: a
+                # runtime failure is a visible 500, not a dropped
+                # connection.
+                server.metrics.observe_error()
+                status, resp = 500, {"error": f"internal error: {e}"}
+            return self._send_json(status, resp)
 
         def _resolve_request(self):
             # Per-request trace context (ISSUE 4): honor an inbound W3C
@@ -1223,6 +1358,10 @@ def serve(
     obs_baseline: Optional[str] = None,
     fleet_router: Optional[str] = None,
     fleet_advertise: Optional[str] = None,
+    opt: Optional[str] = None,
+    opt_max_iterations: Optional[int] = None,
+    opt_iter_budget: Optional[int] = None,
+    opt_max_weight: Optional[int] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -1245,7 +1384,10 @@ def serve(
                  tenant_weights=tenant_weights,
                  obs_stream=obs_stream, obs_flush_ms=obs_flush_ms,
                  obs_baseline=obs_baseline, fleet_router=fleet_router,
-                 fleet_advertise=fleet_advertise)
+                 fleet_advertise=fleet_advertise, opt=opt,
+                 opt_max_iterations=opt_max_iterations,
+                 opt_iter_budget=opt_iter_budget,
+                 opt_max_weight=opt_max_weight)
     srv.start()
     stop = threading.Event()
 

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Optimization-tier smoke test (`make optimize-smoke`, ISSUE 18).
+
+Boots TWO batch-resolution services on ephemeral ports — one with the
+optimization tier (the default), one with ``opt="off"`` — and drives
+the three query classes end to end:
+
+  * **upgrade planning** — a churned catalog's minimal-change plan,
+    oracle-checked in-process: the served plan must satisfy every
+    constraint, adopt every preferred release, and touch no more
+    installed entities than the known-optimal plan;
+  * **soft constraints** — a weighted MaxSAT-style query proves its
+    optimum with the tightening loop (iterations and improvements
+    visible in the response and on ``deppy_optimize_*`` counters at
+    the scrape endpoint);
+  * **explain-why-not** — a goal blocked by a conflicting mandatory
+    bundle returns the named human-readable blocking set;
+  * **off surface** — the opt-off service 404s ``/v1/optimize``
+    byte-identically to an unknown path, registers no
+    ``deppy_optimize_*`` metric families, and serves ``/v1/resolve``
+    byte-identically to the optimizing service.
+
+Fast on purpose: host backend, no device compile — the full subsystem
+suite is ``make test-optimize`` (tests/test_optimize.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from http.client import HTTPConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_PACKAGES = 8
+
+
+def request(port: int, method: str, path: str, body=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    headers = {"Content-Type": "application/json"} if body is not None else {}
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def metric(text: str, name: str):
+    total = None
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            total = (total or 0.0) + float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def scrape(port: int) -> str:
+    _, data = request(port, "GET", "/metrics")
+    return data.decode()
+
+
+def catalog(drift: int) -> list:
+    """A chained version-group catalog (the upgrade bench's shape):
+    package p's dependency row lists versions newest-first under an
+    AtMost-1 pin, each version depending on the next package.  The
+    first ``drift`` packages ship a new release at the head of their
+    row."""
+    variables = []
+    for p in range(N_PACKAGES):
+        vids = [f"p{p}.v0", f"p{p}.v1"]
+        if p < drift:
+            vids.insert(0, f"p{p}.new")
+        cons = []
+        if p == 0:
+            cons.append({"type": "mandatory"})
+        cons.append({"type": "dependency", "ids": vids})
+        cons.append({"type": "atMost", "n": 1, "ids": vids})
+        variables.append({"id": f"p{p}", "constraints": cons})
+        for vid in vids:
+            vcons = []
+            if p + 1 < N_PACKAGES:
+                vcons.append({"type": "dependency", "ids": [f"p{p + 1}"]})
+            variables.append({"id": vid, "constraints": vcons})
+    return variables
+
+
+def main() -> int:
+    from deppy_tpu import io as problem_io
+    from deppy_tpu.service import Server
+    from deppy_tpu.utils import check_solution
+
+    on = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                backend="host")
+    on.start()
+    off = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host", opt="off")
+    off.start()
+    try:
+        # ---- upgrade planning: minimal-change, oracle-checked ----------
+        drift = 3
+        doc = {"query": "upgrade", "variables": catalog(drift),
+               "installed": ([f"p{p}" for p in range(N_PACKAGES)]
+                             + [f"p{p}.v1" for p in range(N_PACKAGES)]),
+               "prefer": [f"p{p}.new" for p in range(drift)]}
+        status, body = request(on.api_port, "POST", "/v1/optimize", doc)
+        assert status == 200, (status, body)
+        plan = json.loads(body)["optimize"]
+        assert plan["status"] == "optimal", plan
+        assert plan["missing_prefer"] == [], plan
+        variables = [problem_io.variable_from_dict(v)
+                     for v in doc["variables"]]
+        assert check_solution(variables, plan["selected"]) == [], \
+            "served plan violates the catalog"
+        # Known optimum: adopt each release (+1), retire its installed
+        # version (+1), touch nothing else.
+        assert plan["touched"] == 2 * drift, plan
+        upgrade_iters = plan["iterations"]
+
+        # ---- soft constraints: proven optimum, loop visible ------------
+        sdoc = {"query": "soft", "variables": catalog(0),
+                "soft": ([{"id": f"p{p}.v1", "installed": True,
+                           "weight": 2} for p in range(N_PACKAGES)]
+                         + [{"id": "p0.v0", "installed": True,
+                             "weight": 1}])}
+        status, body = request(on.api_port, "POST", "/v1/optimize", sdoc)
+        assert status == 200, (status, body)
+        soft = json.loads(body)["optimize"]
+        assert soft["status"] == "optimal", soft
+        # Weight-2 wants win; the AtMost pin forfeits only the weight-1.
+        assert soft["objective"] == 1, soft
+        text = scrape(on.api_port)
+        iters = metric(text, "deppy_optimize_iterations_total") or 0
+        proofs = metric(text, "deppy_optimize_proofs_total") or 0
+        assert iters >= upgrade_iters + soft["iterations"] > 0, \
+            (iters, upgrade_iters, soft["iterations"])
+        assert proofs >= 2, proofs
+
+        # ---- explain-why-not: the named blocking set -------------------
+        blocked = catalog(0)
+        blocked.append({"id": "blocker", "constraints": [
+            {"type": "mandatory"},
+            {"type": "conflict", "id": "p0.v0"},
+            {"type": "conflict", "id": "p0.v1"}]})
+        status, body = request(on.api_port, "POST", "/v1/optimize",
+                               {"query": "explain", "variables": blocked,
+                                "goal": ["p0"]})
+        assert status == 200, (status, body)
+        why = json.loads(body)["optimize"]
+        assert why["status"] == "blocked", why
+        core = " ".join(why["blocking"])
+        assert "conflicts with" in core and "blocker" in core, why
+
+        # ---- opt-off surface -------------------------------------------
+        s_opt, b_opt = request(off.api_port, "POST", "/v1/optimize", doc)
+        s_unk, b_unk = request(off.api_port, "POST", "/v1/no-such", doc)
+        assert s_opt == s_unk == 404, (s_opt, s_unk)
+        assert b_opt == b_unk, "opt-off 404 must match the unknown path"
+        assert metric(scrape(off.api_port),
+                      "deppy_optimize_iterations_total") is None, \
+            "opt-off service must register no optimize metric families"
+        resolve = {"variables": doc["variables"]}
+        s_on, r_on = request(on.api_port, "POST", "/v1/resolve", resolve)
+        s_off, r_off = request(off.api_port, "POST", "/v1/resolve",
+                               resolve)
+        assert s_on == s_off == 200, (s_on, s_off)
+        assert r_on == r_off, "resolve must be byte-identical opt on/off"
+
+        print(f"optimize smoke OK: upgrade plan touched={plan['touched']} "
+              f"(optimal, {upgrade_iters} iterations); soft optimum "
+              f"objective={soft['objective']} ({soft['iterations']} "
+              f"iterations, {int(proofs)} proofs on /metrics); explain "
+              f"named {len(why['blocking'])} blockers; off 404 + "
+              f"resolve byte-identical")
+        return 0
+    finally:
+        on.shutdown()
+        off.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

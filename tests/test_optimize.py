@@ -1,0 +1,394 @@
+"""Optimization tier (ISSUE 18).
+
+The acceptance surface, from the issue:
+
+  * fuzz differential: every tightening answer equals the brute-force
+    enumeration oracle — objective value AND tie-break order (the
+    lex-least optimum, False < True over variable index);
+  * ``DEPPY_TPU_OPT=off`` 404s ``POST /v1/optimize`` (byte-identical
+    to the unknown-path 404) and leaves ``/v1/resolve`` responses
+    byte for byte untouched;
+  * a mid-loop deadline or budget exhaustion degrades to the best
+    model so far, flagged non-optimal with the degradation reason;
+  * explain-why-not surfaces the unsat core as a named human-readable
+    blocking set.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import random
+from http.client import HTTPConnection
+
+import pytest
+
+from deppy_tpu import faults, telemetry
+from deppy_tpu import io as problem_io
+from deppy_tpu import sat
+from deppy_tpu.optimize import OptimizeFormatError, Planner
+from deppy_tpu.sched import Scheduler
+from deppy_tpu.service import Server
+from deppy_tpu.utils import check_solution
+
+from _depth import depth
+
+pytestmark = pytest.mark.optimize
+
+
+@pytest.fixture(autouse=True)
+def fresh_fault_state():
+    """Isolate the process-global breaker, fault plan, and telemetry
+    registry per test (the sched suite's contract)."""
+    prev_breaker = faults.set_default_breaker(faults.CircuitBreaker())
+    prev_plan = faults.configure_plan(None)
+    prev_reg = telemetry.set_default_registry(telemetry.Registry())
+    yield
+    telemetry.set_default_registry(prev_reg)
+    faults.configure_plan(prev_plan)
+    faults.set_default_breaker(prev_breaker)
+
+
+@pytest.fixture
+def sched():
+    s = Scheduler(backend="host")
+    s.start()
+    yield s
+    s.stop()
+
+
+def _doc_of(variables, **fields) -> dict:
+    return {"variables": [problem_io.variable_to_dict(v)
+                          for v in variables], **fields}
+
+
+# ------------------------------------------------- enumeration oracle
+
+
+def _cost(doc: dict, chosen: set) -> int:
+    """The request's objective, computed straight from the query
+    semantics — independent of ``build_objective``'s signed folding."""
+    if doc["query"] == "upgrade":
+        big = len(doc["variables"]) + 1
+        installed = set(doc.get("installed", ()))
+        ids = {v["id"] for v in doc["variables"]}
+        cost = big * sum(1 for p in doc.get("prefer", ())
+                         if p not in chosen)
+        cost += len((installed & ids) - chosen)
+        cost += len(chosen - installed)
+        return cost
+    cost = 0
+    for entry in doc.get("soft", ()):
+        want = entry.get("installed", True)
+        if want != (entry["id"] in chosen):
+            cost += entry.get("weight", 1)
+    return cost
+
+
+def _oracle(doc: dict):
+    """Brute force: enumerate every assignment in lex order
+    (False < True, variable index 0 most significant), constraint-check
+    each with the independent verifier, and keep the first minimum —
+    which IS the lex-least optimum the canonical answer must match.
+    Returns ``(objective, selected-ids)`` or None when infeasible."""
+    variables = [problem_io.variable_from_dict(v)
+                 for v in doc["variables"]]
+    ids = [str(v.identifier) for v in variables]
+    best = None
+    for mask in itertools.product((False, True), repeat=len(ids)):
+        chosen = {i for i, on in zip(ids, mask) if on}
+        if check_solution(variables, chosen):
+            continue
+        cost = _cost(doc, chosen)
+        if best is None or cost < best[0]:
+            best = (cost, [i for i in ids if i in chosen])
+    return best
+
+
+def _random_doc(seed: int) -> dict:
+    rng = random.Random(seed)
+    n = rng.randint(3, 8)
+    ids = [f"x{i}" for i in range(n)]
+    variables = []
+    for i, vid in enumerate(ids):
+        cons = []
+        others = [o for o in ids if o != vid]
+        if rng.random() < 0.2:
+            cons.append(sat.mandatory())
+        if rng.random() < 0.55:
+            cons.append(sat.dependency(
+                *rng.sample(others, rng.randint(1, min(3, len(others))))))
+        if rng.random() < 0.3:
+            cons.append(sat.conflict(rng.choice(others)))
+        if rng.random() < 0.2 and len(others) >= 2:
+            cons.append(sat.at_most(1, *rng.sample(others, 2)))
+        variables.append(sat.variable(vid, *cons))
+    doc = _doc_of(variables)
+    if seed % 2 == 0:
+        doc["query"] = "upgrade"
+        doc["installed"] = rng.sample(ids, rng.randint(0, n))
+        doc["prefer"] = rng.sample(ids, rng.randint(0, 2))
+    else:
+        doc["query"] = "soft"
+        doc["soft"] = [{"id": rng.choice(ids),
+                        "installed": rng.random() < 0.5,
+                        "weight": rng.randint(1, 3)}
+                       for _ in range(rng.randint(1, 4))]
+    return doc
+
+
+class TestFuzzDifferential:
+    @pytest.mark.parametrize("seed", range(depth(40, 10)))
+    def test_answer_matches_enumeration_oracle(self, sched, seed):
+        doc = _random_doc(seed)
+        out = Planner(sched).handle(doc)
+        expect = _oracle(doc)
+        if expect is None:
+            assert out["status"] == "unsat"
+            assert out["blocking"]
+            return
+        assert out["status"] == "optimal", out
+        assert out["optimal"] is True
+        assert out["proof"] in ("unsat_probe", "floor")
+        # Objective value AND tie-break order: the canonical answer is
+        # the lex-least optimum the oracle's enumeration order finds
+        # first.
+        assert out["objective"] == expect[0]
+        assert out["selected"] == expect[1]
+
+    @pytest.mark.parametrize("seed", range(depth(10, 4)))
+    def test_warm_and_cold_prove_the_same_optimum(self, sched, seed):
+        doc = _random_doc(seed)
+        if _oracle(doc) is None:
+            pytest.skip("infeasible instance")
+        warm = Planner(sched).handle({**doc, "warm": True})
+        cold = Planner(sched).handle({**doc, "warm": False})
+        assert warm["objective"] == cold["objective"]
+        assert warm["selected"] == cold["selected"]
+
+    def test_inline_dispatch_without_running_loop(self):
+        # A stopped scheduler serves optimize probes inline — the
+        # library-mode path — rather than hanging on the queue.
+        s = Scheduler(backend="host")
+        doc = _random_doc(0)
+        out = Planner(s).handle(doc)
+        assert out["status"] in ("optimal", "unsat")
+
+
+# ------------------------------------------------------- upgrade shape
+
+
+def _upgrade_family():
+    """The canonical minimal-change case: the catalog prefers v2 but
+    only the app must move — the optimum keeps lib-v1 installed."""
+    return [
+        sat.variable("root", sat.mandatory(),
+                     sat.dependency("app-v2", "app-v1"),
+                     sat.at_most(1, "app-v2", "app-v1")),
+        sat.variable("app-v1", sat.dependency("lib-v1")),
+        sat.variable("app-v2", sat.dependency("lib-v1", "lib-v2")),
+        sat.variable("lib-v1"),
+        sat.variable("lib-v2"),
+    ]
+
+
+class TestUpgrade:
+    def test_minimal_change_plan(self, sched):
+        doc = _doc_of(_upgrade_family(), query="upgrade",
+                      installed=["root", "app-v1", "lib-v1"],
+                      prefer=["app-v2"])
+        out = Planner(sched).handle(doc)
+        assert out["status"] == "optimal"
+        assert out["missing_prefer"] == []
+        # app-v1 out, app-v2 in; lib-v1 kept — 2 touches, not 4.
+        assert out["touched"] == 2
+        assert out["selected"] == ["root", "app-v2", "lib-v1"]
+
+    def test_withdrawn_installed_bundle_is_ignored(self, sched):
+        doc = _doc_of(_upgrade_family(), query="upgrade",
+                      installed=["root", "app-v0", "app-v1", "lib-v1"],
+                      prefer=[])
+        out = Planner(sched).handle(doc)
+        assert out["status"] == "optimal"
+        assert out["touched"] == 0
+
+    def test_unknown_prefer_id_is_a_format_error(self, sched):
+        doc = _doc_of(_upgrade_family(), query="upgrade",
+                      installed=[], prefer=["nope"])
+        with pytest.raises(OptimizeFormatError):
+            Planner(sched).handle(doc)
+
+    def test_soft_weight_cap_enforced(self, sched):
+        doc = _doc_of(_upgrade_family(), query="soft",
+                      soft=[{"id": "lib-v1", "weight": 9}])
+        with pytest.raises(OptimizeFormatError):
+            Planner(sched, max_weight=8).handle(doc)
+        out = Planner(sched, max_weight=9).handle(doc)
+        assert out["status"] == "optimal"
+
+    def test_counters_land_on_the_given_registry(self, sched):
+        reg = telemetry.Registry()
+        planner = Planner(sched, metrics=reg)
+        doc = _doc_of(_upgrade_family(), query="upgrade",
+                      installed=["root", "app-v1", "lib-v1"],
+                      prefer=["app-v2"])
+        out = planner.handle(doc)
+        assert sum(planner._c_iterations.value.values()) \
+            == out["iterations"]
+        assert planner._c_improvements.value == out["improvements"]
+        assert planner._c_proofs.value.get(out["proof"]) == 1
+
+
+# ------------------------------------------------------- explain-why-not
+
+
+class TestExplain:
+    def test_blocked_goal_names_the_blocking_set(self, sched):
+        family = _upgrade_family() + [
+            sat.variable("blocker", sat.mandatory(),
+                         sat.conflict("lib-v1"), sat.conflict("lib-v2")),
+        ]
+        doc = _doc_of(family, query="explain", goal=["app-v2"])
+        out = Planner(sched).handle(doc)
+        assert out["status"] == "blocked"
+        text = " ".join(out["blocking"])
+        assert "conflicts with" in text
+        assert "blocker" in text
+
+    def test_feasible_goal_returns_a_plan(self, sched):
+        doc = _doc_of(_upgrade_family(), query="explain",
+                      goal=["app-v2"])
+        out = Planner(sched).handle(doc)
+        assert out["status"] == "feasible"
+        assert "app-v2" in out["plan"]
+        assert check_solution(_upgrade_family() , out["plan"]) == []
+
+    def test_explain_requires_goals(self, sched):
+        with pytest.raises(OptimizeFormatError):
+            Planner(sched).handle(
+                _doc_of(_upgrade_family(), query="explain", goal=[]))
+
+
+# ------------------------------------------------- mid-loop degradation
+
+
+def _slow_doc(n: int = 12) -> dict:
+    """An instance the loop can only tighten one unit per probe: free
+    variables under want-installed soft preferences.  The feasibility
+    solve starts near cost ``n`` (nothing selected), and the lex-least
+    bounded probe — false-first — satisfies ``cost <= bound`` with the
+    FEWEST trailing Trues it can, landing exactly ON the bound every
+    iteration.  Mixed-sign weights pin every probe to the host
+    objective engine, so the budget knobs bite deterministically."""
+    variables = [sat.variable(f"x{i}") for i in range(n)]
+    return _doc_of(variables, query="soft",
+                   soft=[{"id": f"x{i}", "installed": True, "weight": 1}
+                         for i in range(n)])
+
+
+class TestDegradation:
+    def test_iteration_cap_returns_best_so_far(self, sched):
+        doc = _slow_doc()
+        full = Planner(sched).handle(doc)
+        assert full["status"] == "optimal" and full["objective"] == 0
+        assert full["improvements"] > 2  # genuinely multi-iteration
+        capped = Planner(sched, max_iterations=1).handle(doc)
+        assert capped["status"] == "degraded"
+        assert capped["optimal"] is False
+        assert capped["reason"] == "iteration-cap"
+        assert capped["iterations"] == 1
+        # Best-so-far is a real (feasible) plan, just not proven least.
+        variables = [problem_io.variable_from_dict(v)
+                     for v in doc["variables"]]
+        assert check_solution(variables, capped["selected"]) == []
+        assert capped["objective"] > full["objective"]
+
+    def test_deadline_mid_loop_degrades(self, sched):
+        out = Planner(sched).handle(_slow_doc(), deadline_s=0.0)
+        assert out["status"] == "degraded"
+        assert out["reason"] == "deadline"
+        assert out["optimal"] is False
+
+    def test_probe_budget_flags_non_canonical(self, sched):
+        out = Planner(sched, iter_budget=1).handle(_slow_doc())
+        assert out["status"] == "degraded"
+        assert out["reason"] == "probe-budget"
+        # Even the canonicalizing solve blew the budget: the raw best
+        # model is served, flagged.
+        assert out.get("canonical") is False
+
+
+# -------------------------------------------------- service off-switch
+
+
+def _request(port, method, path, body=None, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    h = dict(headers or {})
+    if body is not None:
+        h.setdefault("Content-Type", "application/json")
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=h)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+class TestServiceSurface:
+    def test_optimize_endpoint_serves_and_validates(self):
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host")
+        srv.start()
+        try:
+            doc = _doc_of(_upgrade_family(), query="upgrade",
+                          installed=["root", "app-v1", "lib-v1"],
+                          prefer=["app-v2"])
+            status, body = _request(srv.api_port, "POST",
+                                    "/v1/optimize", doc)
+            assert status == 200
+            out = json.loads(body)["optimize"]
+            assert out["status"] == "optimal"
+            assert out["selected"] == ["root", "app-v2", "lib-v1"]
+            status, body = _request(srv.api_port, "POST",
+                                    "/v1/optimize", {"query": "nope"})
+            assert status == 400
+            assert "error" in json.loads(body)
+        finally:
+            srv.shutdown()
+
+    def test_off_404s_byte_identically_and_resolve_untouched(self):
+        on = Server(bind_address="127.0.0.1:0",
+                    probe_address="127.0.0.1:0", backend="host")
+        off = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host",
+                     opt="off")
+        on.start()
+        off.start()
+        try:
+            assert off.optimizer is None
+            doc = _doc_of(_upgrade_family(), query="upgrade",
+                          installed=[], prefer=[])
+            s_off, b_off = _request(off.api_port, "POST",
+                                    "/v1/optimize", doc)
+            s_unk, b_unk = _request(off.api_port, "POST",
+                                    "/v1/no-such-endpoint", doc)
+            assert s_off == s_unk == 404
+            assert b_off == b_unk  # byte-identical to the unknown path
+            resolve = {"variables": [problem_io.variable_to_dict(v)
+                                     for v in _upgrade_family()]}
+            s_on, r_on = _request(on.api_port, "POST", "/v1/resolve",
+                                  resolve)
+            s_off, r_off = _request(off.api_port, "POST", "/v1/resolve",
+                                    resolve)
+            assert s_on == s_off == 200
+            assert r_on == r_off  # resolve path byte-identical
+        finally:
+            on.shutdown()
+            off.shutdown()
+
+    def test_sched_off_has_no_optimizer(self):
+        srv = Server(bind_address="127.0.0.1:0",
+                     probe_address="127.0.0.1:0", backend="host",
+                     sched="off")
+        assert srv.optimizer is None
