@@ -140,6 +140,14 @@ obs-smoke: ## Fleet observability plane end to end: 3 replicas stream telemetry 
 test-obs: ## Fleet-observability subsystem tests only (the `obs` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m obs
 
+.PHONY: routes-smoke
+routes-smoke: ## Route-health plane end to end: a deliberately stale measured row trips the stale gauge, shadow probes run at the sampled rate under live load, a learned row is adopted (and cleared on shutdown), responses byte-identical to learn-off, `deppy routes` rebuilds the table from the sink alone (ISSUE 19 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/routes_smoke.py
+
+.PHONY: test-routes
+test-routes: ## Route-health subsystem tests only (the `routes` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m routes
+
 .PHONY: soak-smoke
 soak-smoke: ## Elastic-fleet chaos survival gate, quick shape: open-loop load across replica kill / runtime join+arc-flip / drain / router failover, byte-identity vs a fault-free oracle (ISSUE 17 acceptance at --seconds 70; this target runs the 20s smoke).
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/soak_smoke.py --seconds 20

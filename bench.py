@@ -598,7 +598,58 @@ def _run_soak(timeout_s: int) -> dict | None:
     return None
 
 
+def _run_routes(timeout_s: int) -> dict | None:
+    """Run the distribution-shift routing workload (ISSUE 19) on the
+    forced-CPU platform: a deliberately-wrong frozen portfolio row
+    served through the scheduler racing path, frozen/learned/oracle/
+    observe passes over the identical request stream — the learned
+    pass must recover >= 2x the frozen throughput, land within 20% of
+    the oracle, answer byte-identically, and cost <= 5% on the
+    unshifted mix."""
+    from deppy_tpu.utils.platform_env import run_captured
+
+    cmd = [sys.executable, "-m", "deppy_tpu.benchmarks.routes",
+           "--out", os.path.join(REPO, "benchmarks", "results",
+                                 "routes_r19.json")]
+    if "DEPPY_BENCH_N" in os.environ:
+        cmd += ["--meas-waves", os.environ["DEPPY_BENCH_N"]]
+    try:
+        rc, stdout, stderr = run_captured(
+            cmd, timeout_s=timeout_s, cwd=REPO, env=_cpu_env())
+    except subprocess.TimeoutExpired:
+        _log(f"routes workload timed out after {timeout_s}s")
+        return None
+    if stderr:
+        print(stderr, file=sys.stderr, end="", flush=True)
+    if rc != 0:
+        _log(f"routes workload failed rc={rc}")
+        return None
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return rec
+    return None
+
+
 def main(workload: str = "headline") -> int:
+    if workload == "routes":
+        rec = _run_routes(RUN_TIMEOUT_S)
+        if rec is None:
+            rec = {
+                "metric": ("distribution-shift resolutions/sec "
+                           "(learned routing vs frozen stale default)"),
+                "value": 0.0,
+                "unit": "problems/s",
+                "vs_baseline": 0.0,
+                "workload": "routes",
+                "backend": "none",
+                "error": "routes workload produced no record",
+            }
+        print(json.dumps(rec), flush=True)
+        return 0
     if workload == "upgrade":
         rec = _run_upgrade(RUN_TIMEOUT_S)
         if rec is None:
@@ -776,7 +827,7 @@ if __name__ == "__main__":
     _ap = argparse.ArgumentParser()
     _ap.add_argument("--workload",
                      choices=["headline", "churn", "hard", "publish",
-                              "fleet", "soak", "upgrade"],
+                              "fleet", "soak", "upgrade", "routes"],
                      default="headline",
                      help="headline = batched device vs serial host; "
                      "churn = warm-start vs cold re-resolution replay "
@@ -790,7 +841,9 @@ if __name__ == "__main__":
                      "kill/join/drain/router-failover under open-loop "
                      "load (ISSUE 17); upgrade = churned-catalog "
                      "minimal-change upgrade planning, warm cone "
-                     "probes vs cold tightening (ISSUE 18)")
+                     "probes vs cold tightening (ISSUE 18); routes = "
+                     "distribution-shift routing, learned vs frozen "
+                     "stale default through the racing path (ISSUE 19)")
     _args = _ap.parse_args()
     try:
         rc = main(workload=_args.workload)

@@ -368,6 +368,32 @@ def _build_parser() -> argparse.ArgumentParser:
         "weight — bigger weights are a 400, bounding probe work "
         "(default 64; also via DEPPY_TPU_OPT_MAX_WEIGHT)",
     )
+    p_serve.add_argument(
+        "--route-learn", choices=["off", "observe", "on"], default=None,
+        help="route-health plane (ISSUE 19): 'observe' arms the live "
+        "regret ledger, measured-defaults staleness watcher, and "
+        "idle-priority shadow probing of stale classes; 'on' adds the "
+        "online route registry that adopts live-learned portfolio "
+        "rows (racing order only — answers stay gated by the "
+        "definitive-winner rule and sampled cross-check) and gossips "
+        "them fleet-wide; default off arms nothing and keeps every "
+        "surface byte-identical (also via DEPPY_TPU_ROUTE_LEARN; "
+        "audit with `deppy routes`)",
+    )
+    p_serve.add_argument(
+        "--route-shadow-rate", type=float, default=None, metavar="RATE",
+        help="fraction of a stale-flagged class's flushes duplicated "
+        "to one non-serving backend at idle priority (deterministic "
+        "1-in-N per class, default 0.0625, 0 disables probing; also "
+        "via DEPPY_TPU_ROUTE_SHADOW_RATE)",
+    )
+    p_serve.add_argument(
+        "--route-registry", default=None, metavar="FILE",
+        help="persist live-learned routing rows to FILE through the "
+        "shared flock-guarded measured-defaults store, provenance-"
+        "stamped (also via DEPPY_TPU_ROUTE_REGISTRY; default: "
+        "in-memory only)",
+    )
 
     p_route = sub.add_parser(
         "route",
@@ -595,6 +621,38 @@ def _build_parser() -> argparse.ArgumentParser:
         help="output format (default: text)",
     )
 
+    p_routes = sub.add_parser(
+        "routes",
+        help="reconstruct the route-health table from a telemetry "
+        "JSONL sink alone (ISSUE 19): per-size-class races, win "
+        "shares, regret charged to the frozen default backend "
+        "(censored-aware), staleness verdicts, shadow-probe counts, "
+        "and live-learned row adoptions — the offline twin of the "
+        "deppy_route_* metric families (see docs/observability.md, "
+        "Route health)",
+    )
+    p_routes.add_argument(
+        "file", nargs="?", default=None,
+        help="telemetry JSONL file (default: $DEPPY_TPU_TELEMETRY_FILE)",
+    )
+    p_routes.add_argument(
+        "--file", action="append", default=None, dest="files",
+        metavar="FILE",
+        help="additional telemetry JSONL file(s) to merge (repeatable): "
+        "per-replica sinks reconstruct as one fleet route-health view, "
+        "dump copies deduped",
+    )
+    p_routes.add_argument(
+        "--registry", default=None, metavar="FILE",
+        help="measured-defaults registry JSON to join provenance from "
+        "(default: $DEPPY_TPU_MEASURED_DEFAULTS, else the package-"
+        "local registry)",
+    )
+    p_routes.add_argument(
+        "--output", choices=["text", "json"], default="text",
+        help="output format (default: text)",
+    )
+
     p_trace = sub.add_parser(
         "trace",
         help="reconstruct one request's span tree from a telemetry "
@@ -800,6 +858,9 @@ _CONFIG_KEYS = {
     "optMaxIterations": ("opt_max_iterations", int),
     "optIterBudget": ("opt_iter_budget", int),
     "optMaxWeight": ("opt_max_weight", int),
+    "routeLearn": ("route_learn", str),
+    "routeShadowRate": ("route_shadow_rate", float),
+    "routeRegistry": ("route_registry", str),
 }
 
 
@@ -1654,6 +1715,44 @@ def _cmd_profile(args) -> int:
     return 0
 
 
+def _cmd_routes(args) -> int:
+    """Reconstruct the route-health table (ISSUE 19) from the JSONL
+    sink alone: the same :class:`RegretLedger` the live plane drives,
+    replayed offline over ``race``/``route``/``route_stale``/
+    ``route_learned`` events, joined with the measured-defaults
+    registry's provenance stamps.  Repeated ``--file`` merges replica
+    sinks into one fleet view."""
+    from .engine import defaults_store
+    from .routes import report as routes_report
+
+    paths = _sink_paths(args)
+    if not paths:
+        print("error: no telemetry file (pass FILE or set "
+              "DEPPY_TPU_TELEMETRY_FILE)", file=sys.stderr)
+        return 2
+    try:
+        rows_doc = defaults_store.read_rows(args.registry)
+    except OSError:
+        rows_doc = {}
+    try:
+        doc = routes_report.build_report(_iter_paths_events(paths),
+                                         rows_doc=rows_doc)
+    except FileNotFoundError:
+        print(f"error: no such file: {', '.join(paths)}",
+              file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: cannot read {', '.join(paths)}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.output == "json":
+        json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+    print(routes_report.render_text(doc))
+    return 0
+
+
 def _cmd_compiles(args) -> int:
     """Summarize ``compileguard`` events from a telemetry JSONL sink:
     per jit entry, total traces, distinct abstract signatures, retraces
@@ -1792,6 +1891,9 @@ def _cmd_serve(args) -> int:
         "opt_max_iterations": None,
         "opt_iter_budget": None,
         "opt_max_weight": None,
+        "route_learn": None,
+        "route_shadow_rate": None,
+        "route_registry": None,
     }
     try:
         if args.config:
@@ -1830,6 +1932,9 @@ def _cmd_serve(args) -> int:
             ("opt_max_iterations", args.opt_max_iterations),
             ("opt_iter_budget", args.opt_iter_budget),
             ("opt_max_weight", args.opt_max_weight),
+            ("route_learn", args.route_learn),
+            ("route_shadow_rate", args.route_shadow_rate),
+            ("route_registry", args.route_registry),
         ):
             if val is not None:
                 kwargs[key] = val
@@ -1907,6 +2012,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_trace(args)
     if args.command == "profile":
         return _cmd_profile(args)
+    if args.command == "routes":
+        return _cmd_routes(args)
     if args.command == "top":
         return _cmd_top(args)
     if args.command == "compiles":

@@ -367,6 +367,37 @@ _DECLARATIONS: List[EnvVar] = [
     _v("DEPPY_TPU_OBS_DRIFT_MIN", "int", 8, "deppy_tpu.obs.drift",
        "Minimum sampled device dispatches per size class before the "
        "drift watchdog trusts its regression enough to compare."),
+    # --- route health ----------------------------------------------------
+    _v("DEPPY_TPU_ROUTE_LEARN", "str", "off", "deppy_tpu.routes",
+       "Route-health plane: 'off' (default) arms nothing — no regret "
+       "ledger, no route_* metric families, responses byte-identical; "
+       "'observe' runs the regret ledger, staleness watcher, and "
+       "shadow probing; 'on' adds the online route registry that "
+       "adopts learned portfolio rows onto the in-memory overlay "
+       "(also --route-learn).  Audit with `deppy routes`.",
+       flag="--route-learn", config_key="routeLearn"),
+    _v("DEPPY_TPU_ROUTE_SHADOW_RATE", "float", 0.0625,
+       "deppy_tpu.routes.shadow",
+       "Fraction of a STALE-flagged class's flushes duplicated to one "
+       "non-serving backend at idle priority (deterministic 1-in-N "
+       "per class; 0 disables probing; also --route-shadow-rate).",
+       flag="--route-shadow-rate", config_key="routeShadowRate"),
+    _v("DEPPY_TPU_ROUTE_MAX_AGE_S", "float", 604800.0,
+       "deppy_tpu.routes.staleness",
+       "Measured-defaults provenance age past which a live-observed "
+       "class's routing row is flagged stale (default 7 days)."),
+    _v("DEPPY_TPU_ROUTE_MIN_SAMPLES", "int", 8, "deppy_tpu.routes.learn",
+       "Uncensored live observations per (class, backend) before the "
+       "online route registry trusts its decayed estimate enough to "
+       "re-rank."),
+    _v("DEPPY_TPU_ROUTE_DECAY", "float", 0.2, "deppy_tpu.routes.ledger",
+       "EWMA weight of the newest observation in the regret ledger's "
+       "per-(class, backend) wall estimates, in (0, 1]."),
+    _v("DEPPY_TPU_ROUTE_REGISTRY", "path", None, "deppy_tpu.routes.learn",
+       "Optional path where live-learned routing rows persist through "
+       "the shared flock-guarded defaults store (also "
+       "--route-registry); unset keeps adoptions in-memory only.",
+       flag="--route-registry", config_key="routeRegistry"),
     # --- service ---------------------------------------------------------
     _v("DEPPY_TPU_REQUEST_DEADLINE_S", "float", None, "deppy_tpu.service",
        "Default wall-clock budget per /v1/resolve request (clients "

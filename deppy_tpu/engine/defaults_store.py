@@ -1,0 +1,115 @@
+"""Shared flock-guarded store for the measured-defaults registry
+(ISSUE 19 satellite).
+
+The read-merge-write of ``measured_defaults.json`` rows grew ad hoc in
+two places — ``scripts/tpu_revalidate.py`` (flock + atomic replace +
+per-key evidence stamps) and ``scripts/tpu_ab.py`` (a plain unlocked
+load/dump that could torn-write against a concurrent ladder) — and
+ISSUE 19's online route registry adds a third writer that runs *inside
+a serving process*.  This module is the one merge path all three use:
+
+  * the whole read-merge-write runs under an ``flock`` on a sibling
+    ``.lock`` file, so concurrent writers (two heal windows, a CPU
+    smoke ladder racing a device ladder, a serving replica persisting
+    a learned row mid-ladder) compose instead of dropping each other's
+    rows;
+  * the write is atomic (``.tmp`` + ``os.replace``) so a reader never
+    sees a half-written registry;
+  * every written key gets a **provenance stamp** nested per key under
+    the backend's ``evidence`` map — ``ts`` (epoch seconds), ``box``
+    (hostname), plus whatever the caller measured (``platform``,
+    ``samples``, ``ladder_log``, ``source``) — the trail the ISSUE 19
+    staleness watcher reads to decide whether a row is stale, missing,
+    or foreign.
+
+Rows measured by a *later* run that touches only one key keep their
+siblings' provenance untouched (the tpu_revalidate contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from typing import Dict, Optional
+
+
+def registry_path(path: Optional[str] = None) -> str:
+    """The measured-defaults registry path: explicit argument, the
+    ``DEPPY_TPU_MEASURED_DEFAULTS`` override, else the package-local
+    file an installed wheel ships."""
+    if path:
+        return path
+    return os.environ.get(
+        "DEPPY_TPU_MEASURED_DEFAULTS",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "measured_defaults.json"))
+
+
+def read_rows(path: Optional[str] = None) -> dict:
+    """The whole registry document ({} when absent/corrupt — a missing
+    registry is the normal cold state, never an error)."""
+    try:
+        with open(registry_path(path)) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return data if isinstance(data, dict) else {}
+
+
+def provenance(backend: str, key: str,
+               path: Optional[str] = None,
+               doc: Optional[dict] = None) -> Optional[dict]:
+    """The evidence stamp recorded for one ``backend``/``key`` row
+    (None when the row was never measured, or predates evidence)."""
+    if doc is None:
+        doc = read_rows(path)
+    entry = doc.get(backend)
+    if not isinstance(entry, dict):
+        return None
+    ev = entry.get("evidence")
+    if not isinstance(ev, dict):
+        return None
+    stamp = ev.get(key)
+    return stamp if isinstance(stamp, dict) else None
+
+
+def merge_rows(backend: str, updates: Dict[str, object],
+               evidence: Optional[dict] = None,
+               path: Optional[str] = None) -> str:
+    """Merge ``updates`` into ``backend``'s rows under the registry
+    flock; other backends' rows and this backend's other keys survive.
+    Each updated key's evidence stamp is replaced with the caller's
+    ``evidence`` fields plus ``ts`` and ``box`` — provenance belongs to
+    the run that measured the row, so unmeasured siblings keep theirs.
+    Returns the path written."""
+    import fcntl
+
+    target = registry_path(path)
+    stamp = dict(evidence or {})
+    stamp.setdefault("ts", round(time.time(), 1))
+    stamp.setdefault("box", socket.gethostname())
+    with open(target + ".lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            data = read_rows(target)
+            entry = data.get(backend)
+            if not isinstance(entry, dict):
+                entry = {}
+            entry.update(updates)
+            ev = entry.get("evidence")
+            if not isinstance(ev, dict):
+                ev = {}
+            for key in updates:
+                ev[key] = dict(stamp)
+            entry["evidence"] = ev
+            data[backend] = entry
+            tmp = target + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=2, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, target)
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+    return target

@@ -120,16 +120,49 @@ def estimate_us(name: str, class_name: str) -> float:
                             max(spec.cost_us.values()))
 
 
+# ISSUE 19: learned-route overlay — in-memory ranking rows adopted by
+# the online route registry (:mod:`deppy_tpu.routes.learn`), consulted
+# ahead of the measured-defaults file so a serving replica can adopt a
+# live-learned row without mutating the package-local registry
+# mid-serve.  A learned row can only reorder WHICH definitive backends
+# race — the racer's first-definitive-winner rule and sampled
+# cross-check still gate every answer, so adoption changes speed,
+# never answers.  Empty (the default, and always under
+# DEPPY_TPU_ROUTE_LEARN=off) leaves ranked() byte-identical.
+_ROUTE_OVERLAY: Dict[str, str] = {}
+
+
+def set_route_overlay(rows: Optional[Dict[str, str]]) -> None:
+    """Replace the learned-route overlay: ``{key: comma-separated
+    row}`` under the same keys :func:`ranked` reads
+    (``portfolio.<class>`` / ``portfolio``).  None or {} clears it."""
+    global _ROUTE_OVERLAY
+    _ROUTE_OVERLAY = dict(rows or {})
+
+
+def update_route_overlay(rows: Dict[str, str]) -> None:
+    """Merge rows into the learned-route overlay (atomic swap — the
+    racer may be reading concurrently)."""
+    global _ROUTE_OVERLAY
+    _ROUTE_OVERLAY = {**_ROUTE_OVERLAY, **rows}
+
+
+def route_overlay() -> Dict[str, str]:
+    return dict(_ROUTE_OVERLAY)
+
+
 def ranked(class_name: str) -> Tuple[List[str], bool]:
     """Candidate backend names for a size class, best first, plus
     whether the order came from a MEASURED ``portfolio`` row (the
     ``auto`` racing mode engages only then).  Rows are comma-separated
     backend names under the measured-defaults keys
-    ``portfolio.<class>`` (per class) or ``portfolio`` (global)."""
+    ``portfolio.<class>`` (per class) or ``portfolio`` (global); a
+    live-learned overlay row (ISSUE 19) takes precedence and counts as
+    measured — it IS a measurement, just a fresher one."""
     from . import core
 
     for key in (f"portfolio.{class_name}", "portfolio"):
-        row = core.measured_default(key)
+        row = _ROUTE_OVERLAY.get(key) or core.measured_default(key)
         if row:
             names = [n.strip() for n in row.split(",")
                      if n.strip() in _SPECS]

@@ -522,6 +522,51 @@ class Router:
                 dumped[address] = 0
         return {"dumped": dumped, "errors": errors}
 
+    def gossip_routes(self, doc) -> int:
+        """Fleet-wide route gossip (ISSUE 19): scan one ingested
+        telemetry batch for live-learned routing rows and fan them out
+        to every live replica's ``POST /v1/routes/learned``.  Only
+        first-hand (``source == "live"``) adoptions re-broadcast —
+        gossip-sourced ones stay put, and the learner's idempotent
+        adopt terminates the echo at the origin — so a row crosses the
+        fleet exactly once per discovery.  Returns replicas that
+        accepted."""
+        if not isinstance(doc, dict):
+            return 0
+        rows: Dict[str, str] = {}
+        origin = doc.get("replica")
+        for ev in doc.get("events") or []:
+            if not isinstance(ev, dict) \
+                    or ev.get("kind") != "route_learned" \
+                    or ev.get("source") != "live":
+                continue
+            key, row = ev.get("key"), ev.get("row")
+            if isinstance(key, str) and isinstance(row, str):
+                rows[key] = row
+        if not rows:
+            return 0
+        body = json.dumps({
+            "rows": rows,
+            "origin": origin if isinstance(origin, str) else None,
+        }).encode("utf-8")
+        accepted = 0
+        for address in self.live_replicas():
+            try:
+                status, _, _ = self.forward(
+                    address, "POST", "/v1/routes/learned", body,
+                    {"Content-Type": "application/json"},
+                    timeout=PROBE_TIMEOUT_S * 5)
+            except OSError:
+                continue
+            if status == 200:
+                accepted += 1
+        if accepted:
+            self.registry.counter(
+                "deppy_fleet_route_gossip_total",
+                "Learned routing-row broadcasts accepted by fleet "
+                "replicas.").inc(accepted)
+        return accepted
+
     # ------------------------------------------------------------- drain
 
     def drain(self, address: str) -> dict:
@@ -815,6 +860,14 @@ def _router_handler(router: Router):
             if err is not None:
                 self._send_json(400, {"error": err})
                 return
+            if accepted:
+                # Route gossip (ISSUE 19) rides the same push: any
+                # live-learned routing rows in this batch fan out to
+                # the fleet off-thread — a replica's streamer flush
+                # must never block on N peer round-trips.
+                threading.Thread(
+                    target=router.gossip_routes, args=(doc,),
+                    name="route-gossip", daemon=True).start()
             self._send_json(200, {"accepted": accepted})
 
         def _resolve(self):

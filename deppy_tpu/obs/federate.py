@@ -73,6 +73,7 @@ def _by_label(samples, family: str, label: str) -> Dict[str, float]:
 def fleet_rollups(scrapes: List[Tuple[str, str]]) -> dict:
     """Fleet-level aggregates from ``[(replica, exposition_text)]``."""
     warm_hits = warm_asks = queue_depth = 0.0
+    route_regret = route_stale = route_shadow = route_learned = 0.0
     burn_num: Dict[str, float] = {}
     burn_den: Dict[str, float] = {}
     wins: Dict[str, float] = {}
@@ -84,12 +85,21 @@ def fleet_rollups(scrapes: List[Tuple[str, str]]) -> dict:
         asks = _sum(samples, "deppy_cache_hits_total") \
             + _sum(samples, "deppy_cache_misses_total")
         depth = _sum(samples, "deppy_sched_queue_depth")
+        regret = _sum(samples, "deppy_route_regret_seconds_total")
+        stale = _sum(samples, "deppy_route_stale_classes")
         warm_hits += hits
         warm_asks += asks
         queue_depth += depth
+        route_regret += regret
+        route_stale += stale
+        route_shadow += _sum(samples,
+                             "deppy_route_shadow_dispatches_total")
+        route_learned += _sum(samples, "deppy_route_learned_rows")
         per_replica[replica] = {
             "warm_hit_ratio": (round(hits / asks, 6) if asks else None),
             "queue_depth": depth,
+            "route_regret_s": round(regret, 6),
+            "route_stale_classes": stale,
         }
         burn = _by_label(samples, "deppy_tenant_burn_rate", "tenant")
         reqs = _by_label(samples, "deppy_tenant_requests_total",
@@ -115,6 +125,10 @@ def fleet_rollups(scrapes: List[Tuple[str, str]]) -> dict:
         "race_win_share": {
             b: round(wins[b] / total_wins, 6)
             for b in sorted(wins)} if total_wins else {},
+        "route_regret_s": round(route_regret, 6),
+        "route_stale_classes": route_stale,
+        "route_shadow_dispatches": route_shadow,
+        "route_learned_rows": route_learned,
         "per_replica": per_replica,
     }
 
@@ -157,6 +171,31 @@ def render_rollup_lines(rollups: dict) -> List[str]:
             lines.append(
                 f'deppy_fleet_race_win_share{{backend="{backend}"}} '
                 f"{share[backend]}")
+    # Route health (ISSUE 19): fleet totals render only once some
+    # replica exposes the families — a learn=off fleet's scrape stays
+    # byte-identical to pre-plane.
+    if (rollups.get("route_regret_s") or rollups.get("route_stale_classes")
+            or rollups.get("route_shadow_dispatches")
+            or rollups.get("route_learned_rows")):
+        lines += [
+            "# HELP deppy_fleet_route_regret_seconds Wall-clock seconds "
+            "frozen routing defaults burned beyond observed race "
+            "winners, summed over live replicas.",
+            "# TYPE deppy_fleet_route_regret_seconds gauge",
+            f"deppy_fleet_route_regret_seconds "
+            f"{rollups.get('route_regret_s', 0.0)}",
+            "# HELP deppy_fleet_route_stale_classes Live size classes "
+            "with stale/missing routing rows, summed over live "
+            "replicas.",
+            "# TYPE deppy_fleet_route_stale_classes gauge",
+            f"deppy_fleet_route_stale_classes "
+            f"{_fmt_num(rollups.get('route_stale_classes', 0))}",
+            "# HELP deppy_fleet_route_learned_rows Live-learned routing "
+            "rows adopted across live replicas.",
+            "# TYPE deppy_fleet_route_learned_rows gauge",
+            f"deppy_fleet_route_learned_rows "
+            f"{_fmt_num(rollups.get('route_learned_rows', 0))}",
+        ]
     return lines
 
 

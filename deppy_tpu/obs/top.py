@@ -89,6 +89,10 @@ def snapshot(router: str, status: dict, metrics_text: str) -> dict:
             .get(addr),
             "drift_ratio": _per_replica(
                 "deppy_costmodel_drift_ratio", agg="max").get(addr),
+            "regret_s": _per_replica(
+                "deppy_route_regret_seconds_total").get(addr),
+            "stale_classes": _per_replica(
+                "deppy_route_stale_classes").get(addr),
             "events": ingest.get(addr),
         })
     return {
@@ -99,6 +103,9 @@ def snapshot(router: str, status: dict, metrics_text: str) -> dict:
             "warm_hit_ratio": _fleet("deppy_fleet_warm_hit_ratio"),
             "queue_depth": _fleet("deppy_fleet_queue_depth"),
             "tenant_burn_rate": burn,
+            "route_regret_s": _fleet("deppy_fleet_route_regret_seconds"),
+            "route_stale_classes":
+                _fleet("deppy_fleet_route_stale_classes"),
         },
     }
 
@@ -116,10 +123,12 @@ def render_text(snap: dict) -> str:
         f"policy={snap.get('policy', '?')}   "
         f"{live}/{len(rows)} live   "
         f"warm={_num(fleet.get('warm_hit_ratio'))}   "
-        f"queue={_num(fleet.get('queue_depth'), '{:.0f}')}",
+        f"queue={_num(fleet.get('queue_depth'), '{:.0f}')}   "
+        f"regret={_num(fleet.get('route_regret_s'))}s   "
+        f"stale={_num(fleet.get('route_stale_classes'), '{:.0f}')}",
         "",
         f"  {'REPLICA':<22}  {'STATE':<8}  {'WARM':>6}  {'QUEUE':>6}  "
-        f"{'DRIFT':>6}  {'EVENTS':>8}",
+        f"{'DRIFT':>6}  {'REGRET':>7}  {'STALE':>5}  {'EVENTS':>8}",
     ]
     for r in rows:
         lines.append(
@@ -127,6 +136,8 @@ def render_text(snap: dict) -> str:
             f"{_num(r['warm_hit_ratio']):>6}  "
             f"{_num(r['queue_depth'], '{:.0f}'):>6}  "
             f"{_num(r['drift_ratio'], '{:.2f}'):>6}  "
+            f"{_num(r.get('regret_s'), '{:.2f}'):>7}  "
+            f"{_num(r.get('stale_classes'), '{:.0f}'):>5}  "
             f"{_num(r['events'], '{:.0f}'):>8}")
     burn = fleet.get("tenant_burn_rate") or {}
     if burn:

@@ -74,6 +74,15 @@ def summarize(path) -> dict:
             round(sum(margins) / len(margins), 6) if margins else None)
         agg["win_margin_s_min"] = (round(min(margins), 6)
                                    if margins else None)
+        # Censored-aware per-backend speed (ISSUE 19): only walls from
+        # entrants that actually finished feed the estimate — a
+        # cancelled loser's partial wall measures when the cancel
+        # landed, not how fast the backend solves.
+        lane_us = agg.pop("_lane_us")
+        agg["backend_us_per_lane"] = {
+            b: {"us_per_lane": round(sum(vals) / len(vals), 2),
+                "samples": len(vals)}
+            for b, vals in sorted(lane_us.items())}
     for agg in optimize.values():
         agg["probe_s"] = round(agg["probe_s"], 6)
         agg["improvement_mean"] = (
@@ -95,7 +104,8 @@ def _take_race(races: Dict[str, dict], ev: dict) -> None:
     agg = races.setdefault(key, {
         "races": 0, "starts": {}, "wins": {}, "cancels": {},
         "resubmitted": 0, "no_winner": 0, "checked": 0,
-        "check_mismatches": 0, "_margins": [],
+        "check_mismatches": 0, "censored": {}, "_margins": [],
+        "_lane_us": {},
     })
     if ev.get("resubmitted") is not None:
         agg["resubmitted"] += int(ev.get("resubmitted") or 0)
@@ -117,6 +127,22 @@ def _take_race(races: Dict[str, dict], ev: dict) -> None:
     m = ev.get("win_margin_s")
     if isinstance(m, (int, float)):
         agg["_margins"].append(float(m))
+    lanes = max(int(ev.get("lanes") or 1), 1)
+    wall = ev.get("wall_s")
+    if winner is not None and isinstance(wall, (int, float)):
+        agg["_lane_us"].setdefault(str(winner), []).append(
+            1e6 * float(wall) / lanes)
+    for loser in ev.get("losers") or []:
+        if not isinstance(loser, dict):
+            continue
+        b = loser.get("backend")
+        lw = loser.get("wall_s")
+        if not isinstance(b, str):
+            continue
+        if loser.get("censored") or not isinstance(lw, (int, float)):
+            agg["censored"][b] = agg["censored"].get(b, 0) + 1
+            continue
+        agg["_lane_us"].setdefault(b, []).append(1e6 * float(lw) / lanes)
 
 
 def _take_optimize(optimize: Dict[str, dict], ev: dict) -> None:
@@ -277,6 +303,22 @@ def render_text(summary: dict, path: str) -> str:
             lines.append(
                 f"  {key:>10}  {a['races']:>5}  {wins:<28}  "
                 f"{cancels:<24}  {margin:>8}  {a['resubmitted']:>5}")
+            # Censored-aware backend speed (ISSUE 19): µs/lane from
+            # FINISHED entrants only, with the censored (cancelled)
+            # observation count alongside so a backend that always
+            # loses by cancellation reads "unmeasured", not "fast".
+            speed = a.get("backend_us_per_lane") or {}
+            if speed:
+                cells = []
+                for b in sorted(set(speed) | set(a.get("censored") or {})):
+                    row = speed.get(b)
+                    cen = (a.get("censored") or {}).get(b, 0)
+                    cell = (f"{b}={row['us_per_lane']:.0f}us/{row['samples']}"
+                            if row else f"{b}=?")
+                    if cen:
+                        cell += f" (cens {cen})"
+                    cells.append(cell)
+                lines.append(f"  {'':>10}  speed: " + "  ".join(cells))
             if a.get("check_mismatches"):
                 lines.append(
                     f"  {'':>10}  !! {a['check_mismatches']} sampled "

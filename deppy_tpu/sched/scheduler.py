@@ -165,7 +165,7 @@ class _Group:
 
     __slots__ = ("lanes", "enq_t", "size_class", "budget", "event",
                  "error", "report", "parent", "timing", "speculative",
-                 "tenant", "priority")
+                 "tenant", "priority", "shadow_backend", "shadow_class")
 
     def __init__(self, lanes: List[_Lane], size_class: int, budget: int,
                  speculative: bool = False, priority: int = 1):
@@ -188,6 +188,11 @@ class _Group:
         # group, not per lane.
         self.tenant = lanes[0].tenant if lanes else "default"
         self.priority = priority
+        # ISSUE 19: a shadow route probe — the group re-solves an
+        # already-answered flush via ONE named backend on the idle
+        # queue; its results feed the route ledger, never a response.
+        self.shadow_backend: Optional[str] = None
+        self.shadow_class: Optional[str] = None
 
 
 def _count_lane_outcome(rep, r) -> None:
@@ -452,7 +457,7 @@ class PortfolioRacer:
                 telemetry.default_registry().event(
                     "race", size_class_name=plan.class_name,
                     entrants=list(plan.names), lanes=len(live),
-                    winner=None)
+                    default=plan.names[0], winner=None)
                 return False
 
             noncanonical_win = winner[0] != plan.canonical
@@ -501,10 +506,35 @@ class PortfolioRacer:
 
             wname, wdt, wout, _, wsrep = winner
             with cv:
+                # ISSUE 19 satellite: a cancelled loser can surface as
+                # a PARTIAL completion — err None but a None lane (a
+                # grad descent cancelled mid-certification) — whose
+                # wall clock measures when the cancel landed, not how
+                # fast the backend solves.  Such entrants are CENSORED:
+                # recorded as losers so the regret ledger can count
+                # cancels distinctly, but excluded from win-margin
+                # stats and per-backend wall estimates.
+                losers = []
+                for e in finished:
+                    if e[0] == wname:
+                        continue
+                    censored = (e[3] is not None or e[2] is None
+                                or any(r is None for r in e[2]))
+                    losers.append({"backend": e[0],
+                                   "wall_s": round(e[1], 6),
+                                   "censored": bool(censored)})
+                done = {e[0] for e in finished}
                 margins = [e[1] - wdt for e in finished
                            if e[0] != wname and e[3] is None
-                           and e[2] is not None]
+                           and e[2] is not None
+                           and all(r is not None for r in e[2])]
                 clean_done = {e[0] for e in finished if e[3] is None}
+            for name in plan.names:
+                if name != wname and name not in done:
+                    # Still running at event time (abandoned in the
+                    # background): censored, no usable wall clock.
+                    losers.append({"backend": name, "wall_s": None,
+                                   "censored": True})
             for name in plan.names:
                 if name != wname and name not in clean_done:
                     reg.counter(
@@ -525,10 +555,12 @@ class PortfolioRacer:
             sp.set(winner=wname)
             telemetry.default_registry().event(
                 "race", size_class_name=plan.class_name, winner=wname,
-                canonical=plan.canonical, entrants=list(plan.names),
+                canonical=plan.canonical, default=plan.names[0],
+                entrants=list(plan.names),
                 lanes=len(live),
                 cancelled=[n for n in plan.names
                            if n != wname and n not in clean_done],
+                losers=losers,
                 win_margin_s=(round(margin, 6)
                               if margin is not None else None),
                 checked=checked, wall_s=round(wdt, 6))
@@ -650,6 +682,11 @@ class Scheduler:
             self._racer = PortfolioRacer(
                 "on" if mode in ("on", "1", "true", "yes") else "auto",
                 portfolio_k, portfolio_sample_check, self._registry)
+        # Route-health plane (ISSUE 19): installed by
+        # deppy_tpu.routes.start_plane.  None (the default) leaves the
+        # dispatch path byte-identical — no flush observation, no
+        # shadow groups, no route events.
+        self._route_plane = None
         # Weighted-fair per-tenant admission + priority lanes (ISSUE
         # 15).  "off" restores the global-depth-only gate and strict
         # FIFO flush head byte for byte; "on" (the default) is ALSO
@@ -1219,6 +1256,89 @@ class Scheduler:
             stats["deadline_misses"] = deadline_misses
         return [lane.result for lane in lanes]
 
+    # ---------------------------------------------- route plane (ISSUE 19)
+
+    def set_route_plane(self, plane) -> None:
+        """Install (or, with None, remove) the route-health plane.  The
+        plane observes every cold live flush after its answers are
+        served and may enqueue shadow route probes via
+        :meth:`submit_shadow`."""
+        self._route_plane = plane
+
+    def submit_shadow(self, backend_name: str, class_name: str,
+                      problems: Sequence[Problem],
+                      max_steps: Optional[int] = None) -> bool:
+        """Queue one shadow route probe (ISSUE 19) at IDLE priority:
+        re-solve an already-coalesced flush's problems via ONE named
+        backend, timing it for the route ledger.  Rides the speculative
+        queue, so live traffic preempts every shadow dispatch at the
+        flush boundary; results are emitted as a ``route`` sink event
+        and NEVER touch a lane result, the cache, or the warm index.
+        Returns False when dropped (loop not running, or the idle
+        backlog is full — a shadow probe is pure opportunism)."""
+        from ..engine.driver import _budget
+
+        if max_steps is None:
+            max_steps = self.max_steps
+        budget = int(_budget(max_steps))
+        lanes = [_Lane(p, "", max_steps, budget, None, tenant="shadow")
+                 for p in problems]
+        # The size class carries a shadow-only sentinel so the idle
+        # drain's coalescing can never mix a shadow probe into an
+        # optimize/pre-solve flush (those dispatch through the normal
+        # solve path; shadow groups do not).
+        group = _Group(lanes, f"shadow:{class_name}:{backend_name}",
+                       budget, speculative=True)
+        group.shadow_backend = backend_name
+        group.shadow_class = class_name
+        cap = getattr(self, "spec_max_backlog",
+                      DEFAULT_SPECULATE_MAX_BACKLOG)
+        with self._cv:
+            if (not self.running
+                    or self._spec_depth + len(lanes) > cap):
+                return False
+            self._spec_queue.append(group)
+            self._spec_depth += len(lanes)
+            if self._g_spec_depth is not None:
+                self._g_spec_depth.set(self._spec_depth)
+            self._cv.notify_all()
+        return True
+
+    def _dispatch_shadow(self, groups: List[_Group]) -> None:
+        """Drain shadow route probes: one timed ``solve_via`` dispatch
+        per group, answers discarded, wall clock + definitiveness
+        emitted as a ``route`` event for the ledger/learner.  Failures
+        are counted on the sink — a shadow probe must never take down
+        the dispatch loop."""
+        from ..engine import registry as engine_registry
+
+        for g in groups:
+            problems = [lane.problem for lane in g.lanes]
+            name = g.shadow_backend
+            out = None
+            err = None
+            t1 = time.perf_counter()
+            try:
+                faults.inject(f"sched.shadow.{name}")
+                mesh = (self._resolve_mesh() if name == "device"
+                        else None)
+                out = engine_registry.solve_via(
+                    name, problems, max_steps=g.lanes[0].max_steps,
+                    mesh=mesh)
+            except BaseException as e:  # noqa: BLE001 — probe-local
+                err = type(e).__name__
+            finally:
+                wall = time.perf_counter() - t1
+                ok = (err is None and out is not None
+                      and all(r is not None and not r.degraded
+                              for r in out))
+                telemetry.default_registry().event(
+                    "route", phase="shadow",
+                    size_class_name=g.shadow_class, backend=name,
+                    lanes=len(g.lanes), wall_s=round(wall, 6),
+                    ok=bool(ok), error=err)
+                g.event.set()
+
     def _enqueue(self, group: _Group) -> None:
         with self._cv:
             if self.running:
@@ -1401,6 +1521,12 @@ class Scheduler:
         return take, reason
 
     def _dispatch(self, groups: List[_Group], reason: str) -> None:
+        if groups and groups[0].shadow_backend is not None:
+            # Shadow route probes (ISSUE 19) never coalesce with real
+            # groups (their size-class sentinel is shadow-only), so a
+            # drained set is homogeneous.
+            self._dispatch_shadow(groups)
+            return
         lanes = [lane for g in groups for lane in g.lanes]
         t0 = time.monotonic()
         report = None
@@ -1539,6 +1665,7 @@ class Scheduler:
             self._kick_reprobe()
         rep, owns = telemetry.begin_report(backend=backend,
                                            n_problems=len(live))
+        cold_flush = False
         try:
             with faults.deadline_scope(scope):
                 if all(lane.warm is not None for lane in live):
@@ -1549,6 +1676,7 @@ class Scheduler:
                     self._solve_incremental(live, rep, timing, backend)
                     timing["solve_s"] = time.perf_counter() - t1
                     return rep
+                cold_flush = True
                 # Portfolio racing (ISSUE 13): cold flushes only.  A
                 # None plan (racing off / auto with no measured row /
                 # <2 candidates) leaves the canonical single-backend
@@ -1580,6 +1708,19 @@ class Scheduler:
                         finisher(rep)
         finally:
             telemetry.end_report(rep, owns)
+        if (self._route_plane is not None and cold_flush and live
+                and any(lane.tenant not in ("speculate", "shadow")
+                        for lane in live)):
+            # ISSUE 19: the route plane observes the flush after its
+            # answers are computed — O(1) bookkeeping plus at most one
+            # idle-queue enqueue; the shadow solve itself runs later,
+            # only while the live queue is empty.  Observability must
+            # never fail serving.
+            try:
+                self._route_plane.observe_flush(self, live)
+            # deppy: lint-ok[exception-hygiene] route-health bookkeeping must never fail a flush that already has answers
+            except Exception:
+                pass
         return rep
 
     def _solve_device(self, live: List[_Lane], timing: dict) -> None:

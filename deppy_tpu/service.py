@@ -225,9 +225,10 @@ class Metrics:
         # counters + cost-model drift gauges, process-global like the
         # profiler's.  Disarmed (no --obs-stream / --obs-baseline) this
         # appends nothing — scrapes stay byte-identical.
-        from . import obs
+        from . import obs, routes
 
         lines += obs.render_metric_lines()
+        lines += routes.render_metric_lines()
         return "\n".join(lines) + "\n"
 
 
@@ -269,6 +270,9 @@ class Server:
         opt_max_iterations: Optional[int] = None,
         opt_iter_budget: Optional[int] = None,
         opt_max_weight: Optional[int] = None,
+        route_learn: Optional[str] = None,
+        route_shadow_rate: Optional[float] = None,
+        route_registry: Optional[str] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -387,6 +391,22 @@ class Server:
                 max_iterations=opt_max_iterations,
                 iter_budget=opt_iter_budget,
                 max_weight=opt_max_weight)
+        # Route-health plane (ISSUE 19): regret ledger + staleness
+        # watcher + shadow sampler (+ online route registry when
+        # --route-learn=on).  Exists only when the scheduler does —
+        # every event it folds comes off the scheduler's racer.  "off"
+        # (the default) constructs nothing: no forwarder, no route_*
+        # metric families, POST /v1/routes/learned 404s, and responses
+        # stay byte-identical to pre-plane.
+        self.route_plane = None
+        if self.scheduler is not None:
+            from . import routes
+
+            self.route_plane = routes.start_plane(
+                self.scheduler, mode=route_learn,
+                shadow_rate=route_shadow_rate,
+                registry_path=route_registry,
+                replica=self.replica)
         # Fault-domain knobs (ISSUE 2).  request_deadline_s: default
         # wall-clock budget per /v1/resolve (clients override per request
         # via the X-Deppy-Deadline-S header; None = unbounded).  drain_s
@@ -790,6 +810,14 @@ class Server:
             # flips to ready on its next tick, shrinking the failover
             # window from lease-expiry to renew-interval.
             self.elector.stop(release=True)
+        if self.route_plane is not None:
+            # Detach the route plane's forwarder and clear its learned
+            # overlay so embedded servers in tests don't leak adopted
+            # rows across instances.
+            from . import routes
+
+            routes.stop_plane()
+            self.route_plane = None
         if self._obs_armed:
             # Detach the streamer/watchdog forwarders this Server armed
             # (final flush included) so embedded servers in tests don't
@@ -987,6 +1015,33 @@ def _api_handler(server: Server):
                         self._preview_request(spec)
                 finally:
                     server._exit_request()
+                return
+            if self.path == "/v1/routes/learned":
+                # Route-gossip ingress (ISSUE 19): a peer replica's
+                # live-learned routing rows, fanned out by the router.
+                # Adoption changes which backends race, never answers;
+                # without an armed learning plane this 404s exactly
+                # like any unknown path.
+                plane = server.route_plane
+                if plane is None or plane.learner is None:
+                    self._send_json(404, {"error": "not found"})
+                    return
+                doc, err = self._read_json_body()
+                if err is not None:
+                    return
+                rows = doc.get("rows") if isinstance(doc, dict) else None
+                if not isinstance(rows, dict):
+                    server.metrics.observe_error()
+                    self._send_json(
+                        400, {"error": "body must be "
+                              '{"rows": {"portfolio.<class>": "a,b"}}'})
+                    return
+                origin = doc.get("origin")
+                applied = plane.learner.adopt(
+                    {str(k): v for k, v in rows.items()},
+                    source="gossip",
+                    origin=origin if isinstance(origin, str) else None)
+                self._send_json(200, {"applied": applied})
                 return
             if self.path == "/v1/optimize":
                 # Optimization tier (ISSUE 18).  With the tier off this
@@ -1362,6 +1417,9 @@ def serve(
     opt_max_iterations: Optional[int] = None,
     opt_iter_budget: Optional[int] = None,
     opt_max_weight: Optional[int] = None,
+    route_learn: Optional[str] = None,
+    route_shadow_rate: Optional[float] = None,
+    route_registry: Optional[str] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -1387,7 +1445,10 @@ def serve(
                  fleet_advertise=fleet_advertise, opt=opt,
                  opt_max_iterations=opt_max_iterations,
                  opt_iter_budget=opt_iter_budget,
-                 opt_max_weight=opt_max_weight)
+                 opt_max_weight=opt_max_weight,
+                 route_learn=route_learn,
+                 route_shadow_rate=route_shadow_rate,
+                 route_registry=route_registry)
     srv.start()
     stop = threading.Event()
 

@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Route-health plane smoke (`make routes-smoke`, ISSUE 19 acceptance).
+
+A live service with a deliberately stale measured-defaults row, end to
+end:
+
+  * **staleness** — the frozen ``portfolio`` row carries an epoch-old
+    provenance stamp, so the first live flushes trip the
+    ``deppy_route_stale_classes`` gauge and emit one ``route_stale``
+    crossing event;
+  * **shadow racing** — the deterministic sampler duplicates flagged
+    flushes to the non-serving candidate at the configured rate, under
+    live load, without failing a single live response
+    (``deppy_route_shadow_dispatches_total`` on /metrics, ``route``
+    events on the sink);
+  * **learning** — the online registry adopts a re-ranked row onto the
+    engine-registry overlay (``deppy_route_learned_rows``, a
+    ``route_learned`` sink event, nonzero frozen-default regret), and
+    the plane's shutdown clears the overlay;
+  * **byte-identity** — every response matches a ``route_learn=off``
+    service serving the identical request list, and the off service
+    registers no ``deppy_route_*`` metric family at all;
+  * **offline reconstruction** — ``deppy routes`` rebuilds the whole
+    table (races, staleness verdict, learned row) from the JSONL sink
+    alone.
+
+The frozen row is self-calibrated: a probe pass times each raceable
+backend on this box and freezes the WORST-first order, so the "frozen
+default is wrong" premise holds wherever the smoke runs.  Fast on
+purpose — the subsystem suite is ``make test-routes``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+from http.client import HTTPConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Point the measured-defaults registry at a scratch file BEFORE any
+# deppy import resolves it, and make adoption quick for the smoke.
+REG = tempfile.mktemp(prefix="routes_smoke_reg_", suffix=".json")
+os.environ["DEPPY_TPU_MEASURED_DEFAULTS"] = REG
+os.environ["DEPPY_TPU_ROUTE_MIN_SAMPLES"] = "2"
+
+N_REQUESTS = 36
+STALE_TS = 1000.0  # 1970 — older than any max-age
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def request(port: int, method: str, path: str, body=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=60)
+    headers = {"Content-Type": "application/json"} if body is not None else {}
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def metric(text: str, name: str):
+    total = None
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            total = (total or 0.0) + float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def scrape(port: int) -> str:
+    _, data = request(port, "GET", "/metrics")
+    return data.decode()
+
+
+def chain_doc(depth: int, tag: str) -> dict:
+    ids = [f"{tag}n{i}" for i in range(depth)]
+    variables = []
+    for i, vid in enumerate(ids):
+        cons = []
+        if i == 0:
+            cons.append({"type": "mandatory"})
+        if i + 1 < depth:
+            cons.append({"type": "dependency", "ids": [ids[i + 1]]})
+        variables.append({"id": vid, "constraints": cons})
+    return {"variables": variables}
+
+
+def probe_order() -> list:
+    """Time each raceable backend on this box (warm pass first, so the
+    device jit compile never pollutes the measurement) and return the
+    backends WORST-first — the deliberately-wrong frozen row."""
+    from deppy_tpu import sat
+    from deppy_tpu.engine import registry as engine_registry
+    from deppy_tpu.sat.encode import encode
+
+    def chain_vars(depth, tag):
+        vs = [sat.variable(f"{tag}n0", sat.mandatory(),
+                           sat.dependency(f"{tag}n1"))]
+        vs += [sat.variable(f"{tag}n{i}", sat.dependency(f"{tag}n{i + 1}"))
+               for i in range(1, depth - 1)]
+        vs.append(sat.variable(f"{tag}n{depth - 1}"))
+        return vs
+
+    probs = [encode(chain_vars(40, f"w{i}")) for i in range(4)]
+    walls = {}
+    for name in ("device", "host", "grad_relax"):
+        engine_registry.solve_via(name, probs)  # warm-up / compile
+        t0 = time.perf_counter()
+        out = engine_registry.solve_via(name, probs)
+        walls[name] = time.perf_counter() - t0
+        if out is None or any(r is None for r in out):
+            fail(f"probe backend {name} could not serve the chain")
+    order = sorted(walls, key=lambda n: -walls[n])
+    print("probe walls (worst-first):",
+          " ".join(f"{n}={walls[n] * 1e3:.1f}ms" for n in order))
+    return order
+
+
+def main() -> int:
+    from deppy_tpu import telemetry
+    from deppy_tpu.engine import defaults_store
+    from deppy_tpu.engine import registry as engine_registry
+    from deppy_tpu.service import Server
+
+    sink = tempfile.mktemp(prefix="routes_smoke_", suffix=".jsonl")
+    telemetry.configure_sink(sink)
+
+    # ---- deliberately-wrong, deliberately-stale frozen row ----------
+    order = probe_order()
+    frozen = ",".join(order)
+    defaults_store.merge_rows(
+        "cpu", {"portfolio": frozen},
+        evidence={"ts": STALE_TS, "platform": "cpu", "samples": 4},
+        path=REG)
+    # The probe pass memoized the (then-empty) registry — reload so the
+    # frozen row actually routes.
+    from deppy_tpu.engine import core as engine_core
+
+    engine_core.reload_measured_defaults()
+    ranked, measured = engine_registry.ranked("s")
+    if not measured or ranked[0] != order[0]:
+        fail(f"frozen row did not take: ranked={ranked}")
+
+    reqs = [chain_doc(34 + i % 12, f"r{i}") for i in range(N_REQUESTS)]
+
+    # ---- learn-off pass: no route families, reference bytes ---------
+    off = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="auto", portfolio="on")
+    off.start()
+    try:
+        off_bodies = []
+        for doc in reqs:
+            status, body = request(off.api_port, "POST", "/v1/resolve",
+                                   doc)
+            if status != 200:
+                fail(f"learn-off resolve failed: {status} {body[:200]}")
+            off_bodies.append(body)
+        if "deppy_route_" in scrape(off.api_port):
+            fail("route-learn=off registered route metric families")
+        s_gossip, _ = request(off.api_port, "POST", "/v1/routes/learned",
+                              {"rows": {"portfolio.s": frozen}})
+        if s_gossip != 404:
+            fail(f"learn-off /v1/routes/learned answered {s_gossip}")
+    finally:
+        off.shutdown()
+    print(f"ok: learn-off pass ({len(off_bodies)} responses, no route "
+          "families, gossip ingress 404)")
+
+    # ---- learn-on pass under live load ------------------------------
+    on = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                backend="auto", portfolio="on",
+                route_learn="on", route_shadow_rate=0.5)
+    on.start()
+    try:
+        on_bodies = []
+        stale_seen = 0.0
+        for i, doc in enumerate(reqs):
+            status, body = request(on.api_port, "POST", "/v1/resolve",
+                                   doc)
+            if status != 200:
+                fail(f"learn-on resolve failed: {status} {body[:200]}")
+            on_bodies.append(body)
+            if i == 1:
+                # Early scrape, before adoption can mark the class
+                # fresh: the stale row must already be flagged.
+                for _ in range(20):
+                    stale_seen = metric(scrape(on.api_port),
+                                        "deppy_route_stale_classes") or 0
+                    if stale_seen:
+                        break
+                    time.sleep(0.1)
+                if not stale_seen:
+                    fail("stale gauge never tripped on the epoch-old row")
+        text = scrape(on.api_port)
+        shadows = metric(text, "deppy_route_shadow_dispatches_total") or 0
+        learned = metric(text, "deppy_route_learned_rows") or 0
+        regret = metric(text, "deppy_route_regret_seconds_total") or 0
+        if shadows < 1:
+            fail(f"no shadow probes dispatched (rate=0.5): {shadows}")
+        if learned < 1:
+            fail(f"no learned row adopted: {text}")
+        if regret <= 0:
+            fail("frozen-default regret never accrued")
+        overlay = engine_registry.route_overlay()
+        if not overlay:
+            fail("learned row missing from the engine overlay")
+        heads = {row.split(",")[0] for row in overlay.values()}
+        if heads == {order[0]}:
+            fail(f"adopted row still leads the frozen worst: {overlay}")
+        if on_bodies != off_bodies:
+            fail("learn-on responses differ from learn-off")
+    finally:
+        on.shutdown()
+    if engine_registry.route_overlay():
+        fail("plane shutdown left learned rows on the overlay")
+    print(f"ok: learn-on pass (stale={int(stale_seen)} shadow={int(shadows)} "
+          f"learned={int(learned)} regret={regret:.4f}s, responses "
+          "byte-identical, overlay cleared on shutdown)")
+
+    # ---- offline reconstruction: deppy routes from the sink ---------
+    telemetry.configure_sink(None)
+    from deppy_tpu import cli
+
+    events = [json.loads(line) for line in open(sink)]
+    kinds = {e.get("kind") for e in events}
+    for want in ("race", "route", "route_stale", "route_learned"):
+        if want not in kinds:
+            fail(f"sink lacks {want} events: {sorted(kinds)}")
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli.main(["routes", sink, "--registry", REG])
+    if rc:
+        fail(f"deppy routes exited {rc}")
+    table = out.getvalue()
+    if "regret" not in table or "stale" not in table:
+        fail(f"deppy routes table incomplete:\n{table}")
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli.main(["routes", sink, "--registry", REG,
+                       "--output", "json"])
+    doc = json.loads(out.getvalue())
+    if rc or doc["totals"]["learned_rows"] < 1:
+        fail(f"deppy routes --output json missed the learned row: "
+             f"{doc.get('totals')}")
+    print(f"ok: deppy routes reconstructed {doc['totals']['races']} races, "
+          f"{doc['totals']['learned_rows']} learned row(s), "
+          f"{doc['totals']['regret_s']:.4f}s regret from the sink alone")
+
+    for path in (sink, REG, REG + ".lock"):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    print("routes smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

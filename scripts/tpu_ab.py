@@ -131,21 +131,19 @@ def run_portfolio_ab(a, expected) -> None:
                                  "best_fixed_backend") if k in rec}},
          a.log)
     if won and a.write_portfolio_rows:
-        from deppy_tpu.engine import core as engine_core
+        from deppy_tpu.engine import defaults_store
 
-        path = engine_core._MEASURED_DEFAULTS_PATH
-        try:
-            with open(path) as f:
-                doc = json.load(f)
-        except (OSError, ValueError):
-            doc = {}
         backend = expected[0] or "cpu"
-        entry = doc.setdefault(backend, {})
-        for cls in ("m", "l"):
-            entry[f"portfolio.{cls}"] = "grad_relax,device,host"
-        with open(path, "w") as f:
-            json.dump(doc, f, indent=1, sort_keys=True)
-            f.write("\n")
+        # Through the shared flock-guarded store (ISSUE 19 satellite):
+        # the old unlocked load/dump here could torn-write against a
+        # concurrent revalidation ladder, and left no provenance for
+        # the route-staleness watcher to age the rows by.
+        path = defaults_store.merge_rows(
+            backend,
+            {f"portfolio.{cls}": "grad_relax,device,host"
+             for cls in ("m", "l")},
+            evidence={"platform": backend, "source": "tpu_ab",
+                      "vs_baseline": rec.get("vs_baseline")})
         emit({"note": f"wrote portfolio.m/.l rows for {backend} "
               f"to {path}"}, a.log)
 

@@ -75,51 +75,18 @@ def _write_measured_default(backend: str, stage: str, updates: dict,
                             evidence: dict, log_path: str) -> None:
     """Merge measured-default ``updates`` for ``backend`` into the
     package-local registry (DEPPY_TPU_MEASURED_DEFAULTS overrides the
-    path); other backends' rows and this backend's other keys
-    survive.  The whole read-merge-write runs under an ``flock`` on a
-    sibling lock file: concurrent ladder instances (e.g. a CPU smoke
-    ladder racing a device ladder, or two heal windows overlapping)
-    would otherwise read the same base state and the second replace
-    would drop the first's rows."""
-    import fcntl
+    path) through the shared flock-guarded store
+    (:mod:`deppy_tpu.engine.defaults_store`): concurrent ladder
+    instances (e.g. a CPU smoke ladder racing a device ladder, or two
+    heal windows overlapping) compose instead of torn-writing.
+    Evidence is nested PER KEY: a later run that measures only one key
+    must not re-stamp provenance on rows it never measured."""
+    from deppy_tpu.engine import defaults_store
 
-    path = os.environ.get(
-        "DEPPY_TPU_MEASURED_DEFAULTS",
-        os.path.join(ROOT, "deppy_tpu", "engine", "measured_defaults.json"))
-    with open(path + ".lock", "w") as lockf:
-        fcntl.flock(lockf, fcntl.LOCK_EX)
-        try:
-            try:
-                with open(path) as f:
-                    data = json.load(f)
-                if not isinstance(data, dict):
-                    data = {}
-            except (OSError, ValueError):
-                data = {}
-            entry = data.get(backend)
-            if not isinstance(entry, dict):
-                entry = {}
-            entry.update(updates)
-            ev = entry.get("evidence")
-            if not isinstance(ev, dict):
-                ev = {}
-            # Evidence is nested PER KEY: a later run that measures only
-            # one key must not re-stamp provenance (ts / ladder_log) on
-            # rows it never measured.
-            stamp = {**evidence, "ts": round(time.time(), 1),
-                     "ladder_log":
-                     os.path.abspath(log_path) if log_path else ""}
-            for key in updates:
-                ev[key] = dict(stamp)
-            entry["evidence"] = ev
-            data[backend] = entry
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(data, f, indent=2, sort_keys=True)
-                f.write("\n")
-            os.replace(tmp, path)
-        finally:
-            fcntl.flock(lockf, fcntl.LOCK_UN)
+    path = defaults_store.merge_rows(
+        backend, updates,
+        evidence={**evidence, "ladder_log":
+                  os.path.abspath(log_path) if log_path else ""})
     _emit_line({"stage": stage, "backend": backend, **updates,
                 "path": path}, log_path)
 

@@ -38,6 +38,7 @@ CODE_SCOPE = [
     REPO / "deppy_tpu" / "obs",
     REPO / "deppy_tpu" / "profile",
     REPO / "deppy_tpu" / "optimize",
+    REPO / "deppy_tpu" / "routes",
     REPO / "deppy_tpu" / "service.py",
     REPO / "deppy_tpu" / "engine" / "driver.py",
 ]

@@ -1,0 +1,620 @@
+"""Route-health plane (ISSUE 19).
+
+Pins the plane's contracts: the regret ledger's censoring discipline
+(a cancelled loser's partial wall never feeds a speed estimate — in
+the ledger or `deppy profile`'s race table), one `route_stale` event
+per staleness crossing, the shadow sampler's deterministic schedule
+and exclusion set, the shared flock-guarded defaults store surviving
+concurrent writers, learned-row adoption (gated, idempotent, overlay-
+scoped, cleared on plane shutdown), the learn-off mode constructing
+nothing, and the adversarial fuzz differential: a deliberately-wrong
+learned row everywhere still serves byte-identical answers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from deppy_tpu import sat
+from deppy_tpu.models import random_instance
+
+pytest.importorskip("jax")
+
+from deppy_tpu import io as problem_io  # noqa: E402
+from deppy_tpu import telemetry  # noqa: E402
+from deppy_tpu import routes  # noqa: E402
+from deppy_tpu.engine import core  # noqa: E402
+from deppy_tpu.engine import defaults_store  # noqa: E402
+from deppy_tpu.engine import registry as engine_registry  # noqa: E402
+from deppy_tpu.routes import report as routes_report  # noqa: E402
+from deppy_tpu.routes.ledger import RegretLedger  # noqa: E402
+from deppy_tpu.routes.learn import OnlineRouteRegistry  # noqa: E402
+from deppy_tpu.routes.shadow import ShadowSampler  # noqa: E402
+from deppy_tpu.routes.staleness import StalenessWatcher  # noqa: E402
+from deppy_tpu.sched import scheduler as sched_mod  # noqa: E402
+from deppy_tpu.sched.scheduler import Scheduler  # noqa: E402
+
+from _depth import depth  # noqa: E402
+
+pytestmark = pytest.mark.routes
+
+
+def _race(cls="m", winner="host", default="device", lanes=4,
+          wall=0.04, losers=None, **extra):
+    ev = {"kind": "race", "size_class_name": cls, "winner": winner,
+          "canonical": "device", "default": default,
+          "entrants": ["device", "host"], "lanes": lanes,
+          "cancelled": [], "win_margin_s": 0.01, "checked": None,
+          "wall_s": wall}
+    ev["losers"] = losers if losers is not None else []
+    ev.update(extra)
+    return ev
+
+
+def _capture(registry):
+    events = []
+    registry.add_forwarder(events.append)
+    return events
+
+
+@pytest.fixture(autouse=True)
+def _clean_overlay():
+    yield
+    engine_registry.set_route_overlay({})
+    routes.stop_plane()
+    sched_mod._join_race_threads()
+
+
+# ------------------------------------------------------- regret ledger
+
+
+class TestRegretLedger:
+    def test_uncensored_loser_feeds_estimate_and_regret(self):
+        led = RegretLedger(decay=0.5)
+        led.fold(_race(winner="host", default="device", wall=0.01,
+                       lanes=1,
+                       losers=[{"backend": "device", "wall_s": 0.05,
+                                "censored": False}]))
+        est = led.estimates()["m"]
+        assert est["host"]["us_per_lane"] == 10000.0
+        assert est["device"]["us_per_lane"] == 50000.0
+        snap = led.snapshot()["m"]
+        # Default lost with an observed full wall: regret is the delta.
+        assert snap["regret_s"] == {"device": 0.04}
+        assert snap["win_share"] == {"host": 1.0}
+
+    def test_censored_loser_never_feeds_an_estimate(self):
+        led = RegretLedger()
+        led.fold(_race(losers=[{"backend": "device", "wall_s": 0.011,
+                                "censored": True}]))
+        est = led.estimates()["m"]
+        assert "device" not in est or \
+            est["device"]["us_per_lane"] is None
+        assert est["device"]["censored"] == 1
+        # No uncensored default wall, no decayed estimate to fall back
+        # on: regret must NOT be invented from the censored partial.
+        assert led.snapshot()["m"]["regret_s"] == {}
+
+    def test_censored_default_falls_back_to_decayed_estimate(self):
+        led = RegretLedger(decay=1.0)
+        # One shadow probe measures the default's true full wall...
+        led.fold({"kind": "route", "phase": "shadow",
+                  "size_class_name": "m", "backend": "device",
+                  "lanes": 1, "wall_s": 0.1, "ok": True})
+        # ...then a race the default loses by cancellation.
+        led.fold(_race(winner="host", default="device", wall=0.02,
+                       lanes=1,
+                       losers=[{"backend": "device", "wall_s": 0.021,
+                                "censored": True}]))
+        assert led.snapshot()["m"]["regret_s"]["device"] == \
+            pytest.approx(0.08)
+
+    def test_failed_shadow_counts_without_estimating(self):
+        led = RegretLedger()
+        led.fold({"kind": "route", "phase": "shadow",
+                  "size_class_name": "m", "backend": "grad_relax",
+                  "lanes": 2, "wall_s": 0.5, "ok": False,
+                  "error": "Boom"})
+        assert led.shadow_counts() == {
+            "grad_relax": {"dispatches": 1, "failed": 1}}
+        assert "grad_relax" not in led.estimates().get("m", {})
+
+    def test_no_winner_and_resubmit_markers_fold_cleanly(self):
+        led = RegretLedger()
+        led.fold({"kind": "race", "size_class_name": "m",
+                  "entrants": ["device", "host"], "lanes": 2,
+                  "default": "device", "winner": None})
+        led.fold({"kind": "race", "size_class_name": "m",
+                  "resubmitted": 2})
+        snap = led.snapshot()["m"]
+        assert snap["races"] == 0 and snap["no_winner"] == 1
+
+    def test_render_families_only_when_fed(self):
+        led = RegretLedger()
+        assert led.render_metric_lines() == []
+        led.fold(_race(losers=[{"backend": "device", "wall_s": 0.09,
+                                "censored": False}]))
+        text = "\n".join(led.render_metric_lines(replica="r1"))
+        assert "deppy_route_regret_seconds_total" in text
+        assert "deppy_route_win_share" in text
+        assert 'replica="r1"' in text
+
+
+# ------------------------------------- satellite 1: profile censoring
+
+
+class TestProfileRaceCensoring:
+    def test_censored_loser_excluded_from_us_per_lane(self, tmp_path):
+        from deppy_tpu.profile.report import render_text, summarize
+
+        sink = tmp_path / "sink.jsonl"
+        events = [
+            _race(winner="host", default="device", wall=0.01, lanes=2,
+                  losers=[{"backend": "device", "wall_s": 0.011,
+                           "censored": True}]),
+            _race(winner="device", default="device", wall=0.004,
+                  lanes=2, losers=[{"backend": "host", "wall_s": 0.02,
+                                    "censored": False}]),
+        ]
+        sink.write_text("\n".join(json.dumps(dict(e, ts=i))
+                                  for i, e in enumerate(events)) + "\n")
+        agg = summarize(str(sink))["races"]["m"]
+        speed = agg["backend_us_per_lane"]
+        # host: one win (5000us/lane) + one completed loss (10000).
+        assert speed["host"]["samples"] == 2
+        assert speed["host"]["us_per_lane"] == pytest.approx(7500.0)
+        # device: the censored cancel is excluded — only its win counts.
+        assert speed["device"]["samples"] == 1
+        assert speed["device"]["us_per_lane"] == pytest.approx(2000.0)
+        assert agg["censored"] == {"device": 1}
+        text = render_text(summarize(str(sink)), str(sink))
+        assert "cens" in text
+
+    def test_censored_only_backend_renders_unknown(self, tmp_path):
+        from deppy_tpu.profile.report import render_text, summarize
+
+        sink = tmp_path / "sink.jsonl"
+        sink.write_text(json.dumps(dict(
+            _race(winner="host", wall=0.01,
+                  losers=[{"backend": "device", "wall_s": None,
+                           "censored": True}]), ts=1)) + "\n")
+        summary = summarize(str(sink))
+        assert "device" not in \
+            summary["races"]["m"]["backend_us_per_lane"]
+        assert "device=?" in render_text(summary, str(sink))
+
+
+# ---------------------------------------------------------- staleness
+
+
+class TestStalenessWatcher:
+    def _watcher(self, rows_doc, **kw):
+        reg = telemetry.Registry()
+        events = _capture(reg)
+        w = StalenessWatcher(platform="cpu", registry=reg,
+                             rows_doc=rows_doc, box="here", **kw)
+        return w, events
+
+    def test_missing_row_flags_once_per_crossing(self):
+        w, events = self._watcher({})
+        assert w.observe("m") == "missing"
+        assert w.observe("m") == "missing"
+        stale = [e for e in events if e["kind"] == "route_stale"]
+        assert len(stale) == 1
+        assert stale[0]["reason"] == "missing"
+        assert stale[0]["size_class_name"] == "m"
+        assert w.stale_count() == 1
+
+    def test_stale_then_fresh_then_stale_re_arms(self):
+        doc = {"cpu": {"portfolio": "host,device",
+                       "evidence": {"portfolio": {"ts": 1000.0,
+                                                  "box": "here"}}}}
+        w, events = self._watcher(doc, max_age_s=60.0)
+        assert w.observe("m") == "stale"
+        w.mark_fresh("m")
+        assert w.observe("m") is None
+        assert w.stale_count() == 0
+        assert len([e for e in events
+                    if e["kind"] == "route_stale"]) == 1
+
+    def test_foreign_box_and_no_provenance(self):
+        import time as _time
+
+        now = _time.time()
+        doc = {"cpu": {"portfolio.m": "host,device",
+                       "portfolio.l": "device,host",
+                       "evidence": {"portfolio.m": {"ts": now,
+                                                    "box": "elsewhere"}}}}
+        w, events = self._watcher(doc, max_age_s=3600.0)
+        assert w.observe("m") == "foreign_box"
+        assert w.observe("l") == "no_provenance"
+        reasons = {e["size_class_name"]: e["reason"] for e in events
+                   if e["kind"] == "route_stale"}
+        assert reasons == {"m": "foreign_box", "l": "no_provenance"}
+        assert w.stale_count() == 2
+
+    def test_reason_change_is_a_new_crossing(self):
+        w, events = self._watcher({})
+        w.observe("m")
+        w.reload({"cpu": {"portfolio": "host,device",
+                          "evidence": {"portfolio": {"ts": 1000.0,
+                                                     "box": "here"}}}})
+        assert w.observe("m") == "stale"
+        stale = [e for e in events if e["kind"] == "route_stale"]
+        assert [e["reason"] for e in stale] == ["missing", "stale"]
+
+    def test_fresh_row_never_flags(self):
+        import time as _time
+
+        doc = {"cpu": {"portfolio": "host,device",
+                       "evidence": {"portfolio": {
+                           "ts": _time.time(), "box": "here"}}}}
+        w, events = self._watcher(doc, max_age_s=3600.0)
+        assert w.observe("m") is None
+        assert events == [] and w.stale_count() == 0
+
+
+# ------------------------------------------------------ shadow sampler
+
+
+class TestShadowSampler:
+    def test_deterministic_interval_and_rotation(self):
+        s = ShadowSampler(rate=0.5)
+        picks = [s.pick("m", exclude=["device"]) for _ in range(6)]
+        # Flush counts 0, 2, 4 probe; the candidate rotates through the
+        # non-excluded raceable field.
+        assert picks[1] is picks[3] is picks[5] is None
+        chosen = [p for p in picks if p is not None]
+        assert len(chosen) == 3
+        assert "device" not in chosen
+        field = set(s.candidates("m", exclude=["device"]))
+        assert set(chosen) <= field
+        if len(field) > 1:
+            assert len(set(chosen[:2])) == 2  # rotation, not repetition
+
+    def test_rate_zero_never_picks(self):
+        s = ShadowSampler(rate=0.0)
+        assert s.interval == 0
+        assert s.pick("m", exclude=[]) is None
+
+    def test_full_exclusion_yields_none(self):
+        s = ShadowSampler(rate=1.0)
+        everyone = list(engine_registry.specs())
+        assert s.pick("m", exclude=everyone) is None
+
+    def test_per_class_counters_are_independent(self):
+        s = ShadowSampler(rate=0.5)
+        assert s.pick("m", exclude=[]) is not None
+        assert s.pick("l", exclude=[]) is not None  # own count, fires
+
+
+# ------------------------------- satellite 2: shared defaults store
+
+
+class TestDefaultsStore:
+    def test_merge_preserves_siblings_and_stamps_provenance(
+            self, tmp_path):
+        p = str(tmp_path / "measured.json")
+        defaults_store.merge_rows("cpu", {"portfolio": "host,device"},
+                                  evidence={"platform": "cpu"}, path=p)
+        defaults_store.merge_rows("cpu", {"bcp": "watched"}, path=p)
+        doc = defaults_store.read_rows(p)
+        assert doc["cpu"]["portfolio"] == "host,device"
+        assert doc["cpu"]["bcp"] == "watched"
+        stamp = defaults_store.provenance("cpu", "portfolio", path=p)
+        assert stamp["platform"] == "cpu"
+        assert stamp["ts"] > 0 and stamp["box"]
+        # The second merge stamped only its own key.
+        assert "platform" not in defaults_store.provenance(
+            "cpu", "bcp", path=p)
+
+    def test_concurrent_writers_compose_under_the_flock(self, tmp_path):
+        p = str(tmp_path / "measured.json")
+        errors = []
+
+        def write(key, val):
+            try:
+                for _ in range(10):
+                    defaults_store.merge_rows("cpu", {key: val}, path=p)
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        threads = [threading.Thread(target=write, args=(f"k{i}", f"v{i}"))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        doc = defaults_store.read_rows(p)["cpu"]
+        assert {doc[f"k{i}"] for i in range(4)} == \
+            {f"v{i}" for i in range(4)}
+
+    def test_corrupt_registry_reads_empty(self, tmp_path):
+        p = tmp_path / "measured.json"
+        p.write_text("{not json")
+        assert defaults_store.read_rows(str(p)) == {}
+
+
+# ------------------------------------------------------------ learning
+
+
+class _FakeLedger:
+    def __init__(self, est):
+        self._est = est
+
+    def estimates(self):
+        return self._est
+
+
+class TestOnlineRouteRegistry:
+    def _learner(self, est, min_samples=2, watcher=None):
+        reg = telemetry.Registry()
+        events = _capture(reg)
+        learner = OnlineRouteRegistry(
+            _FakeLedger(est), min_samples=min_samples, platform="cpu",
+            registry=reg, watcher=watcher)
+        return learner, events
+
+    def test_adopts_when_measurement_beats_served_head(self):
+        est = {"m": {"host": {"us_per_lane": 50.0, "samples": 4},
+                     "device": {"us_per_lane": 900.0, "samples": 4}}}
+        learner, events = self._learner(est)
+        # Static ranked order leads with device; the measurement says
+        # host — adoption must fire and flip ranked().
+        row = learner.consider("m")
+        assert row == "host,device"
+        assert engine_registry.route_overlay() == {
+            "portfolio.m": "host,device"}
+        names, measured = engine_registry.ranked("m")
+        assert measured and names == ["host", "device"]
+        learned = [e for e in events if e["kind"] == "route_learned"]
+        assert len(learned) == 1 and learned[0]["source"] == "live"
+        assert learned[0]["est_us_per_lane"]["host"] == 50.0
+        # Idempotent: the same measurement adopts nothing new.
+        assert learner.consider("m") is None
+
+    def test_agreeing_measurement_never_churns(self):
+        served, _ = engine_registry.ranked("m")
+        est = {"m": {served[0]: {"us_per_lane": 10.0, "samples": 9},
+                     "host": {"us_per_lane": 99.0, "samples": 9}}}
+        learner, events = self._learner(est)
+        assert learner.consider("m") is None
+        assert engine_registry.route_overlay() == {}
+
+    def test_min_samples_gates_eligibility(self):
+        est = {"m": {"host": {"us_per_lane": 50.0, "samples": 1},
+                     "device": {"us_per_lane": 900.0, "samples": 9}}}
+        learner, _ = self._learner(est, min_samples=4)
+        assert learner.consider("m") is None
+
+    def test_adopt_validates_rows_and_marks_fresh(self):
+        reg = telemetry.Registry()
+        watcher = StalenessWatcher(platform="cpu", registry=reg,
+                                   rows_doc={}, box="here")
+        watcher.observe("m")
+        assert watcher.stale_count() == 1
+        learner, _ = self._learner({}, watcher=watcher)
+        learner.watcher = watcher
+        applied = learner.adopt(
+            {"portfolio.m": "host, device, nonsense",
+             "portfolio.x": "onlyone",
+             "not_a_portfolio_key": "host,device",
+             "portfolio.l": 7},
+            source="gossip", origin="peer:1")
+        # Unknown backends are dropped, sub-2-backend rows and foreign
+        # keys rejected wholesale.
+        assert applied == {"portfolio.m": "host,device"}
+        assert watcher.stale_count() == 0  # adoption marked it fresh
+
+    def test_gossip_ingress_requires_a_learning_plane(self):
+        assert routes.adopt_remote({"portfolio.m": "host,device"}) == {}
+        plane = routes.start_plane(None, mode="observe")
+        try:
+            assert plane is not None and plane.learner is None
+            assert routes.adopt_remote(
+                {"portfolio.m": "host,device"}) == {}
+        finally:
+            routes.stop_plane()
+        plane = routes.start_plane(None, mode="on")
+        try:
+            applied = routes.adopt_remote(
+                {"portfolio.m": "host,device"}, origin="peer:9")
+            assert applied == {"portfolio.m": "host,device"}
+            assert engine_registry.route_overlay() == applied
+        finally:
+            routes.stop_plane()
+        # Plane shutdown clears its adopted rows from the overlay.
+        assert engine_registry.route_overlay() == {}
+
+
+# ------------------------------------------------------- plane + modes
+
+
+class TestRoutePlane:
+    def test_resolve_mode_ladder(self):
+        assert routes.resolve_mode("off") == "off"
+        assert routes.resolve_mode("0") == "off"
+        assert routes.resolve_mode("no") == "off"
+        assert routes.resolve_mode("on") == "on"
+        assert routes.resolve_mode("learn") == "on"
+        assert routes.resolve_mode("observe") == "observe"
+        assert routes.resolve_mode("anything-else") == "observe"
+
+    def test_mode_off_constructs_nothing(self):
+        assert routes.start_plane(None, mode="off") is None
+        assert routes.active_plane() is None
+        assert routes.render_metric_lines() == []
+
+    def test_forwarder_never_raises(self):
+        plane = routes.RoutePlane(mode="observe",
+                                  registry=telemetry.Registry())
+        plane.ledger.fold = lambda ev: 1 / 0
+        plane({"kind": "race", "size_class_name": "m"})  # must swallow
+
+    def test_observe_mode_folds_races_from_the_registry(self):
+        reg = telemetry.Registry()
+        plane = routes.RoutePlane(mode="observe", registry=reg)
+        plane.install()
+        try:
+            reg.event(**{k: v for k, v in _race().items()
+                         if k != "kind"}, kind="race")
+            snap = plane.snapshot()
+            assert snap["classes"]["m"]["races"] == 1
+            assert snap["mode"] == "observe" and "learned" not in snap
+        finally:
+            plane.close()
+
+
+# ------------------- satellite 3: adversarial learned-row differential
+
+
+class TestAdversarialLearnedRows:
+    def _requests(self):
+        def chain(d):
+            vs = [sat.variable("a0", sat.mandatory(),
+                               sat.dependency("a1"))]
+            vs += [sat.variable(f"a{i}", sat.dependency(f"a{i + 1}"))
+                   for i in range(1, d - 1)]
+            vs.append(sat.variable(f"a{d - 1}"))
+            return vs
+
+        reqs = [chain(24), chain(48)]
+        reqs += [random_instance(length=12, seed=s)
+                 for s in range(depth(6, 3))]
+        reqs.append([
+            sat.variable("u0", sat.mandatory(), sat.dependency("u1")),
+            sat.variable("u1", sat.prohibited()),
+        ])
+        return reqs
+
+    def _render(self, results):
+        return [problem_io.result_to_dict(r) for r in results]
+
+    def test_worst_row_everywhere_changes_speed_never_answers(self):
+        reqs = self._requests()
+        baseline = self._render(Scheduler(
+            backend="auto", portfolio="off").submit(reqs))
+        # Adversarially-wrong learned rows: every class served by the
+        # reversed static order (worst backend promoted to default).
+        static = list(engine_registry.specs())
+        worst_first = ",".join(reversed(
+            [n for n in static if n in ("device", "host", "grad_relax")]))
+        engine_registry.set_route_overlay(
+            {f"portfolio.{c}": worst_first
+             for c in ("xs", "s", "m", "l", "xl")})
+        try:
+            raced = self._render(Scheduler(
+                backend="auto", portfolio="on",
+                portfolio_sample_check=1.0).submit(reqs))
+        finally:
+            engine_registry.set_route_overlay({})
+        assert raced == baseline
+
+    def test_learn_off_scheduler_registers_no_route_families(self):
+        reg = telemetry.Registry()
+        sched = Scheduler(backend="auto", portfolio="off", registry=reg)
+        sched.submit(self._requests()[:2])
+        assert not any(k.startswith("deppy_route")
+                       for k in reg.snapshot())
+        assert routes.render_metric_lines() == []
+
+
+# --------------------------------------------- deppy routes (offline)
+
+
+class TestRoutesReport:
+    EVENTS = [
+        dict(_race(winner="host", default="device", wall=0.01, lanes=1,
+                   losers=[{"backend": "device", "wall_s": 0.05,
+                            "censored": False}]),
+             ts=1.0, platform="cpu"),
+        {"ts": 2.0, "kind": "route_stale", "reason": "stale",
+         "size_class_name": "m", "key": "portfolio", "age_s": 999.0,
+         "row": "device,host", "platform": "cpu"},
+        {"ts": 3.0, "kind": "route", "phase": "shadow",
+         "size_class_name": "m", "backend": "grad_relax", "lanes": 1,
+         "wall_s": 0.002, "ok": True},
+        {"ts": 4.0, "kind": "route_learned", "key": "portfolio.m",
+         "row": "host,device", "size_class_name": "m",
+         "source": "live", "platform": "cpu",
+         "est_us_per_lane": {"host": 10000.0, "device": 50000.0}},
+    ]
+
+    def test_build_report_reconstructs_the_table(self):
+        doc = routes_report.build_report(iter(self.EVENTS))
+        m = doc["classes"]["m"]
+        assert m["races"] == 1
+        assert m["regret_s"] == {"device": 0.04}
+        assert m["learned"]["row"] == "host,device"
+        # Adoption supersedes the earlier staleness flag, exactly like
+        # the live watcher's mark_fresh.
+        assert m["stale"] is None
+        assert doc["totals"] == {"races": 1, "regret_s": 0.04,
+                                 "stale_classes": 0, "learned_rows": 1}
+        assert doc["shadow"]["grad_relax"]["dispatches"] == 1
+
+    def test_stale_without_adoption_stays_flagged(self):
+        doc = routes_report.build_report(iter(self.EVENTS[:3]))
+        assert doc["classes"]["m"]["stale"]["reason"] == "stale"
+        assert doc["totals"]["stale_classes"] == 1
+
+    def test_registry_provenance_joins(self):
+        rows_doc = {"cpu": {"portfolio": "device,host", "evidence": {
+            "portfolio": {"ts": 1000.0, "box": "elsewhere"}}}}
+        doc = routes_report.build_report(iter(self.EVENTS[:2]),
+                                         rows_doc=rows_doc)
+        reg = doc["classes"]["m"]["registry"]
+        assert reg["row"] == "device,host"
+        assert reg["evidence"]["box"] == "elsewhere"
+
+    def test_cli_renders_from_sink_alone(self, tmp_path, capsys):
+        from deppy_tpu import cli
+
+        sink = tmp_path / "sink.jsonl"
+        sink.write_text("\n".join(json.dumps(e)
+                                  for e in self.EVENTS) + "\n")
+        assert cli.main(["routes", str(sink)]) == 0
+        text = capsys.readouterr().out
+        assert "m" in text and "regret" in text
+        assert cli.main(["routes", str(sink), "--output", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["totals"]["learned_rows"] == 1
+
+    def test_cli_missing_file_exits_2(self, tmp_path, capsys):
+        from deppy_tpu import cli
+
+        assert cli.main(["routes", str(tmp_path / "nope.jsonl")]) == 2
+        assert "error" in capsys.readouterr().err
+
+
+# -------------------------------------------------- fleet federation
+
+
+class TestFleetRollups:
+    def test_route_families_roll_up(self):
+        from deppy_tpu.obs.federate import fleet_rollups
+
+        scrape = "\n".join([
+            'deppy_route_regret_seconds_total{size_class="m",'
+            'backend="device"} 1.5',
+            "deppy_route_stale_classes 2",
+            'deppy_route_shadow_dispatches_total{backend="host"} 3',
+            "deppy_route_learned_rows 1",
+        ])
+        roll = fleet_rollups([("a:1", scrape), ("b:2", scrape)])
+        assert roll["route_regret_s"] == pytest.approx(3.0)
+        assert roll["route_stale_classes"] == 4
+        assert roll["route_shadow_dispatches"] == 6
+        assert roll["route_learned_rows"] == 2
+
+    def test_learn_off_fleet_renders_no_route_lines(self):
+        from deppy_tpu.obs.federate import (fleet_rollups,
+                                            render_rollup_lines)
+
+        roll = fleet_rollups([("a:1", "deppy_queue_depth 0")])
+        lines = render_rollup_lines(roll)
+        assert not any("route" in ln for ln in lines)
