@@ -148,6 +148,14 @@ routes-smoke: ## Route-health plane end to end: a deliberately stale measured ro
 test-routes: ## Route-health subsystem tests only (the `routes` pytest marker).
 	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m routes
 
+.PHONY: sessions-smoke
+sessions-smoke: ## Stateful resolution sessions end to end: interactive assume/test/resolve walk byte-identical to the one-shot oracle through a live 2-replica fleet, session survives a live drain, lease expiry on /metrics, sessions-off 404 byte-identity (ISSUE 20 acceptance).
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/sessions_smoke.py
+
+.PHONY: test-sessions
+test-sessions: ## Session-tier subsystem tests only (the `sessions` pytest marker).
+	DEPPY_TEST_DEPTH=quick $(PYTHON) -m pytest tests/ -q -m sessions
+
 .PHONY: soak-smoke
 soak-smoke: ## Elastic-fleet chaos survival gate, quick shape: open-loop load across replica kill / runtime join+arc-flip / drain / router failover, byte-identity vs a fault-free oracle (ISSUE 17 acceptance at --seconds 70; this target runs the 20s smoke).
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/soak_smoke.py --seconds 20

@@ -634,7 +634,57 @@ def _run_routes(timeout_s: int) -> dict | None:
     return None
 
 
+def _run_session(timeout_s: int) -> dict | None:
+    """Run the stateful-session workload (ISSUE 20) on the forced-CPU
+    platform: an interactive assume/resolve exploration walk driven
+    twice over live HTTP — once through a retained session (encoded
+    catalog + warm model kept server-side, per-step op deltas), once
+    by re-deriving and cold-resolving the full catalog document every
+    step — with every step's answer required byte-identical."""
+    from deppy_tpu.utils.platform_env import run_captured
+
+    cmd = [sys.executable, "-m", "deppy_tpu.benchmarks.session",
+           "--out", os.path.join(REPO, "benchmarks", "results",
+                                 "session_r20.json")]
+    if "DEPPY_BENCH_N" in os.environ:
+        cmd += ["--steps", os.environ["DEPPY_BENCH_N"]]
+    try:
+        rc, stdout, stderr = run_captured(
+            cmd, timeout_s=timeout_s, cwd=REPO, env=_cpu_env())
+    except subprocess.TimeoutExpired:
+        _log(f"session workload timed out after {timeout_s}s")
+        return None
+    if stderr:
+        print(stderr, file=sys.stderr, end="", flush=True)
+    if rc != 0:
+        _log(f"session workload failed rc={rc}")
+        return None
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return rec
+    return None
+
+
 def main(workload: str = "headline") -> int:
+    if workload == "session":
+        rec = _run_session(RUN_TIMEOUT_S)
+        if rec is None:
+            rec = {
+                "metric": ("interactive exploration ms/step (retained "
+                           "session vs catalog-re-resolve-per-step)"),
+                "value": 0.0,
+                "unit": "ms",
+                "vs_baseline": 0.0,
+                "workload": "session",
+                "backend": "none",
+                "error": "session workload produced no record",
+            }
+        print(json.dumps(rec), flush=True)
+        return 0
     if workload == "routes":
         rec = _run_routes(RUN_TIMEOUT_S)
         if rec is None:
@@ -827,7 +877,8 @@ if __name__ == "__main__":
     _ap = argparse.ArgumentParser()
     _ap.add_argument("--workload",
                      choices=["headline", "churn", "hard", "publish",
-                              "fleet", "soak", "upgrade", "routes"],
+                              "fleet", "soak", "upgrade", "routes",
+                              "session"],
                      default="headline",
                      help="headline = batched device vs serial host; "
                      "churn = warm-start vs cold re-resolution replay "
@@ -843,7 +894,10 @@ if __name__ == "__main__":
                      "minimal-change upgrade planning, warm cone "
                      "probes vs cold tightening (ISSUE 18); routes = "
                      "distribution-shift routing, learned vs frozen "
-                     "stale default through the racing path (ISSUE 19)")
+                     "stale default through the racing path (ISSUE 19); "
+                     "session = interactive assume/resolve exploration, "
+                     "retained session vs catalog-re-resolve-per-step "
+                     "(ISSUE 20)")
     _args = _ap.parse_args()
     try:
         rc = main(workload=_args.workload)

@@ -1,0 +1,267 @@
+"""Stateful-session benchmark (ISSUE 20): interactive exploration via a
+retained session vs catalog-re-resolve-per-step.
+
+Interactive traffic is conversation-shaped: an operator pins an entity,
+asks for the plan, pins another, asks again — N small steps against ONE
+catalog epoch.  Stateless serving answers each step with the full
+``POST /v1/resolve`` cost: the client re-derives the whole catalog
+document with its accumulated assumptions folded in as constraints,
+ships it, and the server re-parses, re-validates, and re-encodes the
+catalog before solving from cold.  A resolution session
+(``POST /v1/session`` + ``/{id}/op``) retains the encoded problem and
+decode vocabulary server-side, so each step ships only the delta (one
+op document) and the solve warm-starts from the session's last model.
+
+Both passes drive the SAME exploration walk over live HTTP against the
+same single-replica service (host backend — the per-step win this
+workload measures is retained-state vs re-shipped-state, which no
+accelerator changes), and every step's answer must be byte-identical:
+the session op's ``result`` object vs the one-shot oracle's
+``results[0]`` for the equivalent derived document — the fuzz
+differential's contract, measured instead of asserted-only.
+
+Emits one JSON record in the bench.py contract: ``value`` the session
+pass's mean milliseconds per solve-carrying step, ``vs_baseline`` the
+one-shot-to-session per-step ratio (the >= 3x acceptance), plus both
+passes' latency distributions and the answer-identity verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from http.client import HTTPConnection
+from typing import List, Optional
+
+from .harness import log
+
+
+def session_catalog(bundles: int, size: int) -> dict:
+    """The retained catalog: bundle 0 is mandatory behind a dependency
+    chain, every other bundle an independent optional chain — the shape
+    real package catalogs decompose into (bundles share no edges), so an
+    assumption's consequence cone is one bundle, not the world.  Pinning
+    an optional entity genuinely changes the answer (it drags its whole
+    chain in); excluding one genuinely constrains it."""
+    variables = []
+    for b in range(bundles):
+        for j in range(size):
+            cons = []
+            if j == 0 and b == 0:
+                cons.append({"type": "mandatory"})
+            if j < size - 1:
+                cons.append({"type": "dependency",
+                             "ids": [f"b{b}v{j + 1}"]})
+            variables.append({"id": f"b{b}v{j}", "constraints": cons})
+    return {"variables": variables}
+
+
+def walk_steps(bundles: int, size: int, steps: int) -> List[tuple]:
+    """The exploration walk: step ``i`` additionally pins one entity
+    from a rotating bundle (installed for even steps, excluded for odd)
+    — every step's accumulated assumption set is distinct, so the
+    stateless baseline can never serve a step from the exact-result
+    cache."""
+    out = []
+    for i in range(steps):
+        b = 1 + (i % max(bundles - 1, 1))
+        j = (i // max(bundles - 1, 1)) % size
+        out.append((f"b{b}v{j}", i % 2 == 0))
+    return out
+
+
+def derived_doc(doc: dict, assumptions: List[tuple]) -> dict:
+    """The stateless client's per-step document: the full catalog with
+    each accumulated (id, installed) assumption folded in as a
+    mandatory/prohibited constraint — what a session-less client must
+    re-ship and the server must re-encode, every step."""
+    extra: dict = {}
+    for ident, installed in assumptions:
+        extra.setdefault(ident, []).append(
+            {"type": "mandatory" if installed else "prohibited"})
+    variables = []
+    for v in doc["variables"]:
+        cons = list(v.get("constraints") or [])
+        cons += extra.get(v["id"], [])
+        variables.append({"id": v["id"], "constraints": cons})
+    return {"variables": variables}
+
+
+def _request(port: int, method: str, path: str, body=None, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=120)
+    h = dict(headers or {})
+    payload = None
+    if body is not None:
+        payload = json.dumps(body)
+        h.setdefault("Content-Type", "application/json")
+    conn.request(method, path, body=payload, headers=h)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _percentile(samples: List[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    idx = min(int(q * len(ordered)), len(ordered) - 1)
+    return ordered[idx]
+
+
+def _dist(samples: List[float]) -> dict:
+    return {
+        "steps": len(samples),
+        "mean_ms": round(sum(samples) / max(len(samples), 1) * 1e3, 3),
+        "p50_ms": round(_percentile(samples, 0.50) * 1e3, 3),
+        "p99_ms": round(_percentile(samples, 0.99) * 1e3, 3),
+        "wall_s": round(sum(samples), 3),
+    }
+
+
+def session_pass(port: int, doc: dict, steps: List[tuple]) -> tuple:
+    """The retained-session walk: create once, then one assume op + one
+    resolve op per step.  The per-step sample is the CLIENT-visible
+    wall of the whole step (both ops) — the number an interactive
+    operator feels."""
+    status, body = _request(port, "POST", "/v1/session", doc)
+    if status != 200:
+        raise RuntimeError(f"session create: HTTP {status} {body[:200]!r}")
+    sid = json.loads(body)["session"]["id"]
+    op_path = f"/v1/session/{sid}/op"
+    samples: List[float] = []
+    answers: List[str] = []
+    for ident, installed in steps:
+        t0 = time.perf_counter()
+        status, body = _request(
+            port, "POST", op_path,
+            {"op": "assume", "identifiers": [ident],
+             "installed": installed})
+        if status != 200:
+            raise RuntimeError(f"assume {ident}: HTTP {status}")
+        status, body = _request(port, "POST", op_path, {"op": "resolve"})
+        samples.append(time.perf_counter() - t0)
+        if status != 200:
+            raise RuntimeError(f"resolve: HTTP {status} {body[:200]!r}")
+        answers.append(json.dumps(json.loads(body)["result"],
+                                  sort_keys=True))
+    return samples, answers
+
+
+def oneshot_pass(port: int, doc: dict, steps: List[tuple]) -> tuple:
+    """The stateless walk: per step, fold the accumulated assumptions
+    into the full catalog document client-side and POST /v1/resolve.
+    The sample includes the client's document derivation — that cost IS
+    part of being session-less, exactly as re-parse and re-encode are
+    part of the server's."""
+    samples: List[float] = []
+    answers: List[str] = []
+    assumptions: List[tuple] = []
+    for step in steps:
+        t0 = time.perf_counter()
+        assumptions.append(step)
+        status, body = _request(port, "POST", "/v1/resolve",
+                                derived_doc(doc, assumptions))
+        samples.append(time.perf_counter() - t0)
+        if status != 200:
+            raise RuntimeError(f"oracle resolve: HTTP {status} "
+                               f"{body[:200]!r}")
+        answers.append(json.dumps(json.loads(body)["results"][0],
+                                  sort_keys=True))
+    return samples, answers
+
+
+def run(bundles: int = 96, size: int = 8, steps: int = 48,
+        out_path: Optional[str] = None) -> dict:
+    from ..service import Server
+
+    log(f"session workload: {bundles} bundles x {size} = "
+        f"{bundles * size} variables, {steps} exploration steps")
+    doc = session_catalog(bundles, size)
+    walk = walk_steps(bundles, size, steps)
+    srv = Server(bind_address="127.0.0.1:0", probe_address="127.0.0.1:0",
+                 backend="host", sched="on")
+    srv.start()
+    try:
+        sess_samples, sess_answers = session_pass(srv.api_port, doc, walk)
+        one_samples, one_answers = oneshot_pass(srv.api_port, doc, walk)
+    finally:
+        srv.shutdown()
+    sess = _dist(sess_samples)
+    oneshot = _dist(one_samples)
+    identical = sess_answers == one_answers
+    ratio = (oneshot["mean_ms"] / sess["mean_ms"]
+             if sess["mean_ms"] else 0.0)
+    record = {
+        "metric": ("interactive exploration ms/step "
+                   "(retained session vs catalog-re-resolve-per-step)"),
+        "value": sess["mean_ms"],
+        "unit": "ms",
+        "vs_baseline": round(ratio, 2),
+        "workload": "session",
+        "bundles": bundles,
+        "bundle_size": size,
+        "n_vars": bundles * size,
+        "n_steps": steps,
+        "answers_identical": identical,
+        "session": sess,
+        "oneshot": oneshot,
+        "backend": "host",
+    }
+    if not identical:
+        record["error"] = ("session answers diverged from the one-shot "
+                           "oracle — the differential contract is broken")
+        record["value"] = 0.0
+        record["vs_baseline"] = 0.0
+    if out_path:
+        import platform
+
+        full = {
+            "issue": 20,
+            "record": "session_r20",
+            "platform": {
+                "python": platform.python_version(),
+                "machine": platform.machine(),
+                "cpus": os.cpu_count(),
+                "jax_platforms": (os.environ.get("JAX_PLATFORMS")
+                                  or "(default)"),
+            },
+            "note": ("one live host-backend service; both passes drive "
+                     "the identical exploration walk over HTTP; the "
+                     "session pass pays create once then per-step op "
+                     "deltas (retained encoded catalog, warm-started "
+                     "solves), the one-shot pass re-derives, re-ships, "
+                     "and cold-resolves the full catalog document every "
+                     "step; every step's answer must match byte for "
+                     "byte"),
+            **record,
+        }
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(full, fh, indent=1)
+            fh.write("\n")
+        log(f"wrote {out_path}")
+    return record
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--bundles", type=int, default=96)
+    ap.add_argument("--size", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=48)
+    ap.add_argument("--out", default=None,
+                    help="also write the full record (the benchmarks/"
+                    "results/session_r20.json artifact)")
+    args = ap.parse_args()
+    record = run(bundles=args.bundles, size=args.size, steps=args.steps,
+                 out_path=args.out)
+    print(json.dumps(record), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -394,6 +394,35 @@ def _build_parser() -> argparse.ArgumentParser:
         "stamped (also via DEPPY_TPU_ROUTE_REGISTRY; default: "
         "in-memory only)",
     )
+    p_serve.add_argument(
+        "--sessions", choices=["on", "off"], default=None,
+        help="stateful resolution sessions (ISSUE 20): POST "
+        "/v1/session pins a catalog epoch server-side, then "
+        "/v1/session/{id}/op drives assume/test/untest/resolve/"
+        "explain against the retained state, answered byte-"
+        "identically to a one-shot cold resolve; 'off' constructs "
+        "none of it — the endpoints 404 and no session metric "
+        "family registers (default on with the scheduler; also via "
+        "DEPPY_TPU_SESSIONS)",
+    )
+    p_serve.add_argument(
+        "--session-lease-s", type=float, default=None, metavar="SECONDS",
+        help="session lease: each op renews; a session idle past its "
+        "lease is swept and ops on it answer 404 (default 300; also "
+        "via DEPPY_TPU_SESSION_LEASE_S)",
+    )
+    p_serve.add_argument(
+        "--session-max", type=int, default=None, metavar="N",
+        help="hard cap on live sessions per replica — creates beyond "
+        "it evict an expired session or shed with a counted 503 "
+        "(default 256; also via DEPPY_TPU_SESSION_MAX)",
+    )
+    p_serve.add_argument(
+        "--session-max-per-tenant", type=int, default=None, metavar="N",
+        help="per-tenant session cap, enforced before the replica-"
+        "wide one (default 64; also via "
+        "DEPPY_TPU_SESSION_MAX_PER_TENANT)",
+    )
 
     p_route = sub.add_parser(
         "route",
@@ -861,6 +890,10 @@ _CONFIG_KEYS = {
     "routeLearn": ("route_learn", str),
     "routeShadowRate": ("route_shadow_rate", float),
     "routeRegistry": ("route_registry", str),
+    "sessions": ("sessions", str),
+    "sessionLeaseS": ("session_lease_s", float),
+    "sessionMax": ("session_max", int),
+    "sessionMaxPerTenant": ("session_max_per_tenant", int),
 }
 
 
@@ -1894,6 +1927,10 @@ def _cmd_serve(args) -> int:
         "route_learn": None,
         "route_shadow_rate": None,
         "route_registry": None,
+        "sessions": None,
+        "session_lease_s": None,
+        "session_max": None,
+        "session_max_per_tenant": None,
     }
     try:
         if args.config:
@@ -1935,6 +1972,10 @@ def _cmd_serve(args) -> int:
             ("route_learn", args.route_learn),
             ("route_shadow_rate", args.route_shadow_rate),
             ("route_registry", args.route_registry),
+            ("sessions", args.sessions),
+            ("session_lease_s", args.session_lease_s),
+            ("session_max", args.session_max),
+            ("session_max_per_tenant", args.session_max_per_tenant),
         ):
             if val is not None:
                 kwargs[key] = val

@@ -223,6 +223,29 @@ _DECLARATIONS: List[EnvVar] = [
        "rejected as malformed (a weight cap keeps objective values — "
        "and the tightening distance — bounded; also --opt-max-weight).",
        flag="--opt-max-weight", config_key="optMaxWeight"),
+    # --- stateful sessions (ISSUE 20) ------------------------------------
+    _v("DEPPY_TPU_SESSIONS", "str", "on", "deppy_tpu.service",
+       "Stateful resolution sessions: POST /v1/session + "
+       "/v1/session/{id}/op serve interactive assume/test/untest "
+       "exploration against a retained catalog epoch ('off' constructs "
+       "none of it — the endpoints 404 byte-identically, no session "
+       "metric family registers, /v1/resolve is untouched; also "
+       "--sessions).",
+       flag="--sessions", config_key="sessions"),
+    _v("DEPPY_TPU_SESSION_LEASE_S", "float", 300.0, "deppy_tpu.sessions",
+       "Session lease in seconds: every op renews it; the sweeper "
+       "expires sessions whose lease lapsed (also --session-lease-s).",
+       flag="--session-lease-s", config_key="sessionLeaseS"),
+    _v("DEPPY_TPU_SESSION_MAX", "int", 256, "deppy_tpu.sessions",
+       "Hard cap on live sessions per replica; at the cap, expired "
+       "sessions are LRU-evicted first and creation sheds 503 with a "
+       "counted shed once none remain (also --session-max).",
+       flag="--session-max", config_key="sessionMax"),
+    _v("DEPPY_TPU_SESSION_MAX_PER_TENANT", "int", 64, "deppy_tpu.sessions",
+       "Per-tenant session cap: unauthenticated session creation must "
+       "not become a memory DoS, so a tenant at its cap sheds 503 even "
+       "with global headroom (also --session-max-per-tenant).",
+       flag="--session-max-per-tenant", config_key="sessionMaxPerTenant"),
     # --- fleet (ISSUE 15) ------------------------------------------------
     _v("DEPPY_TPU_FLEET_REPLICAS", "str", None, "deppy_tpu.fleet.router",
        "Replica addresses the affinity router fronts, comma-separated "

@@ -90,6 +90,7 @@ KNOWN_POINTS = (
     "fleet.join_stream",
     "fleet.arc_flip",
     "router.peer_sync",
+    "sessions.op",
 )
 
 

@@ -92,14 +92,19 @@ def iter_chunks(shard: dict, chunk_entries: int) -> Iterator[dict]:
     truncated or corrupted chunk fails ITS import and re-sends whole,
     never poisoning the entries that already landed.
     """
+    has_sessions = "sessions" in shard
     entries: List[Tuple[str, dict]] = (
         [("index", e) for e in shard.get("index") or []]
-        + [("cache", e) for e in shard.get("cache") or []])
+        + [("cache", e) for e in shard.get("cache") or []]
+        + [("sessions", e) for e in shard.get("sessions") or []])
     step = max(int(chunk_entries), 1)
     for i in range(0, len(entries), step):
         part = entries[i:i + step]
         yield _seal([e for kind, e in part if kind == "index"],
-                    [e for kind, e in part if kind == "cache"])
+                    [e for kind, e in part if kind == "cache"],
+                    sessions=([e for kind, e in part
+                               if kind == "sessions"]
+                              if has_sessions else None))
 
 
 def _deliver_chunk(router, address: str, chunk: dict, retries: int) -> None:
@@ -180,7 +185,8 @@ def join_replica(router, address: str) -> dict:
         for chunk in iter_chunks(shard, chunk_entries):
             _deliver_chunk(router, address, chunk, retries)
             chunks += 1
-            entries += len(chunk["index"]) + len(chunk["cache"])
+            entries += len(chunk["index"]) + len(chunk["cache"]) \
+                + len(chunk.get("sessions") or [])
     # The atomic arc flip: membership mutates ONLY here, after the
     # whole stream landed.  A scripted fault at this point proves the
     # failure mode is "joiner never admitted", not "cold arcs live".

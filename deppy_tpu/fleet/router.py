@@ -71,7 +71,7 @@ PROBE_TIMEOUT_S = 2.0
 # as ONE tree in `deppy trace`), and response headers echoed back.
 FORWARD_HEADERS = ("Content-Type", "traceparent", "X-Deppy-Request-Id",
                    "X-Deppy-Tenant", "X-Deppy-Deadline-S",
-                   "X-Deppy-Timings")
+                   "X-Deppy-Timings", "X-Deppy-Session")
 ECHO_HEADERS = ("X-Deppy-Request-Id", "traceparent", "Retry-After")
 
 
@@ -604,7 +604,8 @@ class Router:
                     f"inheritor {owner} rejected warm-state shard "
                     f"(HTTP {s2}): {b2[:200]!r}")
             delivered[owner] = json.loads(b2).get("imported", {})
-            entries += len(shard["index"]) + len(shard["cache"])
+            entries += len(shard["index"]) + len(shard["cache"]) \
+                + len(shard.get("sessions") or [])
         with self._lock:
             st.drained = True
             if self.elastic:
@@ -625,11 +626,16 @@ class Router:
         telemetry.default_registry().event(
             "fault", fault="fleet_drain_handoff", replica=address,
             entries=entries, recipients=sorted(delivered))
-        return {"replica": address,
-                "index_entries": len(snapshot["index"]),
-                "cache_seeds": len(snapshot["cache"]),
-                "handed_off": entries,
-                "recipients": delivered}
+        out = {"replica": address,
+               "index_entries": len(snapshot["index"]),
+               "cache_seeds": len(snapshot["cache"]),
+               "handed_off": entries,
+               "recipients": delivered}
+        if "sessions" in snapshot:
+            # Conditional like the snapshot section itself: drains of
+            # session-free replicas keep the PR 15 response body.
+            out["sessions"] = len(snapshot["sessions"])
+        return out
 
     # ------------------------------------------------------------ metrics
 
@@ -822,6 +828,8 @@ def _router_handler(router: Router):
                 self._resolve()
             elif path in ("/v1/catalog/publish", "/v1/resolve/preview"):
                 self._fan_out(path)
+            elif path == "/v1/session" or path.startswith("/v1/session/"):
+                self._session(path)
             elif path == "/fleet/drain":
                 self._drain()
             elif path == "/fleet/join":
@@ -997,6 +1005,69 @@ def _router_handler(router: Router):
                 self._relay(status, body, hdrs)
                 return
             self._send(200, json.dumps({"results": results}).encode())
+
+        def _session(self, path: str) -> None:
+            """Session tier routing (ISSUE 20).  ``POST /v1/session``
+            routes by the catalog's family key — the same affinity walk
+            as a one-problem ``/v1/resolve``, so the session lands on
+            the replica already warm for that family.  Ops route by the
+            session's family key from the ``X-Deppy-Session`` header
+            (minted at create time, echoed by the client), so the hot
+            path never re-encodes the catalog.  Transport failures
+            retry once on the ring successor; an op whose retry lands
+            on a replica that does not hold the session surfaces a
+            clean 409 "session lost" — never a transport 502."""
+            is_create = path == "/v1/session"
+            router._c_requests.inc(
+                label="session" if is_create else "session_op")
+            raw = self._read_body()
+            if raw is None:
+                return
+            if is_create:
+                try:
+                    keys = doc_affinity_keys(json.loads(raw or b"null"))
+                except (ValueError, json.JSONDecodeError, KeyError,
+                        TypeError):
+                    # Unparseable/odd bodies forward untouched: the
+                    # replica renders the same 400 a single server
+                    # would.
+                    keys = [None]
+                key = keys[0] if keys else None
+            else:
+                key = self.headers.get("X-Deppy-Session") or None
+            headers = self._fwd_headers()
+            target = router.target_for(key)
+            tried: List[str] = []
+            out = None
+            while target is not None:
+                try:
+                    out = router.forward(target, "POST", path, raw,
+                                         headers)
+                except OSError:
+                    tried.append(target)
+                    if len(tried) > 1:
+                        out = None
+                        break
+                    router._c_retries.inc()
+                    target = router.target_for(key, exclude=tried)
+                    continue
+                break
+            if out is None:
+                self._send_json(503, {
+                    "error": "fleet: no replica reachable",
+                    "retry_after_s": max(router.probe_interval_s, 1.0)})
+                return
+            status, body, hdrs = out
+            if status == 404 and not is_create and tried:
+                # The holding replica died mid-session and the ring
+                # successor (which answered) has no such session: the
+                # retained state is gone, not the transport.  Clients
+                # see one unambiguous signal to re-create and replay.
+                self._send_json(409, {"error": "session lost"})
+                return
+            if status == 200:
+                router._c_routed.inc(label=target)
+            self._relay(status, body, hdrs)
 
         def _fan_out(self, path: str) -> None:
             """Publish / preview fan-out to every live replica."""

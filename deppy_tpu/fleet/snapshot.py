@@ -14,6 +14,13 @@ versioned, integrity-checked JSON document:
     UNSAT cores hold live constraint objects (not worth a codec for a
     rare, cheap-to-recompute case) and Incomplete entries are
     budget-relative; both re-solve cold once and re-enter the cache.
+  * **sessions** (ISSUE 20, only when the replica runs the session
+    tier): each live resolution session's retained problem, assumption
+    stack with its test-scope structure, remaining lease, and private
+    warm index — so interactive sessions survive elastic membership
+    changes.  The section is OPTIONAL and only present when a session
+    store was exported: snapshots from (and to) session-free builds
+    stay byte-identical.
 
 Every entry carries its family ``affinity`` key so the router can
 split a draining replica's snapshot across the replicas inheriting its
@@ -43,29 +50,65 @@ def _checksum(payload: dict) -> str:
     return hashlib.sha256(canon.encode()).hexdigest()
 
 
-def _seal(index_entries: List[dict], cache_seeds: List[dict]) -> dict:
+def _seal(index_entries: List[dict], cache_seeds: List[dict],
+          sessions: Optional[List[dict]] = None) -> dict:
     payload = {"version": SNAPSHOT_VERSION, "index": index_entries,
                "cache": cache_seeds}
+    if sessions is not None:
+        # Conditional key, checksummed when present: a session-free
+        # export stays byte-identical to pre-session snapshots, and a
+        # tampered sessions list fails verification like any other
+        # section.
+        payload["sessions"] = sessions
     return {**payload, "checksum": _checksum(payload)}
 
 
-def export_warm_state(scheduler) -> dict:
+def index_entry_to_dict(entry) -> dict:
+    """Serialize one clause-set-index entry (shared by the scheduler's
+    shared index and each session's private index — ISSUE 20)."""
+    return {
+        "key": entry.key,
+        "vocab_n": entry.vocab[0],
+        "vocab_ids": list(entry.vocab[1]),
+        "rows": [[list(k), n] for k, n in entry.rows.items()],
+        "model": [int(b) for b in entry.model],
+        "steps": entry.steps,
+        "backtracks": entry.backtracks,
+        "affinity": affinity_key(entry.vocab[1]),
+    }
+
+
+def import_index_entry(index, raw: dict) -> bool:
+    """Deserialize + import one index entry; ``True`` when admitted
+    (live state wins — a fresher local entry keeps its place).  Raises
+    :class:`SnapshotFormatError` on a malformed entry."""
+    import numpy as np
+
+    try:
+        from collections import Counter
+
+        rows = Counter({tuple(k): int(n) for k, n in raw["rows"]})
+        vocab = (int(raw["vocab_n"]),
+                 tuple(str(i) for i in raw["vocab_ids"]))
+        model = np.asarray(raw["model"], dtype=bool)
+        return index.import_entry(
+            str(raw["key"]), rows, vocab, model,
+            int(raw["steps"]), int(raw["backtracks"]))
+    except (KeyError, TypeError, ValueError) as e:
+        raise SnapshotFormatError(
+            f"malformed snapshot index entry: {e}") from e
+
+
+def export_warm_state(scheduler, sessions=None) -> dict:
     """Serialize one scheduler's warm tier.  Works with either store
-    absent (tier off): the corresponding section is just empty."""
+    absent (tier off): the corresponding section is just empty.  With a
+    session store (ISSUE 20) the live sessions ride along; without one
+    the document is byte-identical to the pre-session format."""
     index_entries: List[dict] = []
     index = getattr(scheduler, "incremental", None)
     if index is not None:
         for entry in index.export_entries():
-            index_entries.append({
-                "key": entry.key,
-                "vocab_n": entry.vocab[0],
-                "vocab_ids": list(entry.vocab[1]),
-                "rows": [[list(k), n] for k, n in entry.rows.items()],
-                "model": [int(b) for b in entry.model],
-                "steps": entry.steps,
-                "backtracks": entry.backtracks,
-                "affinity": affinity_key(entry.vocab[1]),
-            })
+            index_entries.append(index_entry_to_dict(entry))
     cache_seeds: List[dict] = []
     cache = getattr(scheduler, "cache", None)
     if cache is not None:
@@ -76,7 +119,10 @@ def export_warm_state(scheduler) -> dict:
                 "solution": solution,
                 "affinity": affinity_key(solution.keys()),
             })
-    return _seal(index_entries, cache_seeds)
+    session_entries = None
+    if sessions is not None:
+        session_entries = sessions.export_entries()
+    return _seal(index_entries, cache_seeds, sessions=session_entries)
 
 
 def verify_snapshot(doc) -> dict:
@@ -94,41 +140,34 @@ def verify_snapshot(doc) -> dict:
             'snapshot requires "index" and "cache" lists')
     payload = {"version": doc["version"], "index": doc["index"],
                "cache": doc["cache"]}
+    if "sessions" in doc:
+        if not isinstance(doc["sessions"], list):
+            raise SnapshotFormatError('"sessions" must be a list')
+        payload["sessions"] = doc["sessions"]
     if doc.get("checksum") != _checksum(payload):
         raise SnapshotFormatError(
             "snapshot integrity check failed (checksum mismatch)")
     return doc
 
 
-def import_warm_state(scheduler, doc) -> dict:
+def import_warm_state(scheduler, doc, sessions=None) -> dict:
     """Merge a verified snapshot into ``scheduler``'s warm tier.
 
     Live state wins: an index key already present keeps its (at least
     as fresh) local entry, and the exact cache's own supersede rules
-    apply to seeds.  Returns the merge accounting the endpoint
-    renders."""
-    import numpy as np
-
+    apply to seeds.  A ``sessions`` section imports into the given
+    session store (live session ids win; entries are dropped without
+    one — a session-free inheritor still takes the index/cache).
+    Returns the merge accounting the endpoint renders; the session
+    keys appear only when the document carried the section, so
+    pre-session snapshot responses stay byte-identical."""
     verify_snapshot(doc)
     index = getattr(scheduler, "incremental", None)
     idx_in = idx_skip = 0
     for raw in doc["index"]:
         if index is None:
             break
-        try:
-            from collections import Counter
-
-            rows = Counter({tuple(k): int(n) for k, n in raw["rows"]})
-            vocab = (int(raw["vocab_n"]),
-                     tuple(str(i) for i in raw["vocab_ids"]))
-            model = np.asarray(raw["model"], dtype=bool)
-            ok = index.import_entry(
-                str(raw["key"]), rows, vocab, model,
-                int(raw["steps"]), int(raw["backtracks"]))
-        except (KeyError, TypeError, ValueError) as e:
-            raise SnapshotFormatError(
-                f"malformed snapshot index entry: {e}") from e
-        if ok:
+        if import_index_entry(index, raw):
             idx_in += 1
         else:
             idx_skip += 1
@@ -147,8 +186,21 @@ def import_warm_state(scheduler, doc) -> dict:
             raise SnapshotFormatError(
                 f"malformed snapshot cache seed: {e}") from e
         seeds += 1
-    return {"index_imported": idx_in, "index_skipped": idx_skip,
-            "cache_seeds": seeds}
+    out = {"index_imported": idx_in, "index_skipped": idx_skip,
+           "cache_seeds": seeds}
+    if "sessions" in doc:
+        ses_in = ses_skip = 0
+        for raw in doc["sessions"]:
+            if sessions is None:
+                ses_skip += 1
+                continue
+            if sessions.import_entry(raw):
+                ses_in += 1
+            else:
+                ses_skip += 1
+        out["sessions_imported"] = ses_in
+        out["sessions_skipped"] = ses_skip
+    return out
 
 
 def split_snapshot(doc, assign: Callable[[str], Optional[str]]
@@ -158,14 +210,17 @@ def split_snapshot(doc, assign: Callable[[str], Optional[str]]
     so recipients verify integrity end to end.  Entries assigned None
     (no surviving owner) are dropped."""
     verify_snapshot(doc)
+    sections = ("index", "cache") + (("sessions",)
+                                     if "sessions" in doc else ())
     shards: Dict[str, Dict[str, List[dict]]] = {}
-    for section in ("index", "cache"):
+    for section in sections:
         for entry in doc[section]:
             owner = assign(entry.get("affinity"))
             if owner is None:
                 continue
-            shard = shards.setdefault(owner,
-                                      {"index": [], "cache": []})
+            shard = shards.setdefault(
+                owner, {s: [] for s in sections})
             shard[section].append(entry)
-    return {owner: _seal(s["index"], s["cache"])
+    return {owner: _seal(s["index"], s["cache"],
+                         sessions=s.get("sessions"))
             for owner, s in shards.items()}

@@ -26,6 +26,7 @@ from .clauseset import (  # noqa: F401
     DELTA_IDENTICAL,
     DELTA_MIXED,
     DELTA_RETRACTIVE,
+    DELTA_SCOPED,
     ClauseSetIndex,
     WarmPlan,
     problem_rows,

@@ -42,6 +42,10 @@ DELTA_IDENTICAL = "identical"
 DELTA_ADDITIVE = "additive"
 DELTA_RETRACTIVE = "retractive"
 DELTA_MIXED = "mixed"
+# ISSUE 20: a stateful session's scoped solve, planned from the delta
+# the session DECLARED (its assumption-stack diff) instead of from
+# per-row classification — the O(delta) fast path of plan_for_scope().
+DELTA_SCOPED = "scoped"
 
 # Nearest-entry search is a multiset intersection per candidate; bound
 # the scan to the most recent entries of the vocabulary bucket so a huge
@@ -171,16 +175,39 @@ def touched_cone(problem: Problem, seed_vars, extra_rows) -> np.ndarray:
 
 
 class _Entry:
-    __slots__ = ("key", "rows", "vocab", "model", "steps", "backtracks")
+    __slots__ = ("key", "_rows", "vocab", "model", "steps", "backtracks",
+                 "_problem")
 
-    def __init__(self, key: str, rows: "Counter[tuple]", vocab,
-                 model: np.ndarray, steps: int, backtracks: int):
+    def __init__(self, key: str, rows: "Optional[Counter[tuple]]", vocab,
+                 model: np.ndarray, steps: int, backtracks: int,
+                 problem: Optional[Problem] = None):
         self.key = key
-        self.rows = rows
+        # ``rows=None`` defers the per-row multiset to first use (ISSUE
+        # 20: a session's private-index store happens per interactive
+        # step, and the scoped planner never reads rows — only the
+        # generic-classifier fallback and the snapshot export do, so
+        # eager O(problem) hashing there is latency for nothing).
+        self._rows = rows
+        self._problem = problem if rows is None else None
         self.vocab = vocab
         self.model = model            # bool[n_vars], the final installed set
         self.steps = int(steps)
         self.backtracks = int(backtracks)
+
+    @property
+    def rows(self) -> "Counter[tuple]":
+        rows = self._rows
+        if rows is None:
+            prob = self._problem
+            if prob is None:
+                # Another thread materialized between our None check
+                # and the problem read — its assignment is ordered
+                # before the clear.
+                return self._rows
+            rows = problem_rows(prob)
+            self._rows = rows
+            self._problem = None
+        return rows
 
 
 class WarmPlan:
@@ -235,7 +262,8 @@ class ClauseSetIndex:
         self._c_delta = reg.counter(
             "deppy_incremental_delta_total",
             "Delta classifications against the clause-set index, by "
-            "class (identical / additive / retractive / mixed / none).",
+            "class (identical / additive / retractive / mixed / "
+            "scoped / none).",
             labelname="class")
         self._h_cone = reg.histogram(
             "deppy_incremental_cone_fraction",
@@ -252,20 +280,25 @@ class ClauseSetIndex:
     # ------------------------------------------------------------ store
 
     def store(self, key: str, problem: Problem, model: np.ndarray,
-              steps: int, backtracks: int) -> None:
+              steps: int, backtracks: int,
+              lazy_rows: bool = False) -> None:
         """Record one SAT solve.  Only zero-backtrack solves are
         warm-start seeds (the certification precondition), so anything
-        else is dropped here rather than filtered on every lookup."""
+        else is dropped here rather than filtered on every lookup.
+        ``lazy_rows=True`` (the scoped session store) defers the
+        O(problem) per-row hashing to first use — the scoped planner
+        never reads it."""
         if self.capacity == 0 or int(backtracks) != 0:
             return
-        rows = problem_rows(problem)
+        rows = None if lazy_rows else problem_rows(problem)
         vocab = vocab_key(problem)
         model = np.asarray(model, dtype=bool).copy()
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
                 self._entries[key] = _Entry(key, rows, vocab, model,
-                                            steps, backtracks)
+                                            steps, backtracks,
+                                            problem=problem)
                 # Refresh bucket recency too: the nearest-entry scan is
                 # bounded to the most recent bucket keys, and a cycling
                 # catalog re-stores old fingerprints — without the touch
@@ -275,7 +308,7 @@ class ClauseSetIndex:
                     bucket.move_to_end(key)
                 return
             self._admit_locked(_Entry(key, rows, vocab, model,
-                                      steps, backtracks))
+                                      steps, backtracks, problem=problem))
 
     def _admit_locked(self, entry: _Entry) -> None:
         """Insert a NEW entry (caller holds the lock; ``entry.key``
@@ -434,6 +467,63 @@ class ClauseSetIndex:
             if best_delta <= ACCEPT_DELTA:
                 break
         return best
+
+    # ------------------------------------------- scoped planning (ISSUE 20)
+
+    def plan_for_scope(self, problem: Problem, key: str, budget: int,
+                       entry_key: str, seed_vars) -> Optional[WarmPlan]:
+        """O(delta) warm planning for a stateful session's scoped solve.
+
+        A session KNOWS its delta: successive scoped solves differ from
+        each other only in the assumption-derived unit constraints on
+        the variables whose assumptions changed — ``seed_vars``, the
+        symmetric difference of the two assumption stacks.  That makes
+        the generic :meth:`plan` pipeline's per-row multiset hashing and
+        nearest-entry scan (both O(problem), paid per step) pure
+        overhead here: this path looks the declared predecessor up by
+        ``entry_key`` directly and closes the declared seed over
+        shared-literal adjacency, so the per-step planning cost scales
+        with the CHANGE, not the catalog.
+
+        Identity is preserved by construction plus certification: every
+        added/removed row is a unit constraint whose subject variable is
+        in ``seed_vars`` (per-subject clause ordinals shift only for
+        those same subjects), so the fixpoint cone contains every
+        differing row and off-cone rows are byte-identical between the
+        entry's problem and this one — the same decomposition invariant
+        :meth:`plan` establishes, with
+        :meth:`deppy_tpu.sat.host.HostEngine.solve_warm` still the
+        authoritative certifier (any imperfect plan falls back to a
+        cold solve, answers unchanged).  The serve gates — entry is a
+        zero-backtrack seed (enforced at :meth:`store`), cone fraction
+        under ``max_delta_ratio``, generous budget — are the generic
+        path's gates, unweakened."""
+        if self.capacity == 0:
+            return None
+        t0 = time.perf_counter()
+        with self._lock:
+            self._n_lookups += 1
+            entry = self._entries.get(entry_key)
+        plan = None
+        if entry is not None and entry.vocab == vocab_key(problem):
+            cone = touched_cone(problem, seed_vars, ())
+            fraction = float(cone.sum()) / max(problem.n_vars, 1)
+            if (fraction <= self.max_delta_ratio
+                    and int(budget) >= max(
+                        MIN_WARM_BUDGET,
+                        WARM_BUDGET_FACTOR * (entry.steps + 1))):
+                warm_assign = np.where(entry.model, 1, -1).astype(np.int8)
+                self._h_cone.observe(fraction)
+                plan = WarmPlan(problem, key, warm_assign, cone,
+                                DELTA_SCOPED, fraction, entry.key,
+                                entry.steps)
+        self._c_delta.inc(
+            label=DELTA_SCOPED if plan is not None else "none")
+        self._registry.record_span(
+            "incremental.delta", time.perf_counter() - t0,
+            klass=plan.klass if plan is not None else "none",
+            cone=int(plan.cone.sum()) if plan is not None else 0)
+        return plan
 
     # ------------------------------------------------- affected (ISSUE 14)
 

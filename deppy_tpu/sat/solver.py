@@ -22,17 +22,47 @@ Usage::
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 import time
+from collections import Counter
 from typing import List, Optional, Sequence
 
 from .. import telemetry
-from .constraints import Variable
-from .encode import Problem, encode
+from .constraints import Variable, mandatory, prohibited
+from .encode import Problem, encode, encode_assumed
 from .errors import Incomplete, InternalSolverError, NotSatisfiable
 from .host import HostEngine
 from .tracer import Tracer
+
+
+def assumed_variables(variables: Sequence[Variable],
+                      assumptions: Sequence[tuple]) -> List[Variable]:
+    """Derive the variable list a solve under ``assumptions`` answers
+    for: each ``(identifier, installed)`` assumption appends a
+    ``Mandatory`` (installed) or ``Prohibited`` (excluded) constraint to
+    its subject variable — the wire-level form of gini's assumption
+    literals (ISSUE 20).  The derived list is an ordinary problem: a
+    one-shot cold solve of it is byte-for-byte the oracle for the
+    scoped solve, and its unsat cores render the assumption as a real
+    applied constraint (``"x is mandatory"``) instead of a synthetic
+    literal."""
+    extra: dict = {}
+    for ident, installed in assumptions:
+        extra.setdefault(ident, []).append(
+            mandatory() if installed else prohibited())
+    if not extra:
+        return list(variables)
+    out = []
+    for v in variables:
+        added = extra.get(v.identifier)
+        if added:
+            out.append(Variable(v.identifier,
+                                tuple(v.constraints) + tuple(added)))
+        else:
+            out.append(v)
+    return out
 
 
 class Solver:
@@ -52,6 +82,8 @@ class Solver:
         backend: str = "auto",
         max_steps: Optional[int] = None,
         trace_cap: Optional[int] = None,
+        scheduler=None,
+        tenant: str = "default",
     ):
         self.problem: Problem = encode(variables)
         self.tracer = tracer
@@ -66,6 +98,17 @@ class Solver:
         # ISSUE 1): outcome, step/decision/propagation counters, and —
         # on the tensor backend — the driver's padding/escalation data.
         self.report: Optional[telemetry.SolveReport] = None
+        # ISSUE 20: an attached request scheduler makes the scope model
+        # engine-registry-aware — scoped solves route through
+        # ``Scheduler.submit_session`` (deadlines/breaker/fair admission
+        # and portfolio racing apply unchanged, and the shared result
+        # cache is bypassed) instead of being pinned to the inline host
+        # engine.  ``warm_index`` is the session's private clause-set
+        # index, handed to the scheduler so scoped solves warm-start
+        # from the session's own last model.
+        self.scheduler = scheduler
+        self.tenant = tenant
+        self.warm_index = None
 
     # ------------------------------------------- incremental (ISSUE 10)
     #
@@ -106,7 +149,151 @@ class Solver:
         returns the remaining scope depth."""
         return self._scope_engine().untest()
 
+    def assumptions(self) -> List[tuple]:
+        """The open assumption stack as ``(identifier, installed)``
+        pairs, in assumption order — empty when no scope is open.  The
+        facade's scope-owner is the host engine's literal stack, so
+        :meth:`untest` truncation is reflected here for free."""
+        eng = getattr(self, "_inc_engine", None)
+        if eng is None:
+            return []
+        vs = self.problem.variables
+        return [(vs[abs(lit) - 1].identifier, lit > 0)
+                for lit in eng._assumed_lits]
+
+    def scope_depth(self) -> int:
+        """Open :meth:`test` scopes (gini's scope depth)."""
+        eng = getattr(self, "_inc_engine", None)
+        return len(eng._test_scopes) if eng is not None else 0
+
+    def scope_state(self) -> tuple:
+        """``(assumptions, scopes, scope_base)`` — the full scope-stack
+        state for serialization (ISSUE 20 drain/join handoff):
+        ``assumptions`` as :meth:`assumptions` renders them, ``scopes``
+        the engine's pushed scope bases, ``scope_base`` the current
+        one.  Replayable through the public assume/test surface."""
+        eng = getattr(self, "_inc_engine", None)
+        if eng is None:
+            return [], [], 0
+        return (self.assumptions(), list(eng._test_scopes),
+                int(eng._scope_base))
+
+    def _scope_key(self, assumptions: Sequence[tuple]) -> str:
+        """Session-local lane key for a scoped solve: the base problem's
+        canonical fingerprint (paid ONCE per solver, memoized) salted
+        with the open assumption stack in order.  Scoped lanes bypass
+        the shared result cache in both directions, so this key's only
+        job is entry identity inside the session's private clause-set
+        index — which makes an O(assumptions) digest legitimate where
+        stateless lanes must pay the O(problem) ``fingerprint``.
+        Deterministic per (catalog, stack), so revisiting an assumption
+        state revisits its private-index entry."""
+        base = self.problem.__dict__.get("_scope_base_key")
+        if base is None:
+            from ..sched.cache import fingerprint
+
+            base = fingerprint(self.problem)
+            self.problem.__dict__["_scope_base_key"] = base
+        h = hashlib.sha256(base.encode())
+        for ident, installed in assumptions:
+            h.update(b"\x1f" + str(ident).encode("utf-8", "surrogatepass"))
+            h.update(b"+" if installed else b"-")
+        return "scope:" + h.hexdigest()
+
+    def _scope_plan_args(self, assumptions: Sequence[tuple]) -> tuple:
+        """``(session_key, scope_entry_key, scope_seed)`` for
+        ``Scheduler.submit_session``: this solve's session-local key,
+        the previous scoped solve's key (the declared warm predecessor
+        in the private index — None on the session's first solve), and
+        the variable indices whose assumptions CHANGED between the two
+        stacks (multiset symmetric difference, so a re-assumed pair
+        cancels and an assume-then-invert shows up once per side) — the
+        exact seed the O(delta) cone closure needs, because every
+        added/removed constraint row is a unit on one of these
+        subjects."""
+        key = self._scope_key(assumptions)
+        prev = getattr(self, "_scope_last", None)
+        if prev is None:
+            return key, None, ()
+        prev_key, prev_assumptions = prev
+        cur_c = Counter(assumptions)
+        prev_c = Counter(prev_assumptions)
+        seed = sorted({
+            idx for ident, _ in
+            list((cur_c - prev_c).keys()) + list((prev_c - cur_c).keys())
+            if (idx := self.problem.id_to_index.get(ident)) is not None})
+        return key, prev_key, tuple(seed)
+
+    def solve_scoped(self, deadline_s=None, stats: Optional[dict] = None):
+        """Solve under the OPEN assumption stack and return the raw
+        result object (solution dict / ``NotSatisfiable`` /
+        ``Incomplete`` — the scheduler-lane contract, un-decoded so a
+        serving layer can render it byte-identically to ``/v1/resolve``).
+
+        With a scheduler attached (ISSUE 20) the solve routes through
+        ``Scheduler.submit_session``: dedicated session class, registry
+        backends raced, deadlines/breaker/fair admission unchanged, the
+        shared result cache bypassed in BOTH directions (an
+        assumption-conditioned answer must never be admitted where
+        stateless traffic could read it — satellite 2), and warm starts
+        planned against ``self.warm_index`` when set — O(delta) against
+        the previous scoped solve's entry when one is on record, the
+        generic classifier otherwise.  Without one, the derived problem
+        solves on the host spec engine inline — the same answer, no
+        registry awareness.
+
+        The derived problem is lowered via ``encode_assumed`` — the
+        session IS the retained encoding, so the per-step lowering cost
+        is the assumption splice, not a catalog re-walk (differential
+        tests pin the splice byte-identical to a full ``encode``)."""
+        assumptions = self.assumptions()
+        p = encode_assumed(self.problem, assumptions)
+        if self.scheduler is not None:
+            key, entry_key, seed = self._scope_plan_args(assumptions)
+            try:
+                return self.scheduler.submit_session(
+                    p.variables, deadline_s=deadline_s,
+                    max_steps=self.max_steps, stats=stats,
+                    tenant=self.tenant, warm_index=self.warm_index,
+                    session_key=key, scope_entry_key=entry_key,
+                    scope_seed=seed, problem=p)
+            finally:
+                # Track the key/stack pair even for UNSAT/degraded
+                # answers: a missing private-index entry just means the
+                # next step's scoped plan misses and the generic
+                # classifier (then the cold path) answers.
+                self._scope_last = (key, list(assumptions))
+        if p.errors:
+            raise InternalSolverError(p.errors)
+        engine = HostEngine(p, max_steps=self.max_steps)
+        try:
+            installed, _ = engine.solve()
+        except (NotSatisfiable, Incomplete) as e:
+            if stats is not None:
+                stats["steps"] = engine.steps
+            return e
+        finally:
+            self.steps = engine.steps
+        if stats is not None:
+            stats["steps"] = engine.steps
+        solution = {v.identifier: False for v in p.variables}
+        for v in installed:
+            solution[v.identifier] = True
+        return solution
+
     def solve(self) -> List[Variable]:
+        if self.assumptions():
+            # ISSUE 20: a solve under an open scope answers for the
+            # ASSUMED problem (gini's Solve consumes assumptions; the
+            # pre-session facade silently ignored them).  Routed through
+            # solve_scoped so a scheduler-attached solver gets registry
+            # engines and the cache bypass; decoded back to the facade's
+            # installed-variables contract.
+            r = self.solve_scoped()
+            if isinstance(r, (NotSatisfiable, Incomplete)):
+                raise r
+            return [v for v in self.problem.variables
+                    if r.get(v.identifier)]
         backend = resolve_backend(self.backend, batch=False)
         if backend == "host":
             return self._solve_host()

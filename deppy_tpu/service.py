@@ -273,6 +273,10 @@ class Server:
         route_learn: Optional[str] = None,
         route_shadow_rate: Optional[float] = None,
         route_registry: Optional[str] = None,
+        sessions: Optional[str] = None,
+        session_lease_s: Optional[float] = None,
+        session_max: Optional[int] = None,
+        session_max_per_tenant: Optional[int] = None,
     ):
         self.backend = backend
         self.max_steps = max_steps
@@ -406,6 +410,28 @@ class Server:
                 self.scheduler, mode=route_learn,
                 shadow_rate=route_shadow_rate,
                 registry_path=route_registry,
+                replica=self.replica)
+        # Stateful resolution sessions (ISSUE 20): POST /v1/session +
+        # /v1/session/{id}/op serve interactive assume/test/untest
+        # exploration against a retained catalog epoch, with every
+        # incremental solve routed through the scheduler's dedicated
+        # session class (warm-started from the session's last model,
+        # raced across registry backends, deadline/breaker/fair
+        # semantics unchanged).  The tier exists only when the
+        # scheduler does; "off" constructs NONE of it — the endpoints
+        # 404 byte-identically to unknown paths, no session metric
+        # family registers, and /v1/resolve is untouched.
+        if sessions is None:
+            sessions = config.env_raw("DEPPY_TPU_SESSIONS", "on")
+        self.sessions = None
+        if self.scheduler is not None and str(sessions).strip().lower() \
+                not in ("off", "0", "false", "no"):
+            from .sessions import SessionStore
+
+            self.sessions = SessionStore(
+                self.scheduler, metrics=self.metrics.registry,
+                lease_s=session_lease_s, max_sessions=session_max,
+                max_per_tenant=session_max_per_tenant,
                 replica=self.replica)
         # Fault-domain knobs (ISSUE 2).  request_deadline_s: default
         # wall-clock budget per /v1/resolve (clients override per request
@@ -630,6 +656,68 @@ class Server:
             return 400, {"error": str(e)}
         return 200, {"optimize": out}
 
+    def session_document(self, path: str, doc,
+                         deadline_s: Optional[float] = None,
+                         tenant: str = "default") -> Tuple[int, dict]:
+        """Serve one session-tier request (ISSUE 20); returns
+        (http_status, response_doc) with :meth:`resolve_document`'s
+        error contract.  ``POST /v1/session`` creates a session from a
+        single-problem document; ``POST /v1/session/{id}/op`` drives
+        one assume/test/untest/resolve/explain op against the retained
+        state.  Solve-carrying ops pass the same fair-admission gate as
+        ``/v1/resolve`` (they join the scheduler queue like any other
+        request); creation sheds a counted 503 at the session caps."""
+        from .sessions.store import SessionError, SessionLost, SessionShed
+
+        if deadline_s is None:
+            deadline_s = self.request_deadline_s
+        if path == "/v1/session":
+            try:
+                out = self.sessions.create(doc, tenant=tenant)
+            except problem_io.ProblemFormatError as e:
+                self.metrics.observe_error()
+                return 400, {"error": str(e)}
+            except (DuplicateIdentifier, InternalSolverError) as e:
+                self.metrics.observe_error()
+                return 400, {"error": str(e)}
+            except SessionShed as e:
+                self.metrics.observe_error()
+                return 503, {
+                    "error": str(e),
+                    "retry_after_s": round(
+                        min(self.sessions.lease_s, 5.0), 3),
+                }
+            return 200, {"session": out}
+        rest = path[len("/v1/session/"):]
+        sid, _, tail = rest.partition("/")
+        if not sid or tail != "op":
+            return 404, {"error": "not found"}
+        op = doc.get("op") if isinstance(doc, dict) else None
+        if op in ("resolve", "explain"):
+            gate = self.admission_retry_after(deadline_s, tenant=tenant)
+            if gate is not None:
+                retry_after, msg = gate
+                self.metrics.observe_error()
+                return 503, {
+                    "error": msg,
+                    "retry_after_s": round(retry_after, 3),
+                }
+        try:
+            out = self.sessions.op(sid, doc, deadline_s=deadline_s)
+        except SessionLost:
+            # A clean miss, not an error burst: the router retries the
+            # ring successor once and renders a retried miss as the
+            # 409 "session lost" contract.
+            self.metrics.observe_error()
+            return 404, {"error": "unknown session"}
+        except SessionError as e:
+            self.metrics.observe_error()
+            return 400, {"error": str(e)}
+        except (DuplicateIdentifier, InternalSolverError) as e:
+            self.metrics.observe_error()
+            return 400, {"error": str(e)}
+        return 200, out
+
     def _on_leader_change(self, leading: bool) -> None:
         self.metrics.leader = leading
         print(f"[service] HA election: "
@@ -798,6 +886,11 @@ class Server:
             drain_s = self._drain_s
         if drain_s > 0:
             self._idle.wait(drain_s)
+        if self.sessions is not None:
+            # Stop the lease sweeper before the scheduler: a sweep
+            # racing scheduler teardown buys nothing, and embedded
+            # servers in tests must not leak sweeper threads.
+            self.sessions.stop()
         if self.scheduler is not None:
             # After the drain: in-flight requests are parked on their
             # queue groups, and stopping first would orphan them.  A
@@ -937,7 +1030,8 @@ def _api_handler(server: Server):
                 from .fleet import export_warm_state
 
                 self._send(200, json.dumps(
-                    export_warm_state(server.scheduler)),
+                    export_warm_state(server.scheduler,
+                                      sessions=server.sessions)),
                     "application/json")
             else:
                 self._send_json(404, {"error": "not found"})
@@ -988,7 +1082,8 @@ def _api_handler(server: Server):
 
                 server._enter_request()
                 try:
-                    out = import_warm_state(server.scheduler, doc)
+                    out = import_warm_state(server.scheduler, doc,
+                                            sessions=server.sessions)
                 except SnapshotFormatError as e:
                     server.metrics.observe_error()
                     self._send_json(400, {"error": str(e)})
@@ -1073,6 +1168,20 @@ def _api_handler(server: Server):
                 if server.replica is not None:
                     out["replica"] = server.replica
                 self._send_json(200, out)
+                return
+            if self.path == "/v1/session" \
+                    or self.path.startswith("/v1/session/"):
+                # Stateful resolution sessions (ISSUE 20).  With the
+                # tier off these paths 404 exactly like any unknown
+                # path — pre-change behavior byte for byte.
+                if server.sessions is None:
+                    self._send_json(404, {"error": "not found"})
+                    return
+                server._enter_request()
+                try:
+                    self._session_request()
+                finally:
+                    server._exit_request()
                 return
             self._send_json(404, {"error": "not found"})
 
@@ -1239,6 +1348,74 @@ def _api_handler(server: Server):
             except Exception as e:  # same contract as /v1/resolve: a
                 # runtime failure is a visible 500, not a dropped
                 # connection.
+                server.metrics.observe_error()
+                status, resp = 500, {"error": f"internal error: {e}"}
+            return self._send_json(status, resp)
+
+        def _session_request(self):
+            """POST /v1/session and /v1/session/{id}/op (ISSUE 20) —
+            the /v1/resolve request envelope (trace context, tenant
+            identity, deadline header, SLO accounting) around the
+            session store, so interactive exploration cost is
+            attributable per tenant exactly like one-shot resolution
+            cost."""
+            inbound_tp = self.headers.get("traceparent")
+            inbound_rid = self.headers.get("X-Deppy-Request-Id")
+            ctx = telemetry.trace.context_from_headers(inbound_tp,
+                                                       inbound_rid)
+            self._trace_ctx = ctx
+            self._echo_ids = inbound_tp is not None \
+                or inbound_rid is not None
+            self._echo_traceparent = inbound_tp is not None
+            tenant = profiling.sanitize_tenant(
+                self.headers.get("X-Deppy-Tenant"))
+            timings: dict = {}
+            t0 = time.perf_counter()
+            reg = telemetry.default_registry()
+            status = None
+            try:
+                span_attrs = {"path": self.path,
+                              "request_id": ctx.request_id,
+                              "tenant": tenant}
+                if server.replica is not None:
+                    span_attrs["replica"] = server.replica
+                with telemetry.trace.activate(ctx), \
+                        reg.span("service.request", **span_attrs) as sp:
+                    status = self._session_request_inner(tenant)
+                    sp["status"] = status
+            finally:
+                timings["total_s"] = time.perf_counter() - t0
+                server.metrics.observe_request(timings["total_s"], None)
+                server.slo.observe(
+                    tenant, timings["total_s"],
+                    deadline_miss=False,
+                    error=status is None or status >= 500)
+                telemetry.trace.default_recorder().record(
+                    ctx, status=status, timings=timings)
+
+        def _session_request_inner(self, tenant) -> int:
+            deadline_s = None
+            raw_deadline = self.headers.get("X-Deppy-Deadline-S")
+            if raw_deadline is not None:
+                import math
+
+                try:
+                    deadline_s = float(raw_deadline)
+                except ValueError:
+                    deadline_s = None
+                if deadline_s is None or not math.isfinite(deadline_s):
+                    server.metrics.observe_error()
+                    return self._send_json(
+                        400, {"error": "invalid X-Deppy-Deadline-S header"})
+            doc, err = self._read_json_body()
+            if err is not None:
+                return err
+            try:
+                status, resp = server.session_document(
+                    self.path, doc, deadline_s=deadline_s, tenant=tenant)
+            except Exception as e:  # same contract as /v1/resolve: a
+                # runtime failure (including an injected sessions.op
+                # fault) is a visible 500, not a dropped connection.
                 server.metrics.observe_error()
                 status, resp = 500, {"error": f"internal error: {e}"}
             return self._send_json(status, resp)
@@ -1420,6 +1597,10 @@ def serve(
     route_learn: Optional[str] = None,
     route_shadow_rate: Optional[float] = None,
     route_registry: Optional[str] = None,
+    sessions: Optional[str] = None,
+    session_lease_s: Optional[float] = None,
+    session_max: Optional[int] = None,
+    session_max_per_tenant: Optional[int] = None,
 ) -> None:
     """Blocking entry point used by ``deppy serve`` (the analog of
     mgr.Start, main.go:85).  Exits cleanly on SIGTERM (how Kubernetes
@@ -1448,7 +1629,10 @@ def serve(
                  opt_max_weight=opt_max_weight,
                  route_learn=route_learn,
                  route_shadow_rate=route_shadow_rate,
-                 route_registry=route_registry)
+                 route_registry=route_registry,
+                 sessions=sessions, session_lease_s=session_lease_s,
+                 session_max=session_max,
+                 session_max_per_tenant=session_max_per_tenant)
     srv.start()
     stop = threading.Event()
 

@@ -1,0 +1,22 @@
+"""Stateful resolution sessions (ISSUE 20).
+
+The interactive twin of the stateless ``/v1/resolve`` path: a client
+creates a session pinned to a catalog epoch (the encoded problem and
+its decode vocabulary retained server-side under a lease), then drives
+gini-style ``assume`` / ``test`` / ``untest`` / ``resolve`` /
+``explain`` ops against the retained state instead of re-sending the
+whole catalog per question.  Every incremental solve routes through
+the request scheduler's dedicated session class — warm-started from
+the session's own last model, raced across registry backends, subject
+to deadlines/breaker/fair admission unchanged — and answers
+byte-identically to the equivalent one-shot cold resolve.
+
+Sessions are warm state like everything else in the fleet: keyed by
+family so the affinity ring routes every op to the replica holding
+them, exported/imported in the drain/join snapshot stream, expired by
+lease with a sweeper, and bounded per tenant.
+"""
+
+from .store import Session, SessionStore
+
+__all__ = ["Session", "SessionStore"]

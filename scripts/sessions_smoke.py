@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Stateful resolution sessions smoke (`make sessions-smoke`, ISSUE 20
+acceptance).
+
+A live 2-replica fleet behind the affinity router, end to end:
+
+  * **byte-identity** — an interactive assume/test/resolve/untest walk
+    driven through ``POST /v1/session/{id}/op`` answers, at every
+    solve-carrying step, exactly what a one-shot cold
+    ``POST /v1/resolve`` of the client-derived document (catalog +
+    assumptions as mandatory/prohibited constraints) answers through
+    the same router;
+  * **drain survival** — a live ``POST /fleet/drain`` of the replica
+    holding the session re-homes it onto the arc inheritor
+    (``"sessions"`` counted in the drain response) and the SAME op
+    stream continues against the same id/key, answers unchanged;
+  * **lease expiry** — a short-leased session is reaped by the
+    background sweeper and the expiry is visible on ``/metrics``
+    (``deppy_session_expired_total`` up, ``deppy_session_active``
+    back to zero);
+  * **off-switch** — a ``sessions=off`` server 404s ``POST
+    /v1/session`` byte-identically to any unknown path and registers
+    no ``deppy_session_*`` metric family at all.
+
+Fast on purpose — the subsystem suite is ``make test-sessions``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from http.client import HTTPConnection
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def request(port: int, method: str, path: str, body=None, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=60)
+    h = dict(headers or {})
+    payload = None
+    if body is not None:
+        payload = json.dumps(body)
+        h.setdefault("Content-Type", "application/json")
+    conn.request(method, path, body=payload, headers=h)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def metric(text: str, name: str):
+    total = None
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            total = (total or 0.0) + float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def scrape(port: int) -> str:
+    _, data = request(port, "GET", "/metrics")
+    return data.decode()
+
+
+def catalog_doc(name: str = "sm", bundles: int = 3, size: int = 4) -> dict:
+    """A small multi-bundle catalog with enough optional structure that
+    assumptions genuinely change the answer (the test suite's shape)."""
+    variables = []
+    for b in range(bundles):
+        for j in range(size):
+            cons = []
+            if j == 0 and b == 0:
+                cons.append({"type": "mandatory"})
+            if j < size - 1:
+                cons.append({"type": "dependency",
+                             "ids": [f"{name}b{b}v{j + 1}",
+                                     f"{name}b{(b + 1) % bundles}v{j + 1}"]})
+            variables.append({"id": f"{name}b{b}v{j}", "constraints": cons})
+    return {"variables": variables}
+
+
+def derived_doc(doc: dict, assumptions) -> dict:
+    """The client-side one-shot equivalent of the session's open
+    assumptions: each (id, installed) appends a mandatory/prohibited
+    constraint to its subject variable — the oracle document."""
+    extra: dict = {}
+    for ident, installed in assumptions:
+        extra.setdefault(ident, []).append(
+            {"type": "mandatory" if installed else "prohibited"})
+    variables = []
+    for v in doc["variables"]:
+        added = extra.get(v["id"])
+        cons = list(v.get("constraints") or [])
+        if added:
+            cons = cons + added
+        variables.append({"id": v["id"], "constraints": cons})
+    return {"variables": variables}
+
+
+def canon(obj) -> str:
+    return json.dumps(obj, sort_keys=True)
+
+
+def main() -> None:
+    from deppy_tpu.fleet import Router
+    from deppy_tpu.service import Server
+
+    # ---------------------------------------------- 2-replica fleet boot
+    replicas = [Server(bind_address="127.0.0.1:0",
+                       probe_address="127.0.0.1:0", backend="host",
+                       sched="on", replica=f"r{i}") for i in range(2)]
+    for r in replicas:
+        r.start()
+    addrs = [f"127.0.0.1:{r.api_port}" for r in replicas]
+    router = Router(bind_address="127.0.0.1:0", replicas=addrs,
+                    probe_interval_s=3600.0)
+    router.start()
+    expiry_srv = off_srv = None
+    try:
+        doc = catalog_doc()
+        status, body = request(router.api_port, "POST", "/v1/session", doc)
+        if status != 200:
+            fail(f"session create via router: HTTP {status} {body[:200]!r}")
+        created = json.loads(body)["session"]
+        sid, key = created["id"], created["key"]
+        op_path = f"/v1/session/{sid}/op"
+        hdr = {"X-Deppy-Session": key}
+        print(f"created session {sid} (n_vars={created['n_vars']}, "
+              f"lease {created['lease_s']}s) via router :{router.api_port}")
+
+        # ------------------------- interactive walk, oracle per resolve
+        assumptions = []
+        walk = [
+            {"op": "assume", "identifiers": ["smb1v0"]},
+            {"op": "test"},
+            {"op": "resolve"},
+            {"op": "untest"},
+            {"op": "assume", "identifiers": ["smb2v1"], "installed": False},
+            {"op": "resolve"},
+        ]
+        checked = 0
+        last_answer = None
+        for step in walk:
+            status, body = request(router.api_port, "POST", op_path,
+                                   step, headers=hdr)
+            if status != 200:
+                fail(f"op {step['op']}: HTTP {status} {body[:200]!r}")
+            out = json.loads(body)
+            if step["op"] == "assume":
+                assumptions += [(i, step.get("installed", True))
+                                for i in step["identifiers"]]
+            elif step["op"] == "untest":
+                # The popped scope owned every assumption above its
+                # base; this walk opened it before any assume, so the
+                # mirror empties (exactly the facade's scope rule).
+                assumptions = assumptions[:0]
+            if step["op"] not in ("resolve", "explain"):
+                continue
+            status, oracle_body = request(
+                router.api_port, "POST", "/v1/resolve",
+                derived_doc(doc, assumptions))
+            if status != 200:
+                fail(f"oracle resolve: HTTP {status}")
+            oracle = json.loads(oracle_body)["results"][0]
+            if canon(out["result"]) != canon(oracle):
+                fail(f"session resolve diverged from the one-shot "
+                     f"oracle under {assumptions}:\n  session: "
+                     f"{canon(out['result'])}\n  oracle:  {canon(oracle)}")
+            checked += 1
+            last_answer = out["result"]
+        print(f"byte-identity: {checked} session solves == one-shot "
+              f"/v1/resolve oracle ({len(walk)} ops walked)")
+
+        # ------------------------------------------------ drain survival
+        holder = next(r for r in replicas
+                      if r.sessions is not None and r.sessions.active())
+        survivor = next(r for r in replicas if r is not holder)
+        status, body = request(
+            router.api_port, "POST", "/fleet/drain",
+            {"replica": f"127.0.0.1:{holder.api_port}"})
+        if status != 200:
+            fail(f"drain: HTTP {status} {body[:200]!r}")
+        drained = json.loads(body)["drain"]
+        if not drained.get("sessions"):
+            fail(f"drain handed off no sessions: {drained}")
+        if survivor.sessions.active() != 1:
+            fail("survivor does not hold the drained session")
+        status, body = request(router.api_port, "POST", op_path,
+                               {"op": "resolve"}, headers=hdr)
+        if status != 200:
+            fail(f"post-drain resolve: HTTP {status} {body[:200]!r}")
+        if canon(json.loads(body)["result"]) != canon(last_answer):
+            fail("post-drain resolve diverged from the pre-drain answer")
+        print(f"drain survival: {drained['sessions']} session re-homed "
+              f"to the arc inheritor, same id/key answers unchanged")
+
+        # ------------------------------------- lease expiry on /metrics
+        expiry_srv = Server(bind_address="127.0.0.1:0",
+                            probe_address="127.0.0.1:0", backend="host",
+                            sched="on", session_lease_s=0.1)
+        expiry_srv.start()
+        status, _ = request(expiry_srv.api_port, "POST", "/v1/session",
+                            catalog_doc("ex"))
+        if status != 200:
+            fail(f"short-lease create: HTTP {status}")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            text = scrape(expiry_srv.api_port)
+            if (metric(text, "deppy_session_expired_total") or 0.0) >= 1.0:
+                break
+            time.sleep(0.05)
+        else:
+            fail("sweeper never expired the short-leased session "
+                 "(deppy_session_expired_total stayed 0)")
+        if metric(text, "deppy_session_active") != 0.0:
+            fail("deppy_session_active nonzero after expiry")
+        print("lease expiry: sweeper reaped the 0.1s-leased session "
+              "(deppy_session_expired_total >= 1, active back to 0)")
+
+        # ------------------------------------------------- off-switch
+        off_srv = Server(bind_address="127.0.0.1:0",
+                         probe_address="127.0.0.1:0", backend="host",
+                         sched="on", sessions="off")
+        off_srv.start()
+        s1, b1 = request(off_srv.api_port, "POST", "/v1/session",
+                         catalog_doc())
+        s2, b2 = request(off_srv.api_port, "POST", "/v1/no-such-path", {})
+        if (s1, b1) != (404, b2) or s2 != 404:
+            fail(f"sessions=off create was not byte-identical to an "
+                 f"unknown path: {s1} {b1!r} vs {s2} {b2!r}")
+        if "deppy_session" in scrape(off_srv.api_port):
+            fail("sessions=off scrape registered a deppy_session_* family")
+        print("off-switch: sessions=off 404s byte-identically, no "
+              "deppy_session_* family on /metrics")
+        print("PASS: sessions smoke")
+    finally:
+        router.shutdown()
+        for r in replicas:
+            try:
+                r.shutdown()
+            # deppy: lint-ok[exception-hygiene] smoke teardown must reach every replica
+            except Exception:
+                pass
+        for extra in (expiry_srv, off_srv):
+            if extra is not None:
+                extra.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,7 @@ CODE_SCOPE = [
     REPO / "deppy_tpu" / "profile",
     REPO / "deppy_tpu" / "optimize",
     REPO / "deppy_tpu" / "routes",
+    REPO / "deppy_tpu" / "sessions",
     REPO / "deppy_tpu" / "service.py",
     REPO / "deppy_tpu" / "engine" / "driver.py",
 ]

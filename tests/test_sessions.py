@@ -1,0 +1,692 @@
+"""Stateful resolution sessions (ISSUE 20).
+
+The interactive serving tier's contracts:
+
+  * **Fuzz differential** — random assume/test/untest/resolve scripts
+    driven against a session answer every incremental solve
+    byte-identically to a fresh one-shot cold resolve of the derived
+    problem (assumptions materialized as Mandatory/Prohibited
+    constraints), warm-started and raced backends included.
+  * **Scope/cache isolation** (satellite) — a solve inside an open
+    test scope is never admitted to the shared exact LRU or clause-set
+    index; the scheduler-free facade agrees with the scheduler path.
+  * **Lifecycle** — leases expire (sweeper and lazily), per-tenant and
+    global caps shed with counted evictions, live sessions are never
+    evicted.
+  * **Handoff** — sessions export/import through the drain/join
+    snapshot stream (checksummed, live-wins) and survive a live drain
+    through the router; ops to a dead replica surface a clean 409
+    "session lost", never a transport 502.
+  * **Off-switch** — DEPPY_TPU_SESSIONS=off constructs nothing: the
+    endpoints 404 byte-identically to any unknown path and no
+    session metric family registers.
+  * **Chaos** — the ``sessions.op`` fault point makes op failures a
+    visible 500 with the store still serving afterwards.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+from http.client import HTTPConnection
+
+import pytest
+
+from deppy_tpu import faults, telemetry
+from deppy_tpu import io as problem_io
+from deppy_tpu.sat.solver import Solver, assumed_variables
+from deppy_tpu.sched import Scheduler
+from deppy_tpu.service import Server
+from deppy_tpu.sessions import SessionStore
+from deppy_tpu.sessions.store import SessionError, SessionLost, SessionShed
+
+pytestmark = pytest.mark.sessions
+
+
+@pytest.fixture(autouse=True)
+def fresh_fault_state():
+    prev_breaker = faults.set_default_breaker(faults.CircuitBreaker())
+    prev_plan = faults.configure_plan(None)
+    prev_reg = telemetry.set_default_registry(telemetry.Registry())
+    yield
+    telemetry.set_default_registry(prev_reg)
+    faults.configure_plan(prev_plan)
+    faults.set_default_breaker(prev_breaker)
+
+
+# --------------------------------------------------------------- helpers
+
+
+def _catalog_doc(name: str = "s", bundles: int = 3, size: int = 4) -> dict:
+    """A small multi-bundle catalog: bundle 0 is mandatory with a
+    preference chain, the rest are optional dependency chains — enough
+    freedom that assumptions genuinely change the answer."""
+    variables = []
+    for b in range(bundles):
+        for j in range(size):
+            cons = []
+            if j == 0 and b == 0:
+                cons.append({"type": "mandatory"})
+            if j < size - 1:
+                cons.append({"type": "dependency",
+                             "ids": [f"{name}b{b}v{j + 1}",
+                                     f"{name}b{(b + 1) % bundles}v{j + 1}"]})
+            variables.append({"id": f"{name}b{b}v{j}",
+                              "constraints": cons})
+    return {"variables": variables}
+
+
+def _oracle(scheduler, variables, assumptions) -> dict:
+    """The one-shot cold-resolve answer for the ASSUMED problem, as
+    /v1/resolve renders it — the byte-identity reference."""
+    derived = assumed_variables(variables, assumptions)
+    [r] = scheduler.submit([derived])
+    return problem_io.result_to_dict(r)
+
+
+def _request(port, method, path, body=None, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    h = dict(headers or {})
+    payload = None
+    if body is not None:
+        payload = json.dumps(body)
+        h.setdefault("Content-Type", "application/json")
+    conn.request(method, path, body=payload, headers=h)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _host_server(**kw):
+    srv = Server(bind_address="127.0.0.1:0",
+                 probe_address="127.0.0.1:0", backend="host", **kw)
+    srv.start()
+    return srv
+
+
+@pytest.fixture
+def sched():
+    s = Scheduler(backend="host", speculate="off", portfolio="off")
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def store(sched):
+    st = SessionStore(sched, metrics=telemetry.Registry(),
+                      sweep_interval_s=3600.0)
+    yield st
+    st.stop()
+
+
+# ------------------------------------------------- scoped-solve isolation
+
+
+class TestScopedSolveIsolation:
+    """Satellite: Solver.test/untest scope interaction with the PR 9
+    result cache — an assumption-conditioned answer must never be
+    admitted to the shared exact LRU or the clause-set index."""
+
+    def test_scoped_solve_never_admitted_to_shared_caches(self, sched):
+        from deppy_tpu.sat.encode import encode
+        from deppy_tpu.sched.cache import MISS, fingerprint
+
+        variables = problem_io.problem_from_dict(_catalog_doc("iso"))
+        solver = Solver(variables, scheduler=sched)
+        solver.assume("isob1v0")
+        assert solver.test() in (1, 0)
+        r = solver.solve_scoped()
+        assert isinstance(r, dict) and r["isob1v0"]
+        from deppy_tpu.engine.driver import _budget
+
+        derived = encode(assumed_variables(
+            variables, [("isob1v0", True)]))
+        key = fingerprint(derived)
+        hit, _ = sched.cache.lookup_or_plan(
+            derived, key, int(_budget(sched.max_steps)))
+        assert hit is MISS, \
+            "scoped solve leaked into the shared exact LRU"
+        assert all(e.key != key
+                   for e in sched.incremental.export_entries()), \
+            "scoped solve leaked into the shared clause-set index"
+        solver.untest()
+
+    def test_unscoped_solve_still_admitted(self, sched):
+        from deppy_tpu.sat.encode import encode
+        from deppy_tpu.sched.cache import MISS, fingerprint
+
+        from deppy_tpu.engine.driver import _budget
+
+        variables = problem_io.problem_from_dict(_catalog_doc("adm"))
+        [_] = sched.submit([variables])
+        p = encode(variables)
+        hit, _ = sched.cache.lookup_or_plan(
+            p, fingerprint(p), int(_budget(sched.max_steps)))
+        assert hit is not MISS
+
+    def test_facade_solve_respects_open_assumptions(self, sched):
+        """solve() under an open scope answers for the ASSUMED problem
+        (gini Solve consumes assumptions) — scheduler path and the
+        scheduler-free inline path agree."""
+        variables = problem_io.problem_from_dict(_catalog_doc("fac"))
+        for s in (Solver(variables, scheduler=sched), Solver(variables)):
+            s.assume("facb2v0")
+            s.test()
+            names = {v.identifier for v in s.solve()}
+            assert "facb2v0" in names
+            s.untest()
+            assert "facb2v0" not in {v.identifier for v in s.solve()}
+
+    def test_session_resolve_unsat_strings_match_oneshot(self, sched):
+        """Conflicting assumptions produce the SAME rendered unsat core
+        as the one-shot resolve of the derived document."""
+        variables = problem_io.problem_from_dict(_catalog_doc("uns"))
+        solver = Solver(variables, scheduler=sched)
+        solver.assume("unsb1v1")
+        solver.assume("unsb1v1", installed=False)
+        got = problem_io.result_to_dict(solver.solve_scoped())
+        want = _oracle(sched, variables,
+                       [("unsb1v1", True), ("unsb1v1", False)])
+        assert got == want
+        assert got["status"] == "unsat"
+
+
+# ------------------------------------------- encode_assumed differential
+
+
+class TestEncodeAssumedDifferential:
+    """Pin for the O(delta) session lowering: ``encode_assumed`` (splice
+    the assumption constraints into an already-encoded problem's
+    tensors) must produce the SAME Problem — every dense tensor, the
+    rendered applied-constraint list, the variable vocabulary, and the
+    error list — as the generic path ``encode(assumed_variables(...))``
+    that re-encodes the derived catalog from scratch."""
+
+    TENSORS = ["clauses", "clause_con", "card_ids", "card_n",
+               "card_act", "card_con", "anchors", "choice_cand",
+               "var_choices"]
+
+    def _random_catalog(self, rng, n):
+        from deppy_tpu.sat import constraints as C
+
+        ids = [f"v{i}" for i in range(n)]
+        variables = []
+        for i, ident in enumerate(ids):
+            cons = []
+            others = ids[:i] + ids[i + 1:]
+            if rng.random() < 0.15:
+                cons.append(C.mandatory())
+            if rng.random() < 0.05:
+                cons.append(C.prohibited())
+            if others and rng.random() < 0.5:
+                deps = rng.sample(others,
+                                  min(rng.randint(1, 3), len(others)))
+                cons.append(C.dependency(*deps))
+            if others and rng.random() < 0.25:
+                cons.append(C.conflict(rng.choice(others)))
+            if others and rng.random() < 0.2:
+                members = rng.sample(others,
+                                     min(rng.randint(2, 4), len(others)))
+                cons.append(C.at_most(rng.randint(1, len(members)),
+                                      *members))
+            variables.append(C.variable(ident, *cons))
+        return ids, variables
+
+    def test_splice_matches_generic_reencode(self):
+        import numpy as np
+
+        from deppy_tpu.sat.encode import encode, encode_assumed
+
+        rng = random.Random(0x20AD)
+        for trial in range(60):
+            ids, variables = self._random_catalog(rng, rng.randint(2, 14))
+            base = encode(variables)
+            k = rng.randint(0, 6)
+            assumptions = []
+            for _ in range(k):
+                # Unknown identifiers are dropped by both paths;
+                # repeats on one subject must splice in stack order.
+                ident = ("nope" if rng.random() < 0.1
+                         else rng.choice(ids))
+                assumptions.append((ident, rng.random() < 0.6))
+            got = encode_assumed(base, assumptions)
+            want = encode(assumed_variables(variables, assumptions))
+            ctx = f"trial {trial}: {assumptions}"
+            for name in self.TENSORS:
+                assert np.array_equal(getattr(got, name),
+                                      getattr(want, name)), \
+                    f"{ctx}: tensor {name} diverged"
+            assert ([str(a) for a in got.applied]
+                    == [str(a) for a in want.applied]), ctx
+            assert ([v.identifier for v in got.variables]
+                    == [v.identifier for v in want.variables]), ctx
+            assert got.errors == want.errors, ctx
+
+    def test_no_assumptions_returns_problem_unchanged(self):
+        from deppy_tpu.sat.encode import encode, encode_assumed
+
+        _, variables = self._random_catalog(random.Random(7), 6)
+        p = encode(variables)
+        assert encode_assumed(p, []) is p
+        assert encode_assumed(p, [("nope", True)]) is p
+
+
+# ------------------------------------------------------ fuzz differential
+
+
+class TestFuzzDifferential:
+    """The tentpole pin: every incremental solve a random
+    assume/test/untest/resolve script produces answers byte-identically
+    to a fresh one-shot cold resolve of the equivalent derived problem
+    — warm-started follow-ups included (the session's private index
+    serves repeat solves; answers must not drift)."""
+
+    def test_fuzz_vs_oneshot_oracle(self, sched, store):
+        variables = problem_io.problem_from_dict(
+            _catalog_doc("fz", bundles=3, size=4))
+        idents = [v["id"] for v in _catalog_doc("fz", 3, 4)["variables"]]
+        for seed in range(3):
+            rng = random.Random(0xD9 + seed)
+            created = store.create(_catalog_doc("fz", 3, 4))
+            sid = created["id"]
+            # Mirror of the engine's scope stack: test() pushes the
+            # previous base (the scope owns assumptions added since the
+            # PREVIOUS test); untest() truncates back to that base.
+            assumptions = []
+            scopes = []
+            base = 0
+            resolves = 0
+            for _ in range(14):
+                op = rng.choice(
+                    ["assume", "assume", "test", "untest", "resolve"])
+                if op == "assume":
+                    ident = rng.choice(idents)
+                    installed = rng.random() < 0.7
+                    out = store.op(sid, {
+                        "op": "assume", "identifiers": [ident],
+                        "installed": installed})
+                    assumptions.append((ident, installed))
+                    assert out["assumed"] == len(assumptions)
+                elif op == "test":
+                    out = store.op(sid, {"op": "test"})
+                    scopes.append(base)
+                    base = len(assumptions)
+                    assert out["depth"] == len(scopes)
+                    assert out["result"] in (1, -1, 0)
+                elif op == "untest":
+                    if not scopes:
+                        with pytest.raises(SessionError):
+                            store.op(sid, {"op": "untest"})
+                        continue
+                    out = store.op(sid, {"op": "untest"})
+                    base = scopes.pop()
+                    del assumptions[base:]
+                    assert out["depth"] == len(scopes)
+                else:
+                    out = store.op(sid, {"op": "resolve"})
+                    want = _oracle(sched, variables, assumptions)
+                    assert out["result"] == want, \
+                        f"seed {seed}: drift under {assumptions}"
+                    resolves += 1
+            assert resolves > 0
+
+    def test_repeat_resolve_warm_identical(self, sched, store):
+        """Second identical resolve may warm-start from the session's
+        private index — the answer must be byte-identical either way."""
+        sid = store.create(_catalog_doc("wm"))["id"]
+        store.op(sid, {"op": "assume", "identifiers": ["wmb1v0"]})
+        first = store.op(sid, {"op": "resolve"})
+        again = store.op(sid, {"op": "resolve"})
+        assert first["result"] == again["result"]
+        variables = problem_io.problem_from_dict(_catalog_doc("wm"))
+        assert again["result"] == _oracle(
+            sched, variables, [("wmb1v0", True)])
+
+    def test_explain_is_resolve_shaped(self, store):
+        sid = store.create(_catalog_doc("ex"))["id"]
+        store.op(sid, {"op": "assume", "identifiers": ["exb0v1"],
+                       "installed": False})
+        out = store.op(sid, {"op": "explain"})
+        assert out["op"] == "explain"
+        assert out["result"]["status"] in ("sat", "unsat")
+
+
+# -------------------------------------------------------------- lifecycle
+
+
+class TestLifecycle:
+    def test_lease_expiry_lazy_and_sweeper(self, sched):
+        st = SessionStore(sched, metrics=telemetry.Registry(),
+                          lease_s=0.05, sweep_interval_s=3600.0)
+        try:
+            sid = st.create(_catalog_doc("lz"))["id"]
+            assert st.active() == 1
+            time.sleep(0.08)
+            with pytest.raises(SessionLost):
+                st.op(sid, {"op": "test"})
+            assert st.active() == 0
+            # Sweeper path: a fresh session lapses and sweep() reaps it
+            # without any op touching the map.
+            st.create(_catalog_doc("lz2"))
+            time.sleep(0.08)
+            assert st.sweep() == 1
+            assert st.active() == 0
+        finally:
+            st.stop()
+
+    def test_ops_renew_the_lease(self, sched):
+        st = SessionStore(sched, metrics=telemetry.Registry(),
+                          lease_s=0.25, sweep_interval_s=3600.0)
+        try:
+            sid = st.create(_catalog_doc("rn"))["id"]
+            for _ in range(4):
+                time.sleep(0.1)
+                st.op(sid, {"op": "test"})  # renews: never lapses
+                st.op(sid, {"op": "untest"})
+            assert st.active() == 1
+        finally:
+            st.stop()
+
+    def test_per_tenant_cap_sheds_counted(self, sched):
+        reg = telemetry.Registry()
+        st = SessionStore(sched, metrics=reg, max_per_tenant=2,
+                          sweep_interval_s=3600.0)
+        try:
+            st.create(_catalog_doc("t1"), tenant="acme")
+            st.create(_catalog_doc("t2"), tenant="acme")
+            with pytest.raises(SessionShed):
+                st.create(_catalog_doc("t3"), tenant="acme")
+            # Another tenant is unaffected by acme's cap.
+            st.create(_catalog_doc("t4"), tenant="other")
+            page = reg.render()
+            assert 'deppy_session_evictions_total{reason="shed"} 1' \
+                in page
+            assert "deppy_session_active 3" in page
+        finally:
+            st.stop()
+
+    def test_cap_evicts_expired_before_shedding(self, sched):
+        reg = telemetry.Registry()
+        st = SessionStore(sched, metrics=reg, lease_s=0.05,
+                          max_sessions=1, sweep_interval_s=3600.0)
+        try:
+            st.create(_catalog_doc("ev"))
+            time.sleep(0.08)
+            # At the global cap, but the incumbent is expired: the
+            # create evicts it instead of shedding.
+            st.create(_catalog_doc("ev2"))
+            assert st.active() == 1
+            page = reg.render()
+            assert ('deppy_session_evictions_total'
+                    '{reason="cap_expired"} 1') in page
+        finally:
+            st.stop()
+
+    def test_live_sessions_never_evicted(self, sched):
+        st = SessionStore(sched, metrics=telemetry.Registry(),
+                          max_sessions=1, sweep_interval_s=3600.0)
+        try:
+            sid = st.create(_catalog_doc("lv"))["id"]
+            with pytest.raises(SessionShed):
+                st.create(_catalog_doc("lv2"))
+            st.op(sid, {"op": "test"})  # the incumbent still serves
+            st.op(sid, {"op": "untest"})
+        finally:
+            st.stop()
+
+    def test_chaos_fault_point(self, store):
+        from deppy_tpu.faults.inject import KNOWN_POINTS
+
+        assert "sessions.op" in KNOWN_POINTS
+        sid = store.create(_catalog_doc("ch"))["id"]
+        faults.configure_plan(faults.FaultPlan.from_doc(
+            [{"point": "sessions.op", "times": 1}]))
+        with pytest.raises(faults.InjectedFault):
+            store.op(sid, {"op": "test"})
+        # One-shot rule consumed: the store serves again.
+        out = store.op(sid, {"op": "test"})
+        assert out["op"] == "test"
+
+
+# ---------------------------------------------------------------- handoff
+
+
+class TestHandoff:
+    def _scripted(self, store):
+        sid = store.create(_catalog_doc("ho"), tenant="acme")["id"]
+        store.op(sid, {"op": "assume", "identifiers": ["hob1v0"]})
+        store.op(sid, {"op": "test"})
+        store.op(sid, {"op": "assume", "identifiers": ["hob2v1"],
+                       "installed": False})
+        return sid, store.op(sid, {"op": "resolve"})
+
+    def test_export_import_round_trip(self, sched, store):
+        sid, answer = self._scripted(store)
+        entries = store.export_entries()
+        assert len(entries) == 1 and entries[0]["id"] == sid
+        assert entries[0]["affinity"] == \
+            store._sessions[sid].key  # routes like any warm entry
+        inheritor = SessionStore(sched, metrics=telemetry.Registry(),
+                                 sweep_interval_s=3600.0)
+        try:
+            assert inheritor.import_entry(entries[0]) is True
+            # The rebuilt scope stack answers byte-identically (the
+            # imported private index may warm-start the solve — the
+            # rendered result must not drift either way)...
+            out = inheritor.op(sid, {"op": "resolve"})
+            assert out["result"] == answer["result"]
+            # ...and untest pops back to the pre-test state.
+            out = inheritor.op(sid, {"op": "untest"})
+            assert out["depth"] == 0
+        finally:
+            inheritor.stop()
+
+    def test_import_live_wins_and_rejects_garbage(self, store):
+        sid, _ = self._scripted(store)
+        [entry] = store.export_entries()
+        assert store.import_entry(entry) is False  # live id wins
+        assert store.import_entry({"id": "x"}) is False
+        dead = dict(entry, id="dead", lease_remaining_s=0.0)
+        assert store.import_entry(dead) is False
+        bad_scope = dict(entry, id="bs", scope_base=999)
+        assert store.import_entry(bad_scope) is False
+        assert store.active() == 1
+
+    def test_sessions_ride_snapshot_stream_checksummed(self, sched, store):
+        from deppy_tpu.fleet.snapshot import (
+            SnapshotFormatError, export_warm_state, import_warm_state,
+            verify_snapshot)
+
+        self._scripted(store)
+        doc = export_warm_state(sched, sessions=store)
+        assert len(doc["sessions"]) == 1
+        verify_snapshot(json.loads(json.dumps(doc)))
+        tampered = json.loads(json.dumps(doc))
+        tampered["sessions"][0]["tenant"] = "mallory"
+        with pytest.raises(SnapshotFormatError):
+            verify_snapshot(tampered)
+        inheritor = SessionStore(sched, metrics=telemetry.Registry(),
+                                 sweep_interval_s=3600.0)
+        try:
+            out = import_warm_state(sched, doc, sessions=inheritor)
+            assert out["sessions_imported"] == 1
+            assert inheritor.active() == 1
+        finally:
+            inheritor.stop()
+
+    def test_sessionless_snapshot_byte_identical(self, sched):
+        from deppy_tpu.fleet.snapshot import export_warm_state
+
+        doc = export_warm_state(sched)
+        assert "sessions" not in doc  # pre-session format, byte for byte
+        from deppy_tpu.fleet.snapshot import import_warm_state
+
+        out = import_warm_state(sched, doc)
+        assert "sessions_imported" not in out
+
+
+# ---------------------------------------------------------------- service
+
+
+class TestService:
+    def test_http_flow_byte_identical_to_oneshot(self):
+        srv = _host_server(sched="on")
+        try:
+            doc = _catalog_doc("sv")
+            s, body = _request(srv.api_port, "POST", "/v1/session", doc)
+            assert s == 200
+            created = json.loads(body)["session"]
+            op_path = f"/v1/session/{created['id']}/op"
+            s, body = _request(srv.api_port, "POST", op_path, {
+                "op": "assume", "identifiers": ["svb1v0"]})
+            assert s == 200
+            s, body = _request(srv.api_port, "POST", op_path,
+                               {"op": "resolve"})
+            assert s == 200
+            got = json.loads(body)["result"]
+            # The oracle: one-shot /v1/resolve of the derived document.
+            derived = json.loads(json.dumps(doc))
+            for v in derived["variables"]:
+                if v["id"] == "svb1v0":
+                    v.setdefault("constraints", []).append(
+                        {"type": "mandatory"})
+            s, body = _request(srv.api_port, "POST", "/v1/resolve",
+                               derived)
+            assert s == 200
+            assert got == json.loads(body)["results"][0]
+            # Error contract: bad op 400, unknown session 404,
+            # malformed deadline 400.
+            s, _ = _request(srv.api_port, "POST", op_path, {"op": "zz"})
+            assert s == 400
+            s, body = _request(srv.api_port, "POST",
+                               "/v1/session/deadbeef/op",
+                               {"op": "resolve"})
+            assert s == 404
+            s, _ = _request(srv.api_port, "POST", op_path,
+                            {"op": "resolve"},
+                            headers={"X-Deppy-Deadline-S": "nan"})
+            assert s == 400
+            # The ISSUE 20 metric families are live.
+            s, page = _request(srv.api_port, "GET", "/metrics")
+            text = page.decode()
+            for fam in ("deppy_session_active",
+                        "deppy_session_ops_total",
+                        "deppy_session_expired_total",
+                        "deppy_session_evictions_total"):
+                assert fam in text
+        finally:
+            srv.shutdown()
+
+    def test_off_switch_404_byte_identical_no_metrics(self):
+        srv = _host_server(sched="on", sessions="off")
+        try:
+            assert srv.sessions is None
+            s1, b1 = _request(srv.api_port, "POST", "/v1/session",
+                              _catalog_doc("off"))
+            s2, b2 = _request(srv.api_port, "POST", "/v1/no-such-path",
+                              _catalog_doc("off"))
+            assert (s1, b1) == (s2, b2) == (404, b1)
+            assert b1 == b'{"error": "not found"}'
+            s, page = _request(srv.api_port, "GET", "/metrics")
+            assert "deppy_session" not in page.decode()
+        finally:
+            srv.shutdown()
+
+    def test_schedless_server_has_no_sessions(self):
+        srv = _host_server(sched="off")
+        try:
+            assert srv.sessions is None
+            s, _ = _request(srv.api_port, "POST", "/v1/session",
+                            _catalog_doc("ns"))
+            assert s == 404
+        finally:
+            srv.shutdown()
+
+
+# ------------------------------------------------------------------ fleet
+
+
+class TestFleetRouting:
+    def _fleet(self):
+        from deppy_tpu.fleet import Router
+
+        replicas = [
+            _host_server(sched="on", replica=f"r{i}") for i in range(2)]
+        addrs = [f"127.0.0.1:{s.api_port}" for s in replicas]
+        router = Router(bind_address="127.0.0.1:0", replicas=addrs,
+                        probe_interval_s=3600.0)
+        router.start()
+        return router, replicas, addrs
+
+    def _holder(self, replicas, sid):
+        return next(s for s in replicas
+                    if s.sessions is not None
+                    and sid in s.sessions._sessions)
+
+    def test_ops_route_by_session_key_and_survive_drain(self):
+        router, replicas, addrs = self._fleet()
+        try:
+            doc = _catalog_doc("fl")
+            s, body = _request(router.api_port, "POST", "/v1/session", doc)
+            assert s == 200
+            created = json.loads(body)["session"]
+            sid, key = created["id"], created["key"]
+            op_path = f"/v1/session/{sid}/op"
+            hdr = {"X-Deppy-Session": key}
+            s, _ = _request(router.api_port, "POST", op_path,
+                            {"op": "assume", "identifiers": ["flb1v0"]},
+                            headers=hdr)
+            assert s == 200
+            s, body = _request(router.api_port, "POST", op_path,
+                               {"op": "resolve"}, headers=hdr)
+            assert s == 200
+            answer = json.loads(body)["result"]
+            holder = self._holder(replicas, sid)
+            survivor = next(r for r in replicas if r is not holder)
+            # Live drain: the holder's warm state — the session
+            # included — re-homes onto the survivor.
+            s, body = _request(
+                router.api_port, "POST", "/fleet/drain",
+                {"replica": f"127.0.0.1:{holder.api_port}"})
+            assert s == 200
+            drained = json.loads(body)["drain"]
+            assert drained["sessions"] == 1
+            assert survivor.sessions.active() == 1
+            # The same op stream continues, byte-identically.
+            s, body = _request(router.api_port, "POST", op_path,
+                               {"op": "resolve"}, headers=hdr)
+            assert s == 200
+            assert json.loads(body)["result"] == answer
+        finally:
+            router.shutdown()
+            for r in replicas:
+                r.shutdown()
+
+    def test_dead_replica_surfaces_409_session_lost(self):
+        router, replicas, addrs = self._fleet()
+        try:
+            s, body = _request(router.api_port, "POST", "/v1/session",
+                               _catalog_doc("dd"))
+            assert s == 200
+            created = json.loads(body)["session"]
+            holder = self._holder(replicas, created["id"])
+            # Hard-kill the holder (no drain): the retained state dies
+            # with it.  The router's transport retry lands on the ring
+            # successor, which does not hold the session — the client
+            # sees one clean 409, never a 502.
+            holder.shutdown()
+            s, body = _request(
+                router.api_port, "POST",
+                f"/v1/session/{created['id']}/op", {"op": "resolve"},
+                headers={"X-Deppy-Session": created["key"]})
+            assert s == 409
+            assert json.loads(body) == {"error": "session lost"}
+        finally:
+            router.shutdown()
+            for r in replicas:
+                try:
+                    r.shutdown()
+                except Exception:
+                    pass
